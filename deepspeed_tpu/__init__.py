@@ -1,0 +1,74 @@
+"""deepspeed_tpu — a TPU-native training & inference framework with the
+capability set of DeepSpeed (reference v0.6.6), built on JAX/XLA/Pallas.
+
+Public API mirrors the reference's ``deepspeed/__init__.py``:
+  - ``initialize()`` (reference __init__.py:51) -> (engine, optimizer,
+    dataloader, lr_scheduler); dispatches to the pipeline engine when given a
+    PipelineModule (reference __init__.py:120-144).
+  - ``init_inference()`` (reference __init__.py:222) -> InferenceEngine.
+  - ``add_config_arguments()`` (reference __init__.py:206) argparse wiring.
+"""
+
+from .version import __version__  # noqa: F401
+
+from . import comm  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               loss_fn=None,
+               rng=None):
+    """Build the engine. See runtime/engine.py for the TPU-native design."""
+    from .runtime.engine import DeepSpeedEngine
+    from .runtime.pipe.module import PipelineModule
+    from .runtime.pipe.engine import PipelineEngine
+
+    config = config if config is not None else config_params
+    if args is not None and config is None:
+        config = getattr(args, "deepspeed_config", None)
+
+    engine_cls = PipelineEngine if isinstance(model, PipelineModule) else DeepSpeedEngine
+    engine = engine_cls(model=model,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mpu=mpu,
+                        collate_fn=collate_fn,
+                        config=config,
+                        loss_fn=loss_fn,
+                        rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, **kwargs):
+    from .inference.engine import InferenceEngine
+    return InferenceEngine(model, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Reference __init__.py:206 / runtime/config.py argparse flags."""
+    group = parser.add_argument_group("DeepSpeed-TPU",
+                                      "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for parity)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the JSON config file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+    return argparse.SUPPRESS

@@ -1,0 +1,21 @@
+from .mesh import (  # noqa: F401
+    MESH_AXES,
+    MeshShape,
+    axis_size,
+    batch_sharding,
+    build_mesh,
+    get_global_mesh,
+    get_global_mesh_shape,
+    named_sharding,
+    reset_global_mesh,
+    set_global_mesh,
+    shard_leading_divisible,
+    tree_replicated,
+    tree_shard_over,
+)
+from .topology import (  # noqa: F401
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    ProcessTopology,
+)

@@ -1,0 +1,161 @@
+"""Device-mesh construction and sharding-rule helpers.
+
+This is the load-bearing seam of the framework (reference analogue: the
+process-group machinery spread across ``deepspeed/comm``, ``utils/groups.py``
+and ``runtime/pipe/topology.py``). Instead of NCCL process groups we build one
+``jax.sharding.Mesh`` with named axes and express every parallel strategy as a
+sharding over those axes:
+
+  - ``dp``  : data parallelism; ZeRO stages shard grads/optimizer/params here.
+  - ``tp``  : tensor (model) parallelism; matmul psum rides this axis.
+  - ``pp``  : pipeline stages; stage p2p is a ``ppermute`` over this axis.
+  - ``ep``  : expert parallelism; MoE all-to-all rides this axis.
+  - ``sp``  : sequence/context parallelism (Ulysses-style all-to-all).
+
+Axes of size 1 are kept in the mesh so sharding specs are stable regardless of
+configuration. Mesh axis order puts ``dp`` outermost (DCN-friendly) and
+``tp`` innermost (ICI-friendly), matching TPU topology: tensor-parallel
+partners need the highest bandwidth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order: outermost (slowest, DCN-tolerant) to innermost
+# (fastest, wants ICI).
+MESH_AXES = ("dp", "pp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshShape:
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def total(self) -> int:
+        return self.dp * self.pp * self.ep * self.sp * self.tp
+
+    def as_dict(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in MESH_AXES}
+
+    @staticmethod
+    def infer(n_devices: int, tp: int = 1, pp: int = 1, ep: int = 1, sp: int = 1,
+              dp: Optional[int] = None) -> "MeshShape":
+        """Fill in dp so the mesh covers all devices."""
+        denom = tp * pp * ep * sp
+        if dp is None:
+            if n_devices % denom != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by tp*pp*ep*sp={denom}")
+            dp = n_devices // denom
+        shape = MeshShape(dp=dp, pp=pp, ep=ep, sp=sp, tp=tp)
+        if shape.total() != n_devices:
+            raise ValueError(
+                f"mesh {shape.as_dict()} covers {shape.total()} devices, "
+                f"have {n_devices}")
+        return shape
+
+
+def build_mesh(shape: MeshShape, devices: Optional[Sequence] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape.total() != n:
+        raise ValueError(f"mesh needs {shape.total()} devices, got {n}")
+    dims = [getattr(shape, a) for a in MESH_AXES]
+    dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, MESH_AXES)
+
+
+_GLOBAL_MESH: Optional[Mesh] = None
+_GLOBAL_SHAPE: Optional[MeshShape] = None
+
+
+def set_global_mesh(mesh: Mesh, shape: MeshShape) -> None:
+    global _GLOBAL_MESH, _GLOBAL_SHAPE
+    _GLOBAL_MESH = mesh
+    _GLOBAL_SHAPE = shape
+
+
+def get_global_mesh() -> Mesh:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        shape = MeshShape.infer(len(jax.devices()))
+        set_global_mesh(build_mesh(shape), shape)
+    return _GLOBAL_MESH
+
+
+def get_global_mesh_shape() -> MeshShape:
+    get_global_mesh()
+    return _GLOBAL_SHAPE
+
+
+def reset_global_mesh() -> None:
+    global _GLOBAL_MESH, _GLOBAL_SHAPE
+    _GLOBAL_MESH = None
+    _GLOBAL_SHAPE = None
+
+
+def axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_global_mesh()
+    return mesh.shape[axis]
+
+
+# ---------------------------------------------------------------------------
+# Sharding-rule helpers (the ZeRO mapping lives on top of these).
+# ---------------------------------------------------------------------------
+
+def shard_leading_divisible(shape: Tuple[int, ...], axes: Sequence[str],
+                            mesh: Optional[Mesh] = None) -> P:
+    """PartitionSpec sharding the first dim divisible by the product of the
+    given mesh axes; replicate if nothing divides. This is the generic rule
+    used to shard flat optimizer-state / master-param tensors over ``dp``
+    (ZeRO-1/2/3) without per-tensor hand annotation."""
+    mesh = mesh or get_global_mesh()
+    group = math.prod(mesh.shape[a] for a in axes)
+    if group == 1:
+        return P()
+    for i, d in enumerate(shape):
+        if d % group == 0 and d > 0:
+            spec = [None] * len(shape)
+            spec[i] = tuple(axes) if len(axes) > 1 else axes[0]
+            return P(*spec)
+    return P()
+
+
+def named_sharding(spec: P, mesh: Optional[Mesh] = None) -> NamedSharding:
+    return NamedSharding(mesh or get_global_mesh(), spec)
+
+
+def tree_shard_over(tree, axes: Sequence[str], mesh: Optional[Mesh] = None):
+    """Sharding pytree: every array leaf sharded by shard_leading_divisible."""
+    mesh = mesh or get_global_mesh()
+
+    def leaf_sharding(x):
+        shape = getattr(x, "shape", ())
+        return named_sharding(shard_leading_divisible(tuple(shape), axes, mesh), mesh)
+
+    return jax.tree_util.tree_map(leaf_sharding, tree)
+
+
+def tree_replicated(tree, mesh: Optional[Mesh] = None):
+    mesh = mesh or get_global_mesh()
+    sh = named_sharding(P(), mesh)
+    return jax.tree_util.tree_map(lambda _: sh, tree)
+
+
+def batch_sharding(mesh: Optional[Mesh] = None, extra_axes: Sequence[str] = ()) -> NamedSharding:
+    """Batch dim sharded over dp (and optionally ep/sp) axes."""
+    axes = ("dp",) + tuple(extra_axes)
+    mesh = mesh or get_global_mesh()
+    axes = tuple(a for a in axes if mesh.shape[a] > 1) or ("dp",)
+    return named_sharding(P(axes if len(axes) > 1 else axes[0]), mesh)

@@ -1,0 +1,61 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``
+(`logger` + `log_dist` rank-filtered logging). Process identity comes from
+``jax.process_index()`` instead of torch.distributed ranks.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+@functools.lru_cache(None)
+def _make_logger(name: str = "deepspeed_tpu", level=logging.INFO):
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    logger_.addHandler(handler)
+    return logger_
+
+
+logger = _make_logger()
+
+
+def _process_index() -> int:
+    # Avoid importing jax (and initializing the backend) just to log before
+    # distributed setup; fall back to env.
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            return jax.process_index()
+        except Exception:
+            pass
+    return int(os.environ.get("RANK", "0"))
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log `message` only on the given process indices (None / [-1] = all)."""
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    levels = {
+        "debug": logging.DEBUG,
+        "info": logging.INFO,
+        "warning": logging.WARNING,
+        "error": logging.ERROR,
+        "critical": logging.CRITICAL,
+    }
+    target = levels.get(max_log_level_str.lower())
+    if target is None:
+        raise ValueError(f"Invalid log level: {max_log_level_str}")
+    return logger.getEffectiveLevel() <= target

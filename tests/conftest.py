@@ -1,0 +1,35 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the reference's distributed-test strategy (tests/unit/common.py:67 —
+N forked processes stand in for a cluster): here N virtual CPU devices in one
+process stand in for a TPU slice.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# jax may already be imported at interpreter start (site customization), in
+# which case it captured JAX_PLATFORMS from the outer env; override via config
+# before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "tests must run on the virtual CPU mesh; backend was initialized too early")
+assert len(jax.devices()) == 8
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    yield
+    mesh_lib.reset_global_mesh()

@@ -1,0 +1,100 @@
+"""Comm façade over an 8-device CPU mesh (reference: tests/unit/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.parallel import MeshShape, build_mesh, set_global_mesh
+
+
+@pytest.fixture
+def dp8():
+    shape = MeshShape(dp=8)
+    set_global_mesh(build_mesh(shape), shape)
+    return comm.new_group("dp")
+
+
+def test_world(dp8):
+    assert comm.device_count() == 8
+    assert dp8.size == 8
+
+
+def test_all_reduce_sum(dp8):
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)  # rank r holds [r]
+    out = comm.all_reduce(x, op="sum", group=dp8)
+    np.testing.assert_allclose(np.asarray(out), [28.0])
+
+
+def test_all_reduce_avg(dp8):
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = comm.all_reduce(x, op="avg", group=dp8)
+    np.testing.assert_allclose(np.asarray(out), [3.5])
+
+
+def test_all_reduce_max(dp8):
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+    out = comm.all_reduce(x, op="max", group=dp8)
+    np.testing.assert_allclose(np.asarray(out), [14.0, 15.0])
+
+
+def test_all_gather(dp8):
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+    out = comm.all_gather(x, group=dp8)
+    np.testing.assert_allclose(np.asarray(out), np.arange(16).reshape(8, 2))
+
+
+def test_all_gather_base_flat(dp8):
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+    out = comm.all_gather_base(x, group=dp8)
+    assert out.shape == (16,)
+    np.testing.assert_allclose(np.asarray(out), np.arange(16))
+
+
+def test_reduce_scatter_base(dp8):
+    # every rank holds the same [0..15]; owner slice r gets 8 * x[2r:2r+2]
+    x = jnp.tile(jnp.arange(16, dtype=jnp.float32), (8, 1))
+    out = comm.reduce_scatter_base(x, group=dp8)
+    assert out.shape == (8, 2)
+    expected = 8 * np.arange(16, dtype=np.float32).reshape(8, 2)
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_all_to_all_single(dp8):
+    # x[r][c] = 10*r + c ; out[r][c] should be x[c][r] = 10*c + r
+    x = (10 * jnp.arange(8)[:, None] + jnp.arange(8)[None, :]).astype(jnp.float32)
+    out = comm.all_to_all_single(x, group=dp8)
+    expected = np.asarray(x).T
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_broadcast(dp8):
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1) * 100
+    out = comm.broadcast(x, src=3, group=dp8)
+    np.testing.assert_allclose(np.asarray(out), [300.0])
+
+
+def test_ppermute_ring(dp8):
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = comm.ppermute(x, perm, group=dp8)
+    expected = np.roll(np.arange(8, dtype=np.float32), 1).reshape(8, 1)
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_subaxis_groups():
+    shape = MeshShape(dp=4, tp=2)
+    set_global_mesh(build_mesh(shape), shape)
+    tp = comm.new_group("tp")
+    assert tp.size == 2
+    dp = comm.new_group("dp")
+    assert dp.size == 4
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1)
+    out = comm.all_reduce(x, group=dp)
+    np.testing.assert_allclose(np.asarray(out), [6.0])
+
+
+def test_unknown_axis_rejected(dp8):
+    with pytest.raises(ValueError):
+        comm.new_group("bogus_axis")

@@ -1,0 +1,111 @@
+"""Config system (reference: tests/unit/test_config.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_algebra_full():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 2},
+                          dp_world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_algebra_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32,
+                           "train_micro_batch_size_per_gpu": 2},
+                          dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_algebra_infer_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4,
+                           "gradient_accumulation_steps": 3},
+                          dp_world_size=2)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_algebra_only_train():
+    cfg = DeepSpeedConfig({"train_batch_size": 16}, dp_world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_algebra_violation():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 33,
+                         "train_micro_batch_size_per_gpu": 2,
+                         "gradient_accumulation_steps": 2},
+                        dp_world_size=8)
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_sizes": 32})
+
+
+def test_zero_config():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 2,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            "reduce_bucket_size": 1000,
+        },
+    }, dp_world_size=8)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_config.reduce_bucket_size == 1000
+
+
+def test_zero_stage_bounds():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"zero_optimization": {"stage": 5}})
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_fp16_dynamic_scale():
+    cfg = DeepSpeedConfig({"fp16": {"enabled": True}})
+    assert cfg.fp16.dynamic_loss_scale
+    cfg2 = DeepSpeedConfig({"fp16": {"enabled": True, "loss_scale": 128}})
+    assert not cfg2.fp16.dynamic_loss_scale
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig({
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}},
+    })
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.params["warmup_num_steps"] == 10
+
+
+def test_json_file_load(tmp_path):
+    path = tmp_path / "ds_config.json"
+    path.write_text(json.dumps({"train_batch_size": 4, "steps_per_print": 5}))
+    cfg = DeepSpeedConfig(str(path), dp_world_size=4)
+    assert cfg.steps_per_print == 5
+    assert cfg.train_micro_batch_size_per_gpu == 1
+
+
+def test_nvme_offload_requires_stage3():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"zero_optimization": {
+            "stage": 2, "offload_param": {"device": "nvme"}}})
+
+
+def test_compute_dtype():
+    import jax.numpy as jnp
+    assert DeepSpeedConfig({"bf16": {"enabled": True}}).compute_dtype == jnp.bfloat16
+    assert DeepSpeedConfig({"fp16": {"enabled": True}}).compute_dtype == jnp.float16
+    assert DeepSpeedConfig({}).compute_dtype == jnp.float32

@@ -1,0 +1,77 @@
+"""Topology math, no devices needed (reference: tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    ProcessTopology,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_coord(2) == topo.ProcessCoord(row=1, col=0)
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("nope") == 0
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # data groups: ranks differing only in data coord
+    data_lists = topo.get_axis_comm_lists("data")
+    assert data_lists == [[0, 1], [2, 3]]
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert pipe_lists == [[0, 2], [1, 3]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+    with pytest.raises(ValueError):
+        topo.filter_match(bogus=0)
+
+
+def test_topology_axis_list():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    assert topo.get_axis_list("pipe", 0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("data", 1) == [1, 5]
+
+
+def test_grid():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, global_rank=5)
+    assert grid.pipe_parallel_size == 2
+    assert grid.data_parallel_size == 2
+    assert grid.model_parallel_size == 2
+    coord = topo.get_coord(5)
+    assert grid.stage_id == coord.pipe
+    # stage_to_global round trip
+    other = grid.stage_to_global(1 - grid.stage_id)
+    assert other != 5
+    assert topo.get_coord(other).pipe == 1 - grid.stage_id
+
+
+def test_grid_dp_only():
+    grid = PipelineParallelGrid(world_size=8, global_rank=3)
+    assert grid.data_parallel_size == 8
+    assert grid.pipe_parallel_size == 1
+    assert grid.is_first_stage() and grid.is_last_stage()
+
+
+def test_duplicate_axis_rejected():
+    with pytest.raises(ValueError):
+        ProcessTopology(axes=["a", "a"], dims=[2, 2])
