@@ -1,0 +1,71 @@
+"""Benchmark: GPT-2 125M bf16 training step on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured MFU / 0.45 (the north-star MFU target from
+BASELINE.md; >1.0 beats the target)."""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import GPT, gpt2_125m, lm_loss_fn
+
+    seq = 1024
+    batch = 8
+    cfg = gpt2_125m(max_seq_len=seq, dtype=jnp.bfloat16)
+    model = GPT(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+
+    engine, _, _, _ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=lm_loss_fn,
+        config={
+            "train_micro_batch_size_per_gpu": batch,
+            "gradient_accumulation_steps": 1,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "steps_per_print": 1000,
+        })
+
+    it = lambda: iter([{"input_ids": ids}])
+    # warmup / compile. NOTE: device_get of the scalar loss is the sync —
+    # block_until_ready is not reliable under the axon relay.
+    for _ in range(3):
+        loss = engine.train_batch(it())
+    float(jax.device_get(loss))
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(it())
+    float(jax.device_get(loss))
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = sum(int(p.size) for p in jax.tree.leaves(params))
+    tokens = batch * seq
+    # training flops: 6*N per token + attention 12*L*d*s per token
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.d_model * seq
+    achieved = flops_per_token * tokens / dt
+    # bf16 peak per chip: v5e ~197 TFLOPs, v5p ~459 TFLOPs
+    dev = jax.devices()[0]
+    peak = 459e12 if "v5p" in str(dev).lower() else 197e12
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "gpt2_125m_train_mfu",
+        "value": round(mfu, 4),
+        "unit": f"MFU (tokens/s={tokens/dt:.0f}, {achieved/1e12:.1f} TFLOP/s)",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,6 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -103,9 +102,12 @@ def get_rank() -> int:
 
 
 def get_world_size(group: Optional[CommGroup] = None) -> int:
+    """Total ranks. Reference semantics: one rank per accelerator, so the
+    no-group form counts *devices* (processes x local devices), matching the
+    size of a group spanning the whole mesh."""
     if group is not None:
         return group.size
-    return jax.process_count()
+    return len(jax.devices())
 
 
 def get_local_rank() -> int:
@@ -117,10 +119,9 @@ def device_count() -> int:
 
 
 def barrier() -> None:
-    """Cross-process sync: a tiny psum across all devices, blocked on."""
+    """Cross-process sync (no-op in single-process runs)."""
     if jax.process_count() == 1:
         return
-    x = jnp.ones((), dtype=jnp.int32)
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices("deepspeed_tpu_barrier")
 
@@ -215,6 +216,8 @@ def all_gather_base(x, group: Optional[CommGroup] = None):
 def reduce_scatter_base(x, op: str = "sum", group: Optional[CommGroup] = None):
     """x: [G, N] stacked per-rank tensors (N divisible by G) ->
     [G, N/G] where out[r] = reduce_r'(x[r', r-th chunk]). psum_scatter."""
+    if op not in ("sum", "avg"):
+        raise ValueError(f"reduce_scatter supports sum/avg, got {op!r}")
     group = _default_group(group)
     x = _stacked(x, group)
     ax = group.axis_name
@@ -251,6 +254,8 @@ def all_to_all_single(x, group: Optional[CommGroup] = None):
 def broadcast(x, src: int = 0, group: Optional[CommGroup] = None):
     """x: [G, ...] stacked; returns x[src] replicated to every rank."""
     group = _default_group(group)
+    if not 0 <= src < group.size:
+        raise ValueError(f"src {src} out of range for group of size {group.size}")
     x = _stacked(x, group)
     out = jax.device_put(x[src], NamedSharding(group.mesh, P(*([None] * (x.ndim - 1)))))
     return out

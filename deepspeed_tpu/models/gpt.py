@@ -1,0 +1,259 @@
+"""Flagship GPT model family (GPT-2 / GPT-NeoX style), TPU-first.
+
+This is the model zoo counterpart of the reference's test/model fixtures
+(tests/unit/simple_model.py, Megatron GPT-2 in tests/model/) and the target of
+the engine milestones (BASELINE.json configs: GPT-2 125M -> GPT-NeoX 20B ->
+175B). Design notes:
+
+  * Plain flax.linen with einsum attention; the hot ops (attention, layernorm)
+    route through ``deepspeed_tpu.ops`` so Pallas kernels can slot in.
+  * ``scan_layers=True`` stacks the transformer blocks into one scanned
+    layer with stacked params [L, ...] — this is the structure that makes
+    ZeRO-3 idiomatic on TPU: sharding the stacked leading-dim-L params over
+    ``dp`` gives per-layer all-gather/release for free inside ``lax.scan``,
+    and remat per scan step is the activation-checkpointing analogue
+    (reference runtime/activation_checkpointing/checkpointing.py:493).
+  * Tensor parallelism comes from sharding rules on param paths (see
+    runtime/sharding.py), not from model surgery: q/k/v and up-projection
+    kernels shard their output dim over ``tp``; out/down projections shard
+    their input dim; XLA inserts the psum (the reference does this manually
+    with ``LinearAllreduce``, module_inject/replace_module.py:13).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304          # pad to a multiple of 128 for the MXU
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    rotary: bool = False             # False: learned positions (GPT-2)
+    rotary_pct: float = 1.0
+    parallel_residual: bool = False  # True for NeoX
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16        # compute dtype
+    param_dtype: Any = jnp.float32
+    dropout: float = 0.0
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = "xla"      # xla | pallas | sparse
+    sparse_attention: Any = None     # SparsityConfig when attention_impl=sparse
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def gpt2_125m(**kw):
+    return GPTConfig(num_layers=12, num_heads=12, d_model=768, d_ff=3072, **kw)
+
+
+def gpt2_1_3b(**kw):
+    return GPTConfig(num_layers=24, num_heads=32, d_model=2048, d_ff=8192, **kw)
+
+
+def gpt_neox_6_7b(**kw):
+    return GPTConfig(num_layers=32, num_heads=32, d_model=4096, d_ff=16384,
+                     rotary=True, parallel_residual=True, **kw)
+
+
+def gpt_neox_20b(**kw):
+    return GPTConfig(num_layers=44, num_heads=64, d_model=6144, d_ff=24576,
+                     rotary=True, parallel_residual=True, tie_embeddings=False, **kw)
+
+
+def gpt3_175b(**kw):
+    return GPTConfig(num_layers=96, num_heads=96, d_model=12288, d_ff=49152, **kw)
+
+
+# --------------------------------------------------------------------------
+# Building blocks
+# --------------------------------------------------------------------------
+
+def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray, rotary_dim: int):
+    """Apply rotary position embedding to [..., S, H, D] over first rotary_dim."""
+    d = rotary_dim
+    x_rot, x_pass = x[..., :d], x[..., d:]
+    freqs = 1.0 / (10000 ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [.., S, d/2]
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    rot = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    return jnp.concatenate([rot.astype(x.dtype), x_pass], axis=-1)
+
+
+def causal_attention(q, k, v, *, dtype, impl: str = "xla", sparse_config=None,
+                     mask: Optional[jnp.ndarray] = None):
+    """q,k,v: [B, S, H, D]. Routes to the configured attention kernel."""
+    if impl == "pallas":
+        from ..ops.pallas.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    if impl == "sparse" and sparse_config is not None:
+        from ..ops.sparse_attention.sparse_self_attention import sparse_attention
+        return sparse_attention(q, k, v, sparse_config)
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = q.shape[1]
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(causal[None, None], logits, -1e10)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e10)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic=True):
+        cfg = self.cfg
+        qkv = nn.Dense(3 * cfg.d_model, use_bias=True, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s, _ = x.shape
+        shp = (b, s, cfg.num_heads, cfg.head_dim)
+        q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
+        if cfg.rotary:
+            rd = int(cfg.rotary_pct * cfg.head_dim)
+            q = rotary_embedding(q, positions, rd)
+            k = rotary_embedding(k, positions, rd)
+        out = causal_attention(q, k, v, dtype=cfg.dtype,
+                               impl=cfg.attention_impl,
+                               sparse_config=cfg.sparse_attention)
+        out = out.reshape(b, s, cfg.d_model)
+        return nn.Dense(cfg.d_model, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="out_proj")(out)
+
+
+class MLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="up_proj")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="down_proj")(h)
+
+
+class Block(nn.Module):
+    """One transformer block. Returns ``(x, None)`` so it can be the body of
+    ``nn.scan`` directly (carry, per-step-output) — the scan-over-layers
+    structure is what makes ZeRO-3 gather/release and per-layer remat
+    idiomatic on TPU."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic=True):
+        cfg = self.cfg
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name="ln_1")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                           param_dtype=cfg.param_dtype, name="ln_2")
+        attn = SelfAttention(cfg, name="attn")
+        mlp = MLP(cfg, name="mlp")
+        if cfg.parallel_residual:
+            # NeoX: x + attn(ln1(x)) + mlp(ln2(x))
+            out = x + attn(ln1(x), positions, deterministic) \
+                    + mlp(ln2(x), deterministic)
+        else:
+            h = x + attn(ln1(x), positions, deterministic)
+            out = h + mlp(ln2(h), deterministic)
+        return out, None
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. __call__(input_ids [B,S]) -> logits [B,S,V]."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=True):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        positions = jnp.arange(s)[None, :].repeat(b, axis=0)
+
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="wte")
+        x = embed(input_ids)
+        if not cfg.rotary:
+            pos_emb = self.param(
+                "wpe", nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+            x = x + pos_emb[None, :s].astype(cfg.dtype)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+
+        if cfg.scan_layers:
+            ScannedBlock = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast),
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = ScannedBlock(cfg, name="blocks")(x, positions, deterministic)
+        else:
+            for i in range(cfg.num_layers):
+                x, _ = block(cfg, name=f"block_{i}")(x, positions, deterministic)
+
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits
+
+
+def lm_loss_fn(logits, batch):
+    """Next-token cross entropy. batch: {input_ids, labels?} — labels default
+    to shifted input_ids."""
+    labels = batch.get("labels")
+    if labels is None:
+        labels = batch["input_ids"][:, 1:]
+        logits = logits[:, :-1]
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask[:, :nll.shape[1]]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def count_params(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def gpt_flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
+    """6N + attention flops per token (for MFU accounting)."""
+    s = seq_len or cfg.max_seq_len
+    n = (12 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff) * cfg.num_layers \
+        + 2 * cfg.vocab_size * cfg.d_model
+    # dense params approx: use actual 6*N plus attention quadratic term
+    return 6.0 * n + 12.0 * cfg.num_layers * cfg.d_model * s

@@ -1,0 +1,92 @@
+"""Monitoring fan-out (reference: deepspeed/monitor/monitor.py —
+``MonitorMaster``:24 dispatching to TensorBoard/W&B/CSV writers, rank-0 only).
+
+Events are ``(label, value, global_sample_count)`` tuples, same contract as
+the reference's ``write_events`` (monitor/monitor.py:45)."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+
+class _BaseWriter:
+    def write_events(self, events: List[Tuple]):
+        raise NotImplementedError
+
+    def flush(self):
+        pass
+
+
+class CsvWriter(_BaseWriter):
+    def __init__(self, cfg):
+        self.out_dir = os.path.join(cfg.output_path or "csv_monitor", cfg.job_name)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events):
+        for label, value, sample in events:
+            fname = os.path.join(self.out_dir, label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["sample", label])
+                w.writerow([int(sample), float(value)])
+
+
+class TensorBoardWriter(_BaseWriter):
+    def __init__(self, cfg):
+        from torch.utils.tensorboard import SummaryWriter
+        path = os.path.join(cfg.output_path or "tensorboard", cfg.job_name)
+        self.writer = SummaryWriter(log_dir=path)
+
+    def write_events(self, events):
+        for label, value, sample in events:
+            self.writer.add_scalar(label, value, int(sample))
+
+    def flush(self):
+        self.writer.flush()
+
+
+class WandbWriter(_BaseWriter):
+    def __init__(self, cfg):
+        import wandb
+        wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+        self.wandb = wandb
+
+    def write_events(self, events):
+        for label, value, sample in events:
+            self.wandb.log({label: value}, step=int(sample))
+
+
+class MonitorMaster:
+    def __init__(self, ds_config):
+        self.writers: List[_BaseWriter] = []
+        self.enabled = False
+        if jax.process_index() != 0:
+            return
+        for cfg, cls in ((ds_config.tensorboard, TensorBoardWriter),
+                         (ds_config.wandb, WandbWriter),
+                         (ds_config.csv_monitor, CsvWriter)):
+            if cfg.enabled:
+                try:
+                    self.writers.append(cls(cfg))
+                except Exception as e:  # missing backend is non-fatal
+                    logger.warning(f"monitor backend {cls.__name__} disabled: {e}")
+        self.enabled = bool(self.writers)
+
+    def write_events(self, events):
+        if not self.enabled:
+            return
+        for w in self.writers:
+            w.write_events(events)
+
+    def flush(self):
+        for w in self.writers:
+            w.flush()

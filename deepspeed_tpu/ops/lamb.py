@@ -1,0 +1,62 @@
+"""Fused LAMB (reference: csrc/lamb/fused_lamb_cuda_kernel.cu via
+ops/lamb/fused_lamb.py:189). Per-tensor trust ratio = ||w|| / ||update||,
+computed with jnp norms — on TPU the reductions fuse into the update kernel.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LambState(NamedTuple):
+    count: jnp.ndarray
+    mu: any
+    nu: any
+
+
+def fused_lamb(learning_rate=1e-3,
+               betas=(0.9, 0.999),
+               eps: float = 1e-6,
+               weight_decay: float = 0.0,
+               max_coeff: float = 10.0,
+               min_coeff: float = 0.01,
+               bias_correction: bool = True) -> optax.GradientTransformation:
+    b1, b2 = betas
+
+    def init(params):
+        z = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return LambState(count=jnp.zeros((), jnp.int32),
+                         mu=jax.tree.map(z, params), nu=jax.tree.map(z, params))
+
+    def update(grads, state, params=None):
+        assert params is not None, "LAMB needs params for the trust ratio"
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(m.dtype), state.mu, grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(v.dtype)), state.nu, grads)
+        if bias_correction:
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+        else:
+            c1 = c2 = jnp.ones((), jnp.float32)
+
+        def upd(m, v, p):
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p.astype(u.dtype)
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(u)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, min_coeff, max_coeff),
+                1.0)
+            return (-lr * trust * u).astype(p.dtype)
+
+        updates = jax.tree.map(upd, mu, nu, params)
+        return updates, LambState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)

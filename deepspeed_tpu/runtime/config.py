@@ -284,6 +284,17 @@ class ElasticityConfig:
 
 
 @dataclass
+class MeshConfig:
+    """TPU-only extension: requested mesh axis sizes. dp=None => fill to
+    cover all devices."""
+    dp: Optional[int] = None
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+
+@dataclass
 class OptimizerConfig:
     type: str = "Adam"
     params: Dict[str, Any] = field(default_factory=dict)
@@ -318,28 +329,19 @@ _SUBCONFIG_KEYS = {
     "elasticity": ("elasticity", ElasticityConfig),
     "optimizer": ("optimizer", OptimizerConfig),
     "scheduler": ("scheduler", SchedulerConfig),
+    "mesh": ("mesh", MeshConfig),
 }
 
-_SCALAR_KEYS = {
-    "train_batch_size": ("train_batch_size", None),
-    "train_micro_batch_size_per_gpu": ("train_micro_batch_size_per_gpu", None),
-    "gradient_accumulation_steps": ("gradient_accumulation_steps", None),
-    "steps_per_print": ("steps_per_print", 10),
-    "gradient_clipping": ("gradient_clipping", 0.0),
-    "prescale_gradients": ("prescale_gradients", False),
-    "gradient_predivide_factor": ("gradient_predivide_factor", 1.0),
-    "wall_clock_breakdown": ("wall_clock_breakdown", False),
-    "memory_breakdown": ("memory_breakdown", False),
-    "dump_state": ("dump_state", False),
-    "disable_allgather": ("disable_allgather", False),
-    "communication_data_type": ("communication_data_type", None),
-    "sparse_gradients": ("sparse_gradients", False),
-    "zero_allow_untested_optimizer": ("zero_allow_untested_optimizer", False),
-    "checkpoint_tag_validation": ("checkpoint_tag_validation", "warn"),
-    "dataloader_drop_last": ("dataloader_drop_last", False),
-    "amp": ("amp", None),
-    "seed": ("seed", 42),
-}
+# JSON key -> attribute name (defaults live on the dataclass fields).
+_SCALAR_KEYS = {k: k for k in (
+    "train_batch_size", "train_micro_batch_size_per_gpu",
+    "gradient_accumulation_steps", "steps_per_print", "gradient_clipping",
+    "prescale_gradients", "gradient_predivide_factor", "wall_clock_breakdown",
+    "memory_breakdown", "dump_state", "disable_allgather",
+    "communication_data_type", "sparse_gradients",
+    "zero_allow_untested_optimizer", "checkpoint_tag_validation",
+    "dataloader_drop_last", "amp", "seed",
+)}
 
 
 @dataclass
@@ -387,6 +389,7 @@ class DeepSpeedConfig:
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     optimizer: Optional[OptimizerConfig] = None
     scheduler: Optional[SchedulerConfig] = None
+    mesh: MeshConfig = field(default_factory=MeshConfig)
 
     dp_world_size: int = 1
 
@@ -423,7 +426,7 @@ class DeepSpeedConfig:
                     raise DeepSpeedConfigError(f"{key} must be an object")
                 setattr(self, attr, _take(value, cls))
             elif key in _SCALAR_KEYS:
-                setattr(self, _SCALAR_KEYS[key][0], value)
+                setattr(self, _SCALAR_KEYS[key], value)
             elif key.startswith("#") or key.startswith("_comment"):
                 continue
             else:

@@ -1,0 +1,575 @@
+"""The core training engine.
+
+Reference analogue: ``DeepSpeedEngine`` (``deepspeed/runtime/engine.py:175``)
+with ``forward``:1552 / ``backward``:1665 / ``step``:1867 /
+``save_checkpoint``:2768 / ``load_checkpoint``:2438.
+
+TPU-native redesign:
+
+  * The reference engine orchestrates eager CUDA work (hooks, side streams,
+    bucketed allreduce, loss-scale host syncs). Here the whole
+    forward+backward+accumulate+update of one global batch is ONE jitted
+    program — ``lax.scan`` over the gradient-accumulation microbatches
+    followed by the guarded optimizer update — so XLA fuses, overlaps
+    collectives with compute, and never syncs to host mid-step.
+  * ZeRO stages are sharding rules (runtime/sharding.py), not code paths:
+    stage 1 shards master+optimizer state over ``dp``; stage 2 additionally
+    constrains grads to the sharded spec (psum -> reduce_scatter); stage 3
+    shards params. The reference's bucketing/overlap machinery
+    (stage_1_and_2.py:783-1014) is XLA's latency-hiding scheduler here.
+  * fp16 dynamic loss scaling runs fully in-graph (fp16/loss_scaler.py);
+    an overflow step selects the old state with ``jnp.where`` instead of
+    raising to host (engine.py:1798 overflow-skip accounting).
+  * The 3-call API (forward / backward / step) is preserved. On TPU the
+    gradient is computed with the forward pass (one fused program), so
+    ``forward`` runs micro-step + accumulation and ``backward`` is the GAS
+    bookkeeping point; semantics (losses returned, update cadence, lr
+    schedule, clipping, overflow skipping) match the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm
+from ..checkpoint import saving as ckpt_saving
+from ..ops.adam import fused_adagrad, fused_adam
+from ..ops.lamb import fused_lamb
+from ..parallel import mesh as mesh_lib
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from .config import DeepSpeedConfig
+from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .fp16.loss_scaler import (LossScaleState, grads_finite,
+                               make_loss_scale_state, update_scale)
+from .lr_schedules import build_lr_scheduler
+from .sharding import ShardingRules
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
+
+
+def _cast_tree(tree, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def _global_norm(tree):
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+class DeepSpeedEngine:
+    def __init__(self, model=None, optimizer=None, model_parameters=None,
+                 training_data=None, lr_scheduler=None, mpu=None,
+                 collate_fn=None, config=None, loss_fn=None, rng=None,
+                 dont_change_device=False):
+        comm.init_distributed()
+
+        # ---- mesh ----------------------------------------------------------
+        raw = config if isinstance(config, dict) else None
+        pre_cfg = DeepSpeedConfig(config, dp_world_size=1) if not isinstance(config, DeepSpeedConfig) else config
+        mc = pre_cfg.mesh
+        n_dev = len(jax.devices())
+        shape = mesh_lib.MeshShape.infer(n_dev, tp=mc.tp, pp=mc.pp, ep=mc.ep,
+                                         sp=mc.sp, dp=mc.dp)
+        self.mesh = mesh_lib.build_mesh(shape)
+        mesh_lib.set_global_mesh(self.mesh, shape)
+        self.dp_world_size = shape.dp
+        self.mp_world_size = shape.tp
+
+        # ---- config (batch algebra against real dp world) ------------------
+        self.config = DeepSpeedConfig(
+            config if not isinstance(config, DeepSpeedConfig) else config._raw,
+            dp_world_size=self.dp_world_size)
+        self._config = self.config  # reference-name parity
+
+        self.module = model
+        self.loss_fn = loss_fn
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self.steps_per_print())
+
+        # monitor (rank-0 writers)
+        from ..monitor.monitor import MonitorMaster
+        self.monitor = MonitorMaster(self.config)
+
+        # flops profiler
+        from ..profiling.flops_profiler import FlopsProfiler
+        self.flops_profiler = FlopsProfiler(self) if self.config.flops_profiler.enabled else None
+
+        # ---- precision -----------------------------------------------------
+        self.compute_dtype = self.config.compute_dtype
+        self.fp16_enabled = self.config.fp16.enabled
+        self.bfloat16_enabled = self.config.bf16.enabled
+        self.dynamic_loss_scale = self.config.fp16.dynamic_loss_scale if self.fp16_enabled else False
+
+        # ---- ZeRO sharding rules ------------------------------------------
+        self.zero_stage = self.config.zero_optimization_stage
+        self.rules = ShardingRules(self.mesh, self.zero_stage)
+
+        # ---- parameters ----------------------------------------------------
+        if model_parameters is None:
+            raise ValueError(
+                "model_parameters (a param pytree) is required: init your "
+                "flax module and pass variables['params']")
+        self._init_state(model_parameters, optimizer, rng)
+
+        # ---- lr scheduler --------------------------------------------------
+        if lr_scheduler is not None:
+            self.lr_scheduler = lr_scheduler
+        else:
+            self.lr_scheduler = build_lr_scheduler(self.config.scheduler)
+
+        # fold schedule into the optimizer's lr (compiled into the step)
+        self._rebuild_optimizer_with_schedule()
+
+        # ---- dataloader ----------------------------------------------------
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data)
+
+        # jit caches
+        self._jit_train = None
+        self._jit_micro = None
+        self._jit_apply = None
+        self._pending_loss = None
+
+        log_dist(
+            f"engine ready: mesh={shape.as_dict()} zero_stage={self.zero_stage} "
+            f"dtype={jnp.dtype(self.compute_dtype).name} "
+            f"batch={self.train_batch_size()}={self.train_micro_batch_size_per_gpu()}"
+            f"x{self.gradient_accumulation_steps()}x{self.dp_world_size}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------ init
+    def _build_base_optimizer(self, optimizer):
+        if optimizer is not None and not isinstance(optimizer, optax.GradientTransformation):
+            raise TypeError("optimizer must be an optax.GradientTransformation")
+        if optimizer is not None:
+            self._client_optimizer = optimizer
+            self._opt_factory = lambda lr: optimizer
+            return
+        oc = self.config.optimizer
+        otype = (oc.type if oc else "Adam").lower()
+        params = dict(oc.params) if oc else {}
+        lr = params.pop("lr", 1e-3)
+        betas = tuple(params.pop("betas", (0.9, 0.999)))
+        eps = params.pop("eps", 1e-8)
+        wd = params.pop("weight_decay", 0.0)
+        params.pop("bias_correction", None)
+        params.pop("torch_adam", None)
+        params.pop("adam_w_mode", None)
+        if otype in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam"):
+            self._opt_factory = lambda lr_fn: fused_adam(
+                lr_fn, betas=betas, eps=eps, weight_decay=wd,
+                adam_w_mode=(otype != "adam"))
+        elif otype in ("lamb", "onebitlamb"):
+            self._opt_factory = lambda lr_fn: fused_lamb(
+                lr_fn, betas=betas, eps=eps, weight_decay=wd, **params)
+        elif otype == "adagrad":
+            self._opt_factory = lambda lr_fn: fused_adagrad(
+                lr_fn, eps=params.pop("eps", 1e-10), weight_decay=wd)
+        elif otype == "sgd":
+            mom = params.pop("momentum", 0.0)
+            self._opt_factory = lambda lr_fn: optax.sgd(lr_fn, momentum=mom)
+        else:
+            raise ValueError(f"unknown optimizer type {oc.type!r}")
+        self._base_lr = lr
+        self._client_optimizer = None
+
+    def _rebuild_optimizer_with_schedule(self):
+        if self._client_optimizer is not None:
+            self.optimizer = self._client_optimizer
+            return
+        if self.lr_scheduler is not None:
+            sched = self.lr_scheduler
+            lr_fn = lambda count: sched.lr_at(count)
+        else:
+            base = self._base_lr
+            lr_fn = lambda count: base
+        self.optimizer = self._opt_factory(lr_fn)
+        # re-init opt state only if not yet created
+        if getattr(self, "state", None) is not None and self.state.get("opt") is None:
+            self._init_opt_state()
+
+    def _init_state(self, model_parameters, optimizer, rng):
+        self._build_base_optimizer(optimizer)
+
+        # copy (not alias) the user's params: engine state buffers are donated
+        # every step and must not share storage with caller-held arrays
+        master = jax.tree.map(lambda x: jnp.array(x, dtype=jnp.float32, copy=True),
+                              model_parameters)
+        self.master_shardings = self.rules.shardings(self.rules.master_specs(master))
+        self.param_shardings = self.rules.shardings(self.rules.param_specs(master))
+        self.grad_shardings = self.rules.shardings(self.rules.grad_specs(master))
+        master = jax.device_put(master, self.master_shardings)
+
+        scale_state = make_loss_scale_state(
+            static_scale=self.config.fp16.loss_scale if self.fp16_enabled else 1.0,
+            initial_scale_power=self.config.fp16.initial_scale_power,
+        ) if self.fp16_enabled else make_loss_scale_state(static_scale=1.0)
+
+        if rng is None:
+            rng = jax.random.PRNGKey(self.config.seed)
+
+        self.state = {
+            "master": master,
+            "opt": None,
+            "acc": None,
+            "scale": scale_state,
+            "rng": rng,
+            "step": jnp.zeros((), jnp.int32),
+            "skipped": jnp.zeros((), jnp.int32),
+        }
+        self._init_opt_state()
+
+    def _init_opt_state(self):
+        # Build a throwaway transformation just for init (lr constant — state
+        # structure does not depend on lr).
+        opt = self._client_optimizer or self._opt_factory(lambda c: 0.0)
+        opt_state = jax.eval_shape(opt.init, self.state["master"])
+        self.opt_shardings = self.rules.opt_state_shardings(
+            opt_state, self.master_shardings, self.state["master"])
+        init_fn = jax.jit(opt.init, out_shardings=self.opt_shardings)
+        self.state["opt"] = init_fn(self.state["master"])
+        zeros = jax.jit(
+            lambda m: jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), m),
+            out_shardings=self.grad_shardings)
+        self.state["acc"] = zeros(self.state["master"])
+        self._state_shardings = {
+            "master": self.master_shardings,
+            "opt": self.opt_shardings,
+            "acc": self.grad_shardings,
+            "scale": jax.tree.map(lambda _: NamedSharding(self.mesh, P()), self.state["scale"]),
+            "rng": NamedSharding(self.mesh, P()),
+            "step": NamedSharding(self.mesh, P()),
+            "skipped": NamedSharding(self.mesh, P()),
+        }
+
+    # ------------------------------------------------------- config accessors
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self.config.steps_per_print
+
+    def gradient_clipping(self):
+        return self.config.gradient_clipping
+
+    def zero_optimization(self):
+        return self.zero_stage > 0
+
+    def get_global_grad_norm(self):
+        return getattr(self, "_last_grad_norm", None)
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            count = getattr(self.state["opt"], "count", None)
+            count = int(jax.device_get(count)) if count is not None else self.global_steps
+            return [float(jax.device_get(self.lr_scheduler.lr_at(jnp.asarray(count, jnp.float32))))]
+        return [self._base_lr if self._client_optimizer is None else float("nan")]
+
+    @property
+    def loss_scale(self):
+        return float(jax.device_get(self.state["scale"].cur_scale))
+
+    # ------------------------------------------------------------- model fns
+    def _apply_model(self, params, batch, rng):
+        if hasattr(self.module, "apply"):  # flax module
+            if isinstance(batch, dict):
+                inputs = batch.get("input_ids", batch.get("inputs"))
+                if inputs is None:
+                    raise ValueError("flax-module path expects batch['input_ids']")
+                return self.module.apply({"params": params}, inputs,
+                                         rngs={"dropout": rng})
+            return self.module.apply({"params": params}, batch, rngs={"dropout": rng})
+        return self.module(params, batch, rng)
+
+    def _loss_of(self, params, batch, rng):
+        out = self._apply_model(params, batch, rng)
+        if self.loss_fn is not None:
+            return self.loss_fn(out, batch)
+        if isinstance(out, jnp.ndarray) and out.ndim == 0:
+            return out
+        raise ValueError("model output is not a scalar loss; pass loss_fn")
+
+    def _micro_grads(self, master, scale, batch, rng):
+        params = _cast_tree(master, self.compute_dtype)
+        params = jax.lax.with_sharding_constraint(params, self.param_shardings)
+
+        def scaled_loss(p):
+            loss = self._loss_of(p, batch, rng)
+            return (loss.astype(jnp.float32) * scale), loss
+
+        (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
+        grads = _cast_tree(grads, jnp.float32)
+        grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
+        return loss.astype(jnp.float32), grads
+
+    def _apply_update(self, state, gas):
+        """Unscale+clip+update with overflow guard, all traced."""
+        scale = state["scale"].cur_scale
+        denom = scale * gas
+        if self.config.prescale_gradients:
+            denom = denom * self.config.gradient_predivide_factor
+        grads = jax.tree.map(lambda a: a / denom, state["acc"])
+        finite = grads_finite(grads) if self.fp16_enabled else jnp.asarray(True)
+        gnorm = _global_norm(grads)
+        clip = self.gradient_clipping()
+        if clip and clip > 0:
+            factor = clip / jnp.maximum(gnorm, clip)
+            grads = jax.tree.map(lambda g: g * factor, grads)
+
+        updates, new_opt = self.optimizer.update(grads, state["opt"], state["master"])
+        new_master = optax.apply_updates(state["master"], updates)
+
+        sel = lambda a, b: jax.tree.map(
+            lambda x, y: jnp.where(finite, x, y), a, b)
+        master = sel(new_master, state["master"])
+        opt = sel(new_opt, state["opt"])
+        master = jax.lax.with_sharding_constraint(master, self.master_shardings)
+
+        new_scale = update_scale(
+            state["scale"], finite,
+            dynamic=self.dynamic_loss_scale,
+            scale_window=self.config.fp16.loss_scale_window,
+            min_scale=self.config.fp16.min_loss_scale,
+            hysteresis=self.config.fp16.hysteresis)
+
+        zeros = jax.tree.map(lambda a: jnp.zeros_like(a), state["acc"])
+        return {
+            "master": master,
+            "opt": opt,
+            "acc": zeros,
+            "scale": new_scale,
+            "rng": state["rng"],
+            "step": state["step"] + 1,
+            "skipped": state["skipped"] + (~finite).astype(jnp.int32),
+        }, gnorm, finite
+
+    # ------------------------------------------------------------ train APIs
+    def _build_train_jit(self):
+        gas = self.gradient_accumulation_steps()
+
+        def train_step(state, batches):
+            def body(carry, batch):
+                acc, loss_sum, rng = carry
+                rng, sub = jax.random.split(rng)
+                loss, grads = self._micro_grads(
+                    state["master"], state["scale"].cur_scale, batch, sub)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
+                return (acc, loss_sum + loss, rng), None
+
+            (acc, loss_sum, rng), _ = jax.lax.scan(
+                body, (state["acc"], jnp.zeros((), jnp.float32), state["rng"]),
+                batches)
+            state = dict(state, acc=acc, rng=rng)
+            new_state, gnorm, finite = self._apply_update(state, float(gas))
+            return new_state, {"loss": loss_sum / gas, "grad_norm": gnorm,
+                               "finite": finite}
+
+        return jax.jit(train_step, donate_argnums=(0,),
+                       out_shardings=(self._state_shardings, None))
+
+    def _shard_batch(self, batch, stacked: bool = False):
+        axes = ("dp",)
+
+        def put(x):
+            x = jnp.asarray(x)
+            dim = 1 if stacked else 0
+            spec = [None] * x.ndim
+            if x.ndim > dim and x.shape[dim] % self.dp_world_size == 0:
+                spec[dim] = "dp"
+            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+
+        return jax.tree.map(put, batch)
+
+    def train_batch(self, data_iter=None):
+        """Pull GAS micro-batches and run one full optimizer step (reference
+        PipelineEngine.train_batch:302 generalized to the non-pipe engine)."""
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise ValueError("no data_iter and no training_data")
+            if not hasattr(self, "_train_iter"):
+                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._train_iter
+        gas = self.gradient_accumulation_steps()
+        micros = [next(data_iter) for _ in range(gas)]
+        batches = jax.tree.map(lambda *xs: np.stack(xs), *micros)
+        batches = self._shard_batch(batches, stacked=True)
+
+        if self._jit_train is None:
+            self._jit_train = self._build_train_jit()
+
+        self.tput_timer.start()
+        self.state, metrics = self._jit_train(self.state, batches)
+        self.tput_timer.stop(sync=metrics["loss"])
+        self.global_steps += 1
+        self.micro_steps += gas
+        self.global_samples += self.train_batch_size()
+        self._last_grad_norm = metrics["grad_norm"]
+        self._after_step(metrics)
+        return metrics["loss"]
+
+    # --- 3-call parity API -------------------------------------------------
+    def forward(self, batch):
+        """Run one micro forward(+grad) and buffer the accumulation."""
+        if self._jit_micro is None:
+            def micro(state, batch):
+                rng, sub = jax.random.split(state["rng"])
+                loss, grads = self._micro_grads(
+                    state["master"], state["scale"].cur_scale, batch, sub)
+                acc = jax.tree.map(jnp.add, state["acc"], grads)
+                return dict(state, acc=acc, rng=rng), loss
+            self._jit_micro = jax.jit(micro, donate_argnums=(0,),
+                                      out_shardings=(self._state_shardings, None))
+        batch = self._shard_batch(batch)
+        self.state, loss = self._jit_micro(self.state, batch)
+        self._pending_loss = loss
+        if self.flops_profiler:
+            self.flops_profiler.on_forward(batch)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, allreduce_gradients=True):
+        """Gradient was produced with forward (fused on TPU); this is the GAS
+        bookkeeping boundary (reference engine.backward:1665)."""
+        self.micro_steps += 1
+        self.global_samples += self.train_micro_batch_size_per_gpu() * self.dp_world_size
+        return loss if loss is not None else self._pending_loss
+
+    def is_gradient_accumulation_boundary(self):
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def step(self):
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self._jit_apply is None:
+            gas = float(self.gradient_accumulation_steps())
+            def apply_only(state):
+                new_state, gnorm, finite = self._apply_update(state, gas)
+                return new_state, {"grad_norm": gnorm, "finite": finite,
+                                   "loss": jnp.zeros((), jnp.float32)}
+            self._jit_apply = jax.jit(apply_only, donate_argnums=(0,),
+                                      out_shardings=(self._state_shardings, None))
+        self.state, metrics = self._jit_apply(self.state)
+        self.global_steps += 1
+        self._last_grad_norm = metrics["grad_norm"]
+        self._after_step(metrics)
+
+    def _after_step(self, metrics):
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps, metrics)
+        if self.monitor.enabled and jax.process_index() == 0:
+            evts = [("Train/Samples/train_loss", float(jax.device_get(metrics["loss"])),
+                     self.global_samples)]
+            self.monitor.write_events(evts)
+        if self.flops_profiler:
+            self.flops_profiler.on_step(self.global_steps)
+
+    def _report_progress(self, step, metrics):
+        loss = float(jax.device_get(metrics["loss"]))
+        lr = self.get_lr()
+        log_dist(f"step={step}, loss={loss:.4f}, lr={lr}, "
+                 f"loss_scale={self.loss_scale:g}, "
+                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.2f}",
+                 ranks=[0])
+
+    # ---------------------------------------------------------------- eval
+    def eval_batch(self, batch):
+        if not hasattr(self, "_jit_eval"):
+            def ev(master, batch, rng):
+                params = _cast_tree(master, self.compute_dtype)
+                return self._loss_of(params, batch, rng)
+            self._jit_eval = jax.jit(ev)
+        batch = self._shard_batch(batch)
+        return self._jit_eval(self.state["master"], batch, self.state["rng"])
+
+    def get_params(self, dtype=None):
+        """Current (compute-dtype) parameters as a pytree."""
+        return _cast_tree(self.state["master"], dtype or self.compute_dtype)
+
+    # ------------------------------------------------------------ dataloader
+    def deepspeed_io(self, dataset, batch_size=None, route="train",
+                     data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        bs = batch_size or (self.train_micro_batch_size_per_gpu() * self.dp_world_size)
+        return DeepSpeedDataLoader(dataset, batch_size=bs,
+                                   collate_fn=collate_fn or self.collate_fn,
+                                   drop_last=self.config.dataloader_drop_last)
+
+    # ----------------------------------------------------------- checkpoints
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        tag = tag or f"global_step{self.global_steps}"
+        meta = {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": int(jax.device_get(self.state["skipped"])),
+            "loss_scale": self.loss_scale,
+            "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
+            "zero_stage": self.zero_stage,
+            "dp_world_size": self.dp_world_size,
+            "client_state": client_state or {},
+        }
+        return ckpt_saving.save_checkpoint_dir(
+            save_dir, tag, master_params=self.state["master"],
+            opt_state=self.state["opt"], meta=meta)
+
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True,
+                        load_module_only=False):
+        res = ckpt_saving.load_checkpoint_dir(
+            load_dir, tag, master_template=self.state["master"],
+            opt_template=self.state["opt"],
+            master_shardings=self.master_shardings,
+            opt_shardings=self.opt_shardings)
+        if res is None:
+            log_dist(f"no checkpoint found in {load_dir}", ranks=[0])
+            return None, {}
+        meta = res["meta"]
+        self.state["master"] = res["master_params"]
+        if load_optimizer_states and not load_module_only:
+            self.state["opt"] = res["opt_state"]
+        if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self.global_steps = meta["global_steps"]
+        self.global_samples = meta["global_samples"]
+        self.micro_steps = meta["micro_steps"]
+        sc = self.state["scale"]
+        self.state["scale"] = sc._replace(
+            cur_scale=jnp.asarray(meta["loss_scale"], jnp.float32))
+        log_dist(f"loaded checkpoint tag={res['tag']} step={self.global_steps}",
+                 ranks=[0])
+        return os.path.join(load_dir, res["tag"]), meta.get("client_state", {})
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
+        os.makedirs(save_dir, exist_ok=True)
+        params16 = _cast_tree(self.state["master"], self.compute_dtype)
+        ckpt_saving.save_tree(os.path.join(save_dir, save_filename), params16)
+        return True

@@ -1,0 +1,76 @@
+"""Loss scaling (reference: deepspeed/runtime/fp16/loss_scaler.py —
+``LossScaler``:54 static, ``DynamicLossScaler``:77).
+
+Jit-native redesign: the scaler state is a small pytree living inside the
+engine state, and the overflow decision is a traced ``jnp.where`` — no host
+sync per step (the reference's ``_has_inf_or_nan`` does a device->host read;
+on TPU that would stall the pipeline)."""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray        # f32 scalar
+    cur_hysteresis: jnp.ndarray   # i32 scalar
+    last_overflow_step: jnp.ndarray
+    step: jnp.ndarray
+    overflows: jnp.ndarray        # total skipped steps
+
+
+def make_loss_scale_state(static_scale: float = 0.0,
+                          initial_scale_power: int = 16) -> LossScaleState:
+    init = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
+    return LossScaleState(
+        cur_scale=jnp.asarray(init, jnp.float32),
+        cur_hysteresis=jnp.asarray(0, jnp.int32),
+        last_overflow_step=jnp.asarray(-1, jnp.int32),
+        step=jnp.asarray(0, jnp.int32),
+        overflows=jnp.asarray(0, jnp.int32),
+    )
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree.leaves(grads)
+    fin = jnp.asarray(True)
+    for g in leaves:
+        fin = jnp.logical_and(fin, jnp.all(jnp.isfinite(g)))
+    return fin
+
+
+def update_scale(state: LossScaleState, finite: jnp.ndarray,
+                 dynamic: bool = True,
+                 scale_factor: float = 2.0,
+                 scale_window: int = 1000,
+                 min_scale: float = 1.0,
+                 hysteresis: int = 2) -> LossScaleState:
+    """Overflow => scale /= factor (with hysteresis); `scale_window` clean
+    steps => scale *= factor. Pure function of state, safe under jit."""
+    step = state.step + 1
+    if not dynamic:
+        return state._replace(step=step,
+                              overflows=state.overflows + (~finite).astype(jnp.int32))
+
+    hys = jnp.where(finite, state.cur_hysteresis,
+                    jnp.maximum(state.cur_hysteresis - 1, 0))
+    shrink = (~finite) & (state.cur_hysteresis <= 1)
+    new_scale = jnp.where(
+        shrink,
+        jnp.maximum(state.cur_scale / scale_factor, min_scale),
+        state.cur_scale)
+    # growth on a clean window
+    clean_window = finite & ((step - state.last_overflow_step) % scale_window == 0) \
+        & (step - state.last_overflow_step >= scale_window)
+    new_scale = jnp.where(clean_window, new_scale * scale_factor, new_scale)
+    hys = jnp.where(~finite & shrink, hysteresis, hys)
+    return LossScaleState(
+        cur_scale=new_scale,
+        cur_hysteresis=hys.astype(jnp.int32),
+        last_overflow_step=jnp.where(~finite, step, state.last_overflow_step).astype(jnp.int32),
+        step=step.astype(jnp.int32),
+        overflows=(state.overflows + (~finite).astype(jnp.int32)),
+    )

@@ -1,0 +1,146 @@
+"""Parameter sharding rules: how ZeRO + TP map onto the mesh.
+
+This module is the TPU-native core of the ZeRO subsystem (reference:
+``runtime/zero/stage_1_and_2.py:91`` and ``stage3.py:80``). The reference
+implements partitioning imperatively — flatten param groups, slice per rank,
+hook grad accumulation, all-gather updated shards. On TPU the same three
+stages are *declarative*: a PartitionSpec per tensor, enforced with
+``with_sharding_constraint`` / ``out_shardings``, and XLA emits the
+all-gathers and reduce-scatters (overlapped with compute by the latency-hiding
+scheduler — the analogue of the reference's ``overlap_comm`` side stream).
+
+Stage semantics (ZeRO paper / reference zero/config.py):
+  stage 0: params+grads+opt replicated; grad psum over dp.
+  stage 1: optimizer state (and fp32 master) sharded over dp.
+  stage 2: + grads reduce-scattered over dp (grad spec = sharded).
+  stage 3: + parameters sharded over dp; all-gathered per use.
+
+Tensor parallelism: Megatron-style column/row split keyed on parameter path
+(the reference only *consumes* an mpu for training and produces TP via
+module_inject for inference, replace_module.py:502; here TP is first-class).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Column-parallel (shard output dim) / row-parallel (shard input dim) name
+# patterns, matched against the parameter path.
+_COLUMN_PAT = re.compile(r"(qkv|up_proj|q_proj|k_proj|v_proj|lm_head|fc_in|wi|gate_proj)")
+_ROW_PAT = re.compile(r"(out_proj|down_proj|o_proj|fc_out|wo)")
+_EMBED_PAT = re.compile(r"(wte|embed|embedding)")
+
+
+def path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tp_spec(path: str, ndim: int) -> P:
+    """TP PartitionSpec on the *trailing* dims (leading scan/stack dims get
+    None). Biases of column-parallel layers shard their single dim."""
+    spec: list = [None] * ndim
+    is_kernel = path.endswith("kernel") or path.endswith("embedding")
+    is_bias = path.endswith("bias")
+    if _EMBED_PAT.search(path) and is_kernel:
+        spec[-2 if ndim >= 2 else -1] = "tp"   # vocab dim
+    elif _COLUMN_PAT.search(path):
+        if is_kernel and ndim >= 2:
+            spec[-1] = "tp"
+        elif is_bias:
+            spec[-1] = "tp"
+    elif _ROW_PAT.search(path):
+        if is_kernel and ndim >= 2:
+            spec[-2] = "tp"
+        # row-parallel bias is replicated (added after the psum)
+    return P(*spec)
+
+
+def _add_axis(spec: P, shape: Tuple[int, ...], axis_name: str, axis_size: int) -> P:
+    """Extend `spec` by sharding the first free, divisible dim over
+    `axis_name`; no-op if nothing fits (tensor stays replicated over it)."""
+    if axis_size <= 1:
+        return spec
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, d in enumerate(shape):
+        if parts[i] is None and d % axis_size == 0 and d >= axis_size:
+            parts[i] = axis_name
+            return P(*parts)
+    return P(*parts)
+
+
+class ShardingRules:
+    """Computes the sharding trees for params / grads / optimizer state given
+    a ZeRO stage and mesh."""
+
+    def __init__(self, mesh: Mesh, zero_stage: int = 0, use_tp: bool = True):
+        self.mesh = mesh
+        self.stage = zero_stage
+        self.dp = mesh.shape.get("dp", 1)
+        self.tp = mesh.shape.get("tp", 1) if use_tp else 1
+
+    def param_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        if self.stage >= 3:
+            spec = _add_axis(spec, shape, "dp", self.dp)
+        return spec
+
+    def master_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+        """fp32 master copy / optimizer moments: sharded from stage 1 on."""
+        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        if self.stage >= 1:
+            spec = _add_axis(spec, shape, "dp", self.dp)
+        return spec
+
+    def grad_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+        """Gradients: reduce-scattered from stage 2 on (constraining the grad
+        output to the sharded spec turns the dp psum into psum_scatter)."""
+        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        if self.stage >= 2:
+            spec = _add_axis(spec, shape, "dp", self.dp)
+        return spec
+
+    # -- tree-level helpers -------------------------------------------------
+    def _tree_specs(self, tree, fn):
+        def leaf(path, x):
+            return fn(path_str(path), tuple(x.shape))
+        return jax.tree_util.tree_map_with_path(leaf, tree)
+
+    def param_specs(self, params):
+        return self._tree_specs(params, self.param_spec)
+
+    def master_specs(self, params):
+        return self._tree_specs(params, self.master_spec)
+
+    def grad_specs(self, params):
+        return self._tree_specs(params, self.grad_spec)
+
+    def shardings(self, spec_tree):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), spec_tree,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def opt_state_shardings(self, opt_state, master_shardings, params_template):
+        """Optimizer state leaves that mirror a param keep its sharding;
+        scalars/others replicate. Matching is by shape."""
+        by_shape = {}
+        leaves, _ = jax.tree_util.tree_flatten_with_path(params_template)
+        m_leaves = jax.tree.leaves(master_shardings)
+        for (path, p), sh in zip(leaves, m_leaves):
+            by_shape.setdefault(tuple(p.shape), sh)
+        rep = NamedSharding(self.mesh, P())
+
+        def leaf(x):
+            return by_shape.get(tuple(getattr(x, "shape", ())), rep)
+
+        return jax.tree.map(leaf, opt_state)

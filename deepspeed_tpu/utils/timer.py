@@ -1,0 +1,125 @@
+"""Timers (reference: deepspeed/utils/timer.py — SynchronizedWallClockTimer:35,
+ThroughputTimer). CUDA-event timing becomes ``jax.block_until_ready`` around
+``perf_counter``; on TPU that is the only honest wall-clock."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from .logging import log_dist
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self._records: List[float] = []
+
+    def start(self):
+        assert not self.started, f"timer {self.name} already started"
+        self._start = time.perf_counter()
+        self.started = True
+
+    def stop(self, reset: bool = False, record: bool = False, sync=None):
+        assert self.started, f"timer {self.name} not started"
+        if sync is not None:
+            jax.block_until_ready(sync)
+        dt = time.perf_counter() - self._start
+        if reset:
+            self._elapsed = dt
+        else:
+            self._elapsed += dt
+        if record:
+            self._records.append(dt)
+        self.started = False
+
+    def reset(self):
+        self.started = False
+        self._elapsed = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        e = self._elapsed
+        if reset:
+            self.reset()
+        return e
+
+    def mean(self) -> float:
+        return sum(self._records) / len(self._records) if self._records else 0.0
+
+
+class SynchronizedWallClockTimer:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name) -> bool:
+        return name in self.timers
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown=None, ranks=None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        log_dist("time (ms) | " + " | ".join(parts), ranks=ranks or [0])
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            used = stats.get("bytes_in_use", 0) / 2**30
+            peak = stats.get("peak_bytes_in_use", 0) / 2**30
+            return f"mem used {used:.2f} GB, peak {peak:.2f} GB"
+        except Exception:
+            return "mem stats unavailable"
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs accounting across steps (skips warmup steps)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
+        self.initialized = False
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync=None, report_speed: bool = True):
+        if self._t0 is None:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.global_step_count += 1
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += time.perf_counter() - self._t0
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.avg_samples_per_sec():.2f}")
+        self._t0 = None
+
+    def avg_samples_per_sec(self) -> float:
+        steps = self.global_step_count - self.start_step
+        if steps <= 0 or self.total_elapsed_time == 0:
+            return 0.0
+        return steps * self.batch_size / self.total_elapsed_time

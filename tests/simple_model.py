@@ -1,0 +1,56 @@
+"""Tiny real-model fixtures (reference: tests/unit/simple_model.py:12-40 —
+SimpleModel + random_dataloader; real models, not mocks)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel(nn.Module):
+    hidden_dim: int = 16
+    nlayers: int = 2
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.nlayers):
+            x = nn.Dense(self.hidden_dim, name=f"linear_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.hidden_dim, name="head")(x)
+
+
+def mse_loss(outputs, batch):
+    return jnp.mean((outputs - batch["labels"]) ** 2)
+
+
+class RandomDataset:
+    """Indexable dataset of (x, y) dicts."""
+
+    def __init__(self, n=64, dim=16, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, dim)).astype(np.float32)
+        self.y = rng.normal(size=(n, dim)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"input_ids": self.x[i], "labels": self.y[i]}
+
+
+def make_engine(config, hidden_dim=16, n=64, seed=0, **kw):
+    import deepspeed_tpu as ds
+    model = SimpleModel(hidden_dim=hidden_dim)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((2, hidden_dim)))["params"]
+    engine, opt, loader, sched = ds.initialize(
+        model=model, model_parameters=params, config=config,
+        training_data=RandomDataset(n=n, dim=hidden_dim, seed=seed),
+        loss_fn=mse_loss, **kw)
+    return engine
+
+
+def random_batch(bs, dim=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.normal(size=(bs, dim)).astype(np.float32),
+            "labels": rng.normal(size=(bs, dim)).astype(np.float32)}

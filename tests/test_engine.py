@@ -1,0 +1,156 @@
+"""End-to-end engine tests (reference: tests/unit/test_fp16.py, test_zero.py
+train-loop patterns on SimpleModel)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from simple_model import SimpleModel, RandomDataset, make_engine, mse_loss, random_batch
+
+BASE_CONFIG = {
+    "train_batch_size": 16,
+    "gradient_accumulation_steps": 2,
+    "steps_per_print": 100,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+}
+
+
+def train_losses(config, steps=5, seed=0):
+    engine = make_engine(config, seed=seed)
+    losses = []
+    for _ in range(steps):
+        losses.append(float(jax.device_get(engine.train_batch())))
+    return losses, engine
+
+
+def test_train_loss_decreases():
+    losses, _ = train_losses(BASE_CONFIG, steps=10)
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_train_batch_counts():
+    _, engine = train_losses(BASE_CONFIG, steps=3)
+    assert engine.global_steps == 3
+    assert engine.global_samples == 48
+    assert engine.micro_steps == 6
+
+
+def test_forward_backward_step_api():
+    engine = make_engine(BASE_CONFIG)
+    gas = engine.gradient_accumulation_steps()
+    for i in range(2 * gas):
+        batch = random_batch(engine.train_micro_batch_size_per_gpu() *
+                             engine.dp_world_size, seed=i)
+        loss = engine(batch)
+        engine.backward(loss)
+        boundary = engine.is_gradient_accumulation_boundary()
+        assert boundary == ((i + 1) % gas == 0)
+        engine.step()
+    assert engine.global_steps == 2
+
+
+def test_bf16_training():
+    cfg = dict(BASE_CONFIG, bf16={"enabled": True})
+    losses, engine = train_losses(cfg, steps=10)
+    assert engine.compute_dtype == jnp.bfloat16
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_dynamic_loss_scale_runs():
+    cfg = dict(BASE_CONFIG, fp16={"enabled": True, "initial_scale_power": 8})
+    losses, engine = train_losses(cfg, steps=5)
+    assert engine.loss_scale > 0
+    assert np.isfinite(losses[-1])
+
+
+def test_fp16_overflow_skips_step():
+    cfg = dict(BASE_CONFIG, fp16={"enabled": True, "initial_scale_power": 4})
+    engine = make_engine(cfg)
+    before = jax.device_get(jax.tree.leaves(engine.state["master"])[0]).copy()
+    # poison one micro-batch to produce inf grads
+    bad = {"input_ids": np.full((16, 16), 1e30, np.float32),
+           "labels": np.zeros((16, 16), np.float32)}
+    it = iter([bad, bad])
+    engine.train_batch(it)
+    after = jax.device_get(jax.tree.leaves(engine.state["master"])[0])
+    np.testing.assert_array_equal(before, after)  # update skipped
+    assert int(jax.device_get(engine.state["skipped"])) == 1
+    # scale halved
+    assert engine.loss_scale == 2.0 ** 4 / 2
+
+
+def test_gradient_clipping():
+    cfg = dict(BASE_CONFIG, gradient_clipping=1e-6)
+    losses, engine = train_losses(cfg, steps=3)
+    # with absurdly small clip, updates are tiny: loss barely moves
+    assert abs(losses[-1] - losses[0]) < 0.1 * losses[0]
+
+
+def test_scheduler_integration():
+    cfg = dict(BASE_CONFIG,
+               scheduler={"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0.0,
+                                     "warmup_max_lr": 1e-2,
+                                     "warmup_num_steps": 100,
+                                     "warmup_type": "linear"}})
+    engine = make_engine(cfg)
+    engine.train_batch()
+    lr1 = engine.get_lr()[0]
+    for _ in range(5):
+        engine.train_batch()
+    lr2 = engine.get_lr()[0]
+    assert lr2 > lr1
+
+
+def test_client_optimizer():
+    import optax
+    engine = make_engine({"train_batch_size": 16}, optimizer=optax.sgd(1e-2))
+    loss0 = float(jax.device_get(engine.train_batch()))
+    loss1 = float(jax.device_get(engine.train_batch()))
+    assert np.isfinite(loss1)
+
+
+def test_eval_batch():
+    engine = make_engine(BASE_CONFIG)
+    loss = engine.eval_batch(random_batch(16))
+    assert np.isfinite(float(jax.device_get(loss)))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    losses, engine = train_losses(BASE_CONFIG, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="t3")
+    ref = jax.device_get(jax.tree.leaves(engine.state["master"])[0]).copy()
+
+    engine2 = make_engine(BASE_CONFIG)
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert path.endswith("t3")
+    assert engine2.global_steps == 3
+    got = jax.device_get(jax.tree.leaves(engine2.state["master"])[0])
+    np.testing.assert_array_equal(ref, got)
+    # training continues
+    engine2.train_batch()
+    assert engine2.global_steps == 4
+
+
+def test_checkpoint_latest_tag(tmp_path):
+    _, engine = train_losses(BASE_CONFIG, steps=1)
+    engine.save_checkpoint(str(tmp_path))
+    engine2 = make_engine(BASE_CONFIG)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None and "global_step1" in path
+
+
+def test_save_16bit_model(tmp_path):
+    cfg = dict(BASE_CONFIG, bf16={"enabled": True})
+    _, engine = train_losses(cfg, steps=1)
+    assert engine.save_16bit_model(str(tmp_path))
+    import numpy as _np
+    with _np.load(tmp_path / "pytorch_model.npz") as f:
+        assert len(f.files) > 0
+
+
+def test_missing_params_rejected():
+    import deepspeed_tpu as ds
+    with pytest.raises(ValueError):
+        ds.initialize(model=SimpleModel(), config={"train_batch_size": 8})

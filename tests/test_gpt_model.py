@@ -1,0 +1,128 @@
+"""Flagship GPT family: shapes, training, TP sharding rules."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt import (GPT, GPTConfig, count_params,
+                                      gpt2_125m, lm_loss_fn)
+from deepspeed_tpu.runtime.sharding import ShardingRules, tp_spec
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=256, max_seq_len=64, num_layers=2, num_heads=2,
+                d_model=32, d_ff=64, dtype=jnp.float32, param_dtype=jnp.float32)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def make_batch(bs=8, seq=16, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(bs, seq)).astype(np.int32)
+    return {"input_ids": ids}
+
+
+def test_forward_shapes():
+    cfg = tiny_cfg()
+    model = GPT(cfg)
+    batch = make_batch()
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"])["params"]
+    logits = model.apply({"params": params}, batch["input_ids"])
+    assert logits.shape == (8, 16, 256)
+
+
+def test_scan_layers_stacked_params():
+    cfg = tiny_cfg(scan_layers=True)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        make_batch()["input_ids"])["params"]
+    qkv = params["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv.shape == (2, 32, 96)  # [layers, in, 3*d_model]
+
+
+def test_rotary_neox_variant():
+    cfg = tiny_cfg(rotary=True, parallel_residual=True, tie_embeddings=False)
+    model = GPT(cfg)
+    batch = make_batch()
+    params = model.init(jax.random.PRNGKey(0), batch["input_ids"])["params"]
+    logits = model.apply({"params": params}, batch["input_ids"])
+    assert logits.shape == (8, 16, 256)
+    assert "lm_head" in params and "wpe" not in params
+
+
+def test_causality():
+    """Changing a future token must not affect earlier logits."""
+    cfg = tiny_cfg(scan_layers=False)
+    model = GPT(cfg)
+    b = make_batch(bs=1)
+    params = model.init(jax.random.PRNGKey(0), b["input_ids"])["params"]
+    l1 = model.apply({"params": params}, b["input_ids"])
+    mod = b["input_ids"].copy()
+    mod[0, -1] = (mod[0, -1] + 1) % 256
+    l2 = model.apply({"params": params}, mod)
+    np.testing.assert_allclose(np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(l1[0, -1]), np.asarray(l2[0, -1]))
+
+
+def test_gpt_trains_with_engine():
+    cfg = tiny_cfg()
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        make_batch()["input_ids"])["params"]
+    engine, _, _, _ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=lm_loss_fn,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    losses = []
+    for i in range(8):
+        losses.append(float(jax.device_get(engine.train_batch(
+            iter([make_batch(seed=0)])))))
+    assert losses[-1] < losses[0]
+
+
+def test_tp_sharding_rules():
+    assert tp_spec("blocks/attn/qkv/kernel", 3) == P(None, None, "tp")
+    assert tp_spec("blocks/attn/out_proj/kernel", 3) == P(None, "tp", None)
+    assert tp_spec("blocks/mlp/up_proj/kernel", 3) == P(None, None, "tp")
+    assert tp_spec("blocks/mlp/down_proj/kernel", 3) == P(None, "tp", None)
+    assert tp_spec("wte/embedding", 2) == P("tp", None)
+    assert tp_spec("blocks/ln_1/scale", 2) == P(None, None)
+    assert tp_spec("blocks/attn/qkv/bias", 2) == P(None, "tp")
+    assert tp_spec("blocks/attn/out_proj/bias", 2) == P(None, None)
+
+
+def test_gpt_tp2_matches_tp1():
+    """Same model trained under tp=1 vs tp=2 must match numerically."""
+    cfg = tiny_cfg()
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        make_batch()["input_ids"])["params"]
+
+    def train(mesh):
+        engine, _, _, _ = ds.initialize(
+            model=model, model_parameters=params, loss_fn=lm_loss_fn,
+            config={"train_batch_size": 8,
+                    "mesh": mesh,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+        return [float(jax.device_get(engine.train_batch(iter([make_batch(seed=i)]))))
+                for i in range(3)]
+
+    l_tp1 = train({"tp": 1})
+    l_tp2 = train({"tp": 2})
+    np.testing.assert_allclose(l_tp1, l_tp2, rtol=1e-4)
+
+
+def test_count_params_125m():
+    cfg = gpt2_125m()
+    # analytic: ~124-163M depending on padded vocab; just sanity band
+    model = GPT(cfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32)))
+    n = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+    assert 1.2e8 < n < 1.8e8

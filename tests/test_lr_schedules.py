@@ -1,0 +1,80 @@
+"""LR schedule math (reference: tests/unit/test_lr_schedulers.py)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle,
+                                                WarmupDecayLR, WarmupLR,
+                                                build_lr_scheduler)
+from deepspeed_tpu.runtime.config import SchedulerConfig
+
+
+def test_warmup_linear():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                 warmup_type="linear")
+    assert float(s.lr_at(0)) == 0.0
+    np.testing.assert_allclose(float(s.lr_at(5)), 0.5)
+    assert float(s.lr_at(10)) == 1.0
+    assert float(s.lr_at(100)) == 1.0  # constant after warmup
+
+
+def test_warmup_log():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100,
+                 warmup_type="log")
+    assert float(s.lr_at(1)) == 0.0
+    np.testing.assert_allclose(float(s.lr_at(10)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(s.lr_at(100)), 1.0, rtol=1e-5)
+
+
+def test_warmup_decay():
+    s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.0,
+                      warmup_max_lr=1.0, warmup_num_steps=10,
+                      warmup_type="linear")
+    np.testing.assert_allclose(float(s.lr_at(10)), 1.0)
+    np.testing.assert_allclose(float(s.lr_at(55)), 0.5)
+    np.testing.assert_allclose(float(s.lr_at(100)), 0.0, atol=1e-7)
+
+
+def test_lr_range_test():
+    s = LRRangeTest(lr_range_test_min_lr=0.1, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    np.testing.assert_allclose(float(s.lr_at(0)), 0.1)
+    np.testing.assert_allclose(float(s.lr_at(9)), 0.1)
+    np.testing.assert_allclose(float(s.lr_at(10)), 0.2)
+    np.testing.assert_allclose(float(s.lr_at(25)), 0.3)
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                 cycle_first_step_size=10, decay_lr_rate=0.1,
+                 decay_step_size=10)
+    np.testing.assert_allclose(float(s.lr_at(0)), 0.1)
+    np.testing.assert_allclose(float(s.lr_at(10)), 1.0)
+    np.testing.assert_allclose(float(s.lr_at(20)), 0.1, rtol=1e-5)
+    # decay phase below min lr
+    assert float(s.lr_at(40)) < 0.1
+
+
+def test_one_cycle_momentum():
+    s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0, cycle_first_step_size=10)
+    np.testing.assert_allclose(float(s.mom_at(0)), 0.9)
+    np.testing.assert_allclose(float(s.mom_at(10)), 0.8)
+
+
+def test_stepper_api():
+    s = WarmupLR(warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear")
+    for _ in range(5):
+        s.step()
+    assert s.get_last_lr() == [0.5]
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=1.0, warmup_num_steps=10, warmup_type="linear")
+    s2.load_state_dict(sd)
+    assert s2.get_last_lr() == [0.5]
+
+
+def test_registry():
+    s = build_lr_scheduler(SchedulerConfig(type="WarmupLR",
+                                           params={"warmup_num_steps": 5}))
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        build_lr_scheduler(SchedulerConfig(type="Nope"))

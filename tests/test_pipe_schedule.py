@@ -1,0 +1,100 @@
+"""Pipeline schedule math, no devices (reference: tests/unit/test_pipe_schedule.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as sched
+
+
+def collect(s):
+    return [cmds for cmds in s]
+
+
+def count_type(steps, t):
+    return sum(1 for cmds in steps for c in cmds if type(c) is t)
+
+
+@pytest.mark.parametrize("micro,stages", [(4, 2), (8, 4), (2, 2), (6, 3)])
+def test_train_schedule_counts(micro, stages):
+    for stage in range(stages):
+        s = sched.TrainSchedule(micro_batches=micro, stages=stages, stage_id=stage)
+        steps = collect(s)
+        assert len(steps) == 2 * (micro + stages - 1)
+        assert count_type(steps, sched.ForwardPass) == micro
+        assert count_type(steps, sched.BackwardPass) == micro
+        assert count_type(steps, sched.OptimizerStep) == 1
+        assert count_type(steps, sched.ReduceGrads) == 1
+        # boundary sends/recvs
+        if stage > 0:
+            assert count_type(steps, sched.RecvActivation) == micro
+            assert count_type(steps, sched.SendGrad) == micro
+        else:
+            assert count_type(steps, sched.RecvActivation) == 0
+            assert count_type(steps, sched.SendGrad) == 0
+
+
+def test_forward_before_backward_per_micro():
+    s = sched.TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    fwd_tick = {}
+    bwd_tick = {}
+    for tick, cmds in enumerate(s):
+        for c in cmds:
+            if type(c) is sched.ForwardPass:
+                fwd_tick[tick] = c.buffer_id
+            if type(c) is sched.BackwardPass:
+                bwd_tick[tick] = c.buffer_id
+    assert min(fwd_tick) < min(bwd_tick)
+    assert len(fwd_tick) == len(bwd_tick) == 4
+
+
+def test_last_stage_1f1b_interleave():
+    """Last stage runs B immediately after each F in steady state."""
+    S, M = 4, 8
+    s = sched.TrainSchedule(micro_batches=M, stages=S, stage_id=S - 1)
+    seq = []
+    for cmds in s:
+        for c in cmds:
+            if type(c) is sched.ForwardPass:
+                seq.append("F")
+            elif type(c) is sched.BackwardPass:
+                seq.append("B")
+    assert seq == ["F", "B"] * M
+
+
+def test_cross_stage_consistency():
+    """Stage s sends micro m forward before stage s+1 runs it; backward in
+    reverse order."""
+    S, M = 3, 4
+    schedules = [sched.TrainSchedule(M, S, s) for s in range(S)]
+    fwd_time = {}
+    bwd_time = {}
+    iters = [iter(s) for s in schedules]
+    for tick in range(2 * (M + S - 1)):
+        for s in range(S):
+            for c in next(iters[s]):
+                if type(c) is sched.ForwardPass:
+                    # recover micro id from order of appearance per stage
+                    m = sum(1 for (ss, _) in fwd_time if ss == s)
+                    fwd_time[(s, m)] = tick
+                if type(c) is sched.BackwardPass:
+                    m = sum(1 for (ss, _) in bwd_time if ss == s)
+                    bwd_time[(s, m)] = tick
+    for m in range(M):
+        for s in range(S - 1):
+            assert fwd_time[(s, m)] < fwd_time[(s + 1, m)]
+            assert bwd_time[(s + 1, m)] < bwd_time[(s, m)]
+        assert fwd_time[(S - 1, m)] < bwd_time[(S - 1, m)]
+
+
+def test_inference_schedule():
+    s = sched.InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+    steps = collect(s)
+    assert len(steps) == 4 + 2 - 1
+    assert count_type(steps, sched.ForwardPass) == 4
+    assert count_type(steps, sched.BackwardPass) == 0
+
+
+def test_num_pipe_buffers_bound():
+    s = sched.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    assert s.num_pipe_buffers == min(4 - 0 + 1, 8)
+    s = sched.TrainSchedule(micro_batches=1, stages=4, stage_id=0)
+    assert s.num_pipe_buffers == 2

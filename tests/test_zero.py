@@ -1,0 +1,100 @@
+"""ZeRO stages 1/2/3 (reference: tests/unit/test_zero.py — correctness across
+stages + fp32 reconstruction). On TPU the stages are sharding rules, so the
+key invariants are (a) numerics identical to stage 0, (b) state is actually
+partitioned over dp, (c) checkpoints reconstruct full fp32 weights."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from simple_model import make_engine
+
+CFG = {
+    "train_batch_size": 16,
+    "gradient_accumulation_steps": 2,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+}
+
+
+def _zero_cfg(stage, hidden=16):
+    return dict(CFG, zero_optimization={"stage": stage})
+
+
+def _losses(engine, steps=4):
+    return [float(jax.device_get(engine.train_batch())) for _ in range(steps)]
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_matches_stage0(stage):
+    base = make_engine(_zero_cfg(0))
+    zero = make_engine(_zero_cfg(stage))
+    l0 = _losses(base)
+    lz = _losses(zero)
+    np.testing.assert_allclose(l0, lz, rtol=2e-5)
+    # final weights identical
+    w0 = jax.device_get(jax.tree.leaves(base.state["master"])[0])
+    wz = jax.device_get(jax.tree.leaves(zero.state["master"])[0])
+    np.testing.assert_allclose(w0, wz, rtol=2e-5, atol=1e-6)
+
+
+def _is_dp_sharded(arr):
+    spec = arr.sharding.spec
+    return any(ax == "dp" or (isinstance(ax, tuple) and "dp" in ax)
+               for ax in spec if ax is not None)
+
+
+def test_stage1_shards_optimizer_state():
+    engine = make_engine(_zero_cfg(1))
+    # master fp32 sharded over dp (hidden=16 divisible by dp=8)
+    assert any(_is_dp_sharded(l) for l in jax.tree.leaves(engine.state["master"]))
+    assert any(_is_dp_sharded(l) for l in jax.tree.leaves(engine.state["opt"])
+               if hasattr(l, "sharding") and l.ndim > 0)
+    # compute params remain replicated at stage 1 (param spec has no dp)
+    specs = jax.tree.leaves(engine.rules.param_specs(engine.state["master"]),
+                            is_leaf=lambda x: isinstance(x, P))
+    assert all(all(ax is None for ax in s) for s in specs)
+
+
+def test_stage3_shards_params():
+    engine = make_engine(_zero_cfg(3))
+    specs = jax.tree.leaves(engine.rules.param_specs(engine.state["master"]),
+                            is_leaf=lambda x: isinstance(x, P))
+    assert any(any(ax == "dp" for ax in s if ax is not None) for s in specs)
+
+
+def test_zero_checkpoint_fp32_reconstruction(tmp_path):
+    from deepspeed_tpu.checkpoint.saving import consolidated_fp32_state_dict
+    engine = make_engine(_zero_cfg(3))
+    _losses(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path), tag="z3")
+    sd = consolidated_fp32_state_dict(engine.state["master"])
+    assert all(v.dtype == np.float32 for v in sd.values())
+    # reconstructed fulls match the sharded masters
+    ref = jax.device_get(jax.tree.leaves(engine.state["master"])[0])
+    key = [k for k in sd if k.endswith("kernel")][0]
+    assert sd[key].shape[-1] == 16
+
+
+def test_zero_elastic_reshard(tmp_path):
+    """Save under stage 3, load under stage 1 (different shardings) — the
+    npz checkpoint is shard-layout free, so this is the dp-resize elastic
+    path (reference elastic checkpointing)."""
+    e3 = make_engine(_zero_cfg(3))
+    _losses(e3, steps=2)
+    e3.save_checkpoint(str(tmp_path), tag="x")
+    ref = jax.device_get(jax.tree.leaves(e3.state["master"])[0])
+
+    e1 = make_engine(_zero_cfg(1))
+    e1.load_checkpoint(str(tmp_path), tag="x")
+    got = jax.device_get(jax.tree.leaves(e1.state["master"])[0])
+    np.testing.assert_array_equal(ref, got)
+    e1.train_batch()
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_with_bf16(stage):
+    cfg = dict(_zero_cfg(stage), bf16={"enabled": True})
+    engine = make_engine(cfg)
+    losses = _losses(engine, steps=6)
+    assert losses[-1] < losses[0]
