@@ -51,6 +51,15 @@ class GPTConfig:
     attention_impl: str = "xla"      # xla | pallas | sparse
     sparse_attention: Any = None     # SparsityConfig when attention_impl=sparse
     layer_norm_eps: float = 1e-5
+    # --- MoE (reference: deepspeed/moe/; MoE-NLG model family) ------------
+    moe: bool = False
+    num_experts: int = 1
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_eval_capacity_factor: float = 2.0
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
+    moe_use_residual: bool = False   # PR-MoE residual experts
 
     @property
     def head_dim(self) -> int:
@@ -77,6 +86,13 @@ def gpt_neox_20b(**kw):
 
 def gpt3_175b(**kw):
     return GPTConfig(num_layers=96, num_heads=96, d_model=12288, d_ff=49152, **kw)
+
+
+def gpt_moe_1_3b(num_experts=128, **kw):
+    """1.3B + MoE-128: matches 6.7B dense quality at ~5x lower compute
+    (reference docs/_posts/2021-12-09-deepspeed-moe-nlg.md:123-133)."""
+    return GPTConfig(num_layers=24, num_heads=16, d_model=2048, d_ff=8192,
+                     moe=True, num_experts=num_experts, **kw)
 
 
 # --------------------------------------------------------------------------
@@ -156,11 +172,28 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
-    """One transformer block. Returns ``(x, None)`` so it can be the body of
+    """One transformer block. Returns ``(x, l_aux)`` so it can be the body of
     ``nn.scan`` directly (carry, per-step-output) — the scan-over-layers
     structure is what makes ZeRO-3 gather/release and per-layer remat
-    idiomatic on TPU."""
+    idiomatic on TPU. ``l_aux`` is the MoE load-balancing loss (0 for dense
+    blocks), summed over layers by GPT."""
     cfg: GPTConfig
+
+    def _ffn(self, cfg, h, deterministic):
+        if cfg.moe:
+            from ..moe.layer import MoE
+            out, l_aux, _counts = MoE(
+                hidden_size=cfg.d_model,
+                expert=MLP(cfg),
+                num_experts=cfg.num_experts,
+                k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                min_capacity=cfg.moe_min_capacity,
+                use_residual=cfg.moe_use_residual,
+                name="moe")(h, deterministic=deterministic)
+            return out, l_aux
+        return MLP(cfg, name="mlp")(h, deterministic), jnp.zeros((), jnp.float32)
 
     @nn.compact
     def __call__(self, x, positions, deterministic=True):
@@ -170,15 +203,15 @@ class Block(nn.Module):
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_2")
         attn = SelfAttention(cfg, name="attn")
-        mlp = MLP(cfg, name="mlp")
         if cfg.parallel_residual:
-            # NeoX: x + attn(ln1(x)) + mlp(ln2(x))
-            out = x + attn(ln1(x), positions, deterministic) \
-                    + mlp(ln2(x), deterministic)
+            # NeoX: x + attn(ln1(x)) + ffn(ln2(x))
+            ffn_out, l_aux = self._ffn(cfg, ln2(x), deterministic)
+            out = x + attn(ln1(x), positions, deterministic) + ffn_out
         else:
             h = x + attn(ln1(x), positions, deterministic)
-            out = h + mlp(ln2(h), deterministic)
-        return out, None
+            ffn_out, l_aux = self._ffn(cfg, ln2(h), deterministic)
+            out = h + ffn_out
+        return out, l_aux
 
 
 class GPT(nn.Module):
@@ -209,15 +242,18 @@ class GPT(nn.Module):
             ScannedBlock = nn.scan(
                 block,
                 variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
+                split_rngs={"params": True, "dropout": True, "gating": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = ScannedBlock(cfg, name="blocks")(x, positions, deterministic)
+            x, aux = ScannedBlock(cfg, name="blocks")(x, positions, deterministic)
+            moe_aux = jnp.sum(aux) if cfg.moe else jnp.zeros((), jnp.float32)
         else:
+            moe_aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
-                x, _ = block(cfg, name=f"block_{i}")(x, positions, deterministic)
+                x, aux = block(cfg, name=f"block_{i}")(x, positions, deterministic)
+                moe_aux = moe_aux + aux
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_f")(x)
@@ -226,12 +262,20 @@ class GPT(nn.Module):
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=cfg.param_dtype, name="lm_head")(x)
+        if cfg.moe:
+            return logits, cfg.moe_aux_loss_coef * moe_aux
         return logits
 
 
 def lm_loss_fn(logits, batch):
     """Next-token cross entropy. batch: {input_ids, labels?} — labels default
-    to shifted input_ids."""
+    to shifted input_ids. When the model returns (logits, moe_aux_loss) the
+    aux load-balancing loss is added (reference: l_aux returned from
+    MoE.forward, moe/layer.py:106, added to the training loss by the user
+    script in the MoE tutorials)."""
+    aux = None
+    if isinstance(logits, tuple):
+        logits, aux = logits
     labels = batch.get("labels")
     if labels is None:
         labels = batch["input_ids"][:, 1:]
@@ -242,8 +286,12 @@ def lm_loss_fn(logits, batch):
     mask = batch.get("loss_mask")
     if mask is not None:
         mask = mask[:, :nll.shape[1]]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
-    return jnp.mean(nll)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    else:
+        loss = jnp.mean(nll)
+    if aux is not None:
+        loss = loss + aux
+    return loss
 
 
 def count_params(params) -> int:

@@ -1,0 +1,7 @@
+"""Mixture-of-Experts subsystem (reference: ``deepspeed/moe/``)."""
+
+from .experts import Experts
+from .layer import MoE
+from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating
+from .utils import (count_moe_params, is_moe_param, is_moe_param_path,
+                    moe_param_mask, split_params_into_shared_and_expert)

@@ -1,0 +1,56 @@
+"""Expert bank: one module template, num_experts parameter copies.
+
+Reference analogue: ``deepspeed/moe/experts.py:9-34`` — deep-copies the
+expert module ``num_local_experts`` times and stamps ``allreduce=False`` /
+``group_name`` on every expert parameter so the engine reduces them over the
+expert-data-parallel group instead of the dp group (engine.py:2171-2186).
+
+TPU-native: the copies are one ``nn.vmap`` lift — params get a stacked
+leading expert dim [E, ...] whose path contains ``experts``; the sharding
+rules (runtime/sharding.py) shard that dim over the ``ep`` mesh axis, and
+GSPMD reduces expert grads only over the axes they are replicated on
+(the expert-data-parallel semantics, for free).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _ApplyExpert(nn.Module):
+    inner: nn.Module
+
+    @nn.compact
+    def __call__(self, x):
+        out = self.inner(x)
+        if isinstance(out, tuple):
+            out = out[0]
+        return out
+
+
+class Experts(nn.Module):
+    """Applies ``num_experts`` independent copies of ``expert`` to the
+    leading dim of an [E, C, M] tensor."""
+    expert: nn.Module
+    num_experts: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if x.shape[0] != self.num_experts:
+            raise ValueError(
+                f"expected leading expert dim {self.num_experts}, "
+                f"got shape {x.shape}")
+        VmappedExpert = nn.vmap(
+            _ApplyExpert,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=0, out_axes=0,
+            metadata_params={nn.PARTITION_NAME: "experts"},
+        )
+        # clone the template so flax does not "adopt" the shared instance
+        # into the caller's scope — the stacked params must live under
+        # .../experts/ (the path the sharding rules key on)
+        return VmappedExpert(inner=self.expert.clone(), name="experts")(x)

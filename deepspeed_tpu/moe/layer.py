@@ -1,0 +1,85 @@
+"""User-facing MoE layer.
+
+Reference analogue: ``deepspeed/moe/layer.py:18-131`` — wraps an expert
+module with a TopKGate + MOELayer, optionally as a Residual MoE
+(arXiv:2201.05596) with a learned 2-way coefficient mix. The reference's
+lazy expert-parallel process-group creation (``_create_process_groups``,
+layer.py:88-104) is unnecessary here: expert parallelism is the ``ep`` mesh
+axis, fixed at mesh construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .experts import Experts
+from .sharded_moe import MOELayer, TopKGate
+
+
+class MoE(nn.Module):
+    """Mixture-of-Experts layer. ``__call__(hidden [.., M])`` returns
+    ``(output, l_aux, exp_counts)`` like the reference (layer.py:106-131)."""
+    hidden_size: int
+    expert: nn.Module
+    num_experts: int = 1
+    ep_size: int = 1                 # kept for API parity; mesh governs EP
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    use_residual: bool = False
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states: jnp.ndarray, used_token=None,
+                 deterministic: bool = True):
+        assert self.noisy_gate_policy in (None, "None", "Jitter", "RSample"), \
+            f"Unsupported noisy_gate_policy: {self.noisy_gate_policy}"
+        gate = TopKGate(
+            model_dim=self.hidden_size,
+            num_experts=self.num_experts,
+            k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=None if self.noisy_gate_policy == "None"
+            else self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens,
+            use_rts=self.use_rts,
+            name="gate")
+        moe = MOELayer(
+            gate=gate,
+            experts=Experts(expert=self.expert,
+                            num_experts=self.num_experts),
+            name="deepspeed_moe")
+        output, l_aux, exp_counts = moe(hidden_states, used_token,
+                                        deterministic)
+        if self.use_residual:
+            # Residual MoE: learned softmax mix of expert path and a dense
+            # MLP path (reference layer.py:117-130). Clone the template so
+            # the dense path gets its own (unstacked) params.
+            mlp_out = _ApplyDense(inner=self.expert.clone(),
+                                  name="mlp")(hidden_states)
+            coef = nn.Dense(2, dtype=hidden_states.dtype,
+                            name="coefficient")(hidden_states)
+            coef = jax.nn.softmax(coef, axis=-1)
+            output = output * coef[..., 0:1] + mlp_out * coef[..., 1:]
+        return output, l_aux, exp_counts
+
+
+class _ApplyDense(nn.Module):
+    inner: nn.Module
+
+    @nn.compact
+    def __call__(self, x):
+        out = self.inner(x)
+        if isinstance(out, tuple):
+            out = out[0]
+        return out

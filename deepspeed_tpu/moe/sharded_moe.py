@@ -1,0 +1,257 @@
+"""Sharded Mixture-of-Experts: gating + all-to-all dispatch, TPU-native.
+
+Reference analogue: ``deepspeed/moe/sharded_moe.py`` — ``top1gating`` (:178),
+``top2gating`` (:279), ``TopKGate`` (:352), ``MOELayer`` (:440) with its
+einsum dispatch -> all-to-all -> local experts -> all-to-all -> einsum
+combine pipeline (:488-561).
+
+TPU-native redesign:
+
+  * The reference's ``_AllToAll`` autograd wrapper over
+    ``dist.all_to_all_single`` disappears: the dispatched ``[E, C, M]``
+    tensor is simply sharding-constrained to the ``ep`` mesh axis, and XLA
+    emits the all-to-all (forward AND backward) when the layout changes from
+    token-sharded to expert-sharded. Differentiation is automatic.
+  * Capacity is a static Python int (shapes are static under jit); the
+    reference's dynamic no-drop path (``drop_tokens=False`` -> allreduce MAX
+    of counts, sharded_moe.py:215-218) becomes capacity = num_tokens, which
+    drops nothing by construction.
+  * Randomness (RSample noisy gating, Random Token Selection) uses explicit
+    JAX PRNG keys instead of cached torch distribution samplers
+    (sharded_moe.py:32-81).
+
+einsum dimension legend (GShard, arXiv:2006.16668): (s)equence/tokens,
+(e)xpert, (m)odel dim, (c)apacity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..parallel import mesh as mesh_lib
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """Static per-expert capacity (reference ``_capacity``,
+    sharded_moe.py:158-166)."""
+    cap = math.ceil(num_tokens / num_experts) * capacity_factor
+    cap = int(math.ceil(cap))
+    if cap < min_capacity:
+        cap = int(min_capacity)
+    return min(cap, num_tokens)
+
+
+def _keep_top_capacity(mask: jnp.ndarray, priority: jnp.ndarray,
+                       capacity: int) -> jnp.ndarray:
+    """Keep at most ``capacity`` tokens per expert, choosing the tokens with
+    the highest ``priority`` (reference ``_top_idx`` + scatter,
+    sharded_moe.py:168-246). mask/priority: [S, E] -> pruned mask [S, E]."""
+    s, e = mask.shape
+    # top-k over the token dim for every expert
+    _, top_idx = jax.lax.top_k(priority.T, capacity)          # [E, C]
+    keep = jnp.zeros((e, s), dtype=mask.dtype)
+    keep = keep.at[jnp.arange(e)[:, None], top_idx].set(1)
+    return mask * keep.T
+
+
+def top1gating(logits: jnp.ndarray,
+               capacity_factor: float,
+               min_capacity: int,
+               rng: Optional[jax.Array] = None,
+               used_token: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True,
+               use_rts: bool = True
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 gating (Switch-style). logits: [S, E] fp32.
+
+    Returns (l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool,
+    exp_counts [E]). Mirrors reference top1gating (sharded_moe.py:178-276).
+    """
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=1)
+
+    capacity = _capacity(s, e, capacity_factor, min_capacity)
+    if not drop_tokens:
+        capacity = s  # statically large enough to never drop
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        rng, sub = jax.random.split(rng)
+        noisy = logits + jax.random.gumbel(sub, logits.shape, logits.dtype)
+        indices1 = jnp.argmax(noisy, axis=1)
+    else:
+        indices1 = jnp.argmax(gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1, e, dtype=jnp.int32)
+
+    if used_token is not None:
+        mask1 = mask1 * used_token.astype(jnp.int32)[:, None]
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # load-balancing loss (GShard eq.; reference :220-223)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * e
+
+    # Random Token Selection: random priority inside each over-capacity
+    # expert instead of sequence order (reference :225-246)
+    if use_rts and rng is not None:
+        rng, sub = jax.random.split(rng)
+        priority = mask1.astype(jnp.float32) * jax.random.uniform(
+            sub, mask1.shape)
+    else:
+        priority = mask1.astype(jnp.float32)
+    mask1 = _keep_top_capacity(mask1, priority, capacity)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1                 # [S, E]
+    locations1_s = jnp.sum(locations1 * mask1, axis=1)         # [S]
+
+    gates_masked = gates * mask1.astype(gates.dtype)
+    locations1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates.dtype)
+    combine_weights = jnp.einsum("se,sc->sec", gates_masked, locations1_sc)
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+def top2gating(logits: jnp.ndarray,
+               capacity_factor: float,
+               min_capacity: int,
+               rng: Optional[jax.Array] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-2 gating (GShard). logits: [S, E] fp32. Second expert chosen by
+    the Gumbel-max trick over the remaining logits (reference top2gating,
+    sharded_moe.py:279-349)."""
+    s, e = logits.shape
+    gates = jax.nn.softmax(logits, axis=1)
+    capacity = _capacity(s, e, capacity_factor * 2.0, min_capacity)
+
+    indices1 = jnp.argmax(gates, axis=1)
+    mask1 = jax.nn.one_hot(indices1, e, dtype=jnp.int32)
+
+    if rng is not None:
+        rng, sub = jax.random.split(rng)
+        noisy = logits + jax.random.gumbel(sub, logits.shape, logits.dtype)
+    else:
+        noisy = logits
+    masked = jnp.where(mask1 > 0, -jnp.inf, noisy)
+    indices2 = jnp.argmax(masked, axis=1)
+    mask2 = jax.nn.one_hot(indices2, e, dtype=jnp.int32)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - 1
+    locations2 = jnp.cumsum(mask2, axis=0) - 1
+    locations2 = locations2 + jnp.sum(mask1, axis=0, keepdims=True)
+
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1.astype(jnp.float32), axis=0)
+    l_aux = jnp.mean(me * ce) * e * e
+
+    mask1 = mask1 * (locations1 < capacity).astype(jnp.int32)
+    mask2 = mask2 * (locations2 < capacity).astype(jnp.int32)
+
+    locations1_s = jnp.sum(locations1 * mask1, axis=1)
+    locations2_s = jnp.sum(locations2 * mask2, axis=1)
+
+    mask1_f = mask1.astype(gates.dtype)
+    mask2_f = mask2.astype(gates.dtype)
+    gates1_s = jnp.einsum("se,se->s", gates, mask1_f)
+    gates2_s = jnp.einsum("se,se->s", gates, mask2_f)
+    denom = jnp.clip(gates1_s + gates2_s, jnp.finfo(gates.dtype).eps, None)
+    gates1_s = gates1_s / denom
+    gates2_s = gates2_s / denom
+
+    gates1 = jnp.einsum("s,se->se", gates1_s, mask1_f)
+    gates2 = jnp.einsum("s,se->se", gates2_s, mask2_f)
+    loc1_sc = jax.nn.one_hot(locations1_s, capacity, dtype=gates.dtype)
+    loc2_sc = jax.nn.one_hot(locations2_s, capacity, dtype=gates.dtype)
+    combine_weights = (jnp.einsum("se,sc->sec", gates1, loc1_sc)
+                       + jnp.einsum("se,sc->sec", gates2, loc2_sc))
+    dispatch_mask = combine_weights > 0
+    return l_aux, combine_weights, dispatch_mask, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gate network: fp32 linear -> top-k gating (reference TopKGate,
+    sharded_moe.py:352-437). k in {1, 2}."""
+    model_dim: int
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 8
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, used_token=None,
+                 deterministic: bool = True):
+        if self.k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gatings are supported.")
+        # gate math is always fp32 (reference :406-409)
+        x = tokens.astype(jnp.float32)
+        rng = None
+        if not deterministic and self.has_rng("gating"):
+            rng = self.make_rng("gating")
+        if (self.noisy_gate_policy == "Jitter" and not deterministic
+                and rng is not None):
+            rng, sub = jax.random.split(rng)
+            x = x * jax.random.uniform(sub, x.shape, jnp.float32, 0.99, 1.01)
+        logits = nn.Dense(self.num_experts, use_bias=False,
+                          dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="wg")(x)
+        cf = self.capacity_factor if not deterministic else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(
+                logits, cf, self.min_capacity, rng=rng, used_token=used_token,
+                noisy_gate_policy=self.noisy_gate_policy if not deterministic else None,
+                drop_tokens=self.drop_tokens, use_rts=self.use_rts)
+        return top2gating(logits, cf, self.min_capacity, rng=rng)
+
+
+def _ep_constraint(x: jnp.ndarray) -> jnp.ndarray:
+    """Constrain an [E, C, M] tensor's expert dim to the ``ep`` mesh axis —
+    this is where XLA emits the dispatch/combine all-to-all (the reference's
+    explicit ``_AllToAll.apply``, sharded_moe.py:92-105)."""
+    try:
+        mesh = mesh_lib.get_global_mesh()
+    except Exception:
+        return x
+    if "ep" not in mesh.shape or x.shape[0] % max(mesh.shape["ep"], 1):
+        return x
+    from jax.sharding import PartitionSpec as P
+    spec = P("ep", *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, spec))
+
+
+class MOELayer(nn.Module):
+    """Dispatch -> experts -> combine (reference MOELayer.forward,
+    sharded_moe.py:488-561). ``experts`` maps [E, C, M] -> [E, C, M] with
+    expert-stacked params (see moe/experts.py)."""
+    gate: TopKGate
+    experts: nn.Module
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, used_token=None,
+                 deterministic: bool = True):
+        d_model = x.shape[-1]
+        tokens = x.reshape(-1, d_model)                        # [S, M]
+        l_aux, combine, dispatch, exp_counts = self.gate(
+            tokens, used_token, deterministic)
+
+        dispatched = jnp.einsum("sec,sm->ecm",
+                                dispatch.astype(x.dtype), tokens)
+        dispatched = _ep_constraint(dispatched)
+        expert_out = self.experts(dispatched)                  # [E, C, M]
+        expert_out = _ep_constraint(expert_out)
+        out = jnp.einsum("sec,ecm->sm",
+                         combine.astype(x.dtype), expert_out)
+        return out.reshape(x.shape), l_aux, exp_counts

@@ -1,0 +1,64 @@
+"""MoE parameter utilities.
+
+Reference analogue: ``deepspeed/moe/utils.py`` — ``is_moe_param`` (:18) keys
+on the ``allreduce=False`` attribute stamped by Experts;
+``split_params_into_different_moe_groups_for_optimizer`` (:62) splits
+optimizer param groups into shared vs per-expert-group params so the engine
+can reduce expert grads over the expert-data-parallel group
+(engine.py:2171-2186).
+
+TPU-native: params are a pytree; MoE-ness is a property of the parameter
+*path* (the Experts lift names its stacked params ``experts/...``), and grad
+reduction scope is decided by GSPMD from shardings — so the utilities here
+are pure tree-mask helpers used for weight decay masks, checkpoint layout,
+and param counting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.sharding import path_str
+
+
+def is_moe_param_path(path: str) -> bool:
+    return "experts" in path.split("/") or "/experts/" in f"/{path}/"
+
+
+def is_moe_param(path) -> bool:
+    """path: a flax tree path tuple or a '/'-joined string."""
+    if not isinstance(path, str):
+        path = path_str(path)
+    return is_moe_param_path(path)
+
+
+def moe_param_mask(params) -> Any:
+    """Pytree of bools: True for expert params. Usable as an optax mask."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: is_moe_param(p), params)
+
+
+def split_params_into_shared_and_expert(params) -> Tuple[Any, Any]:
+    """Two pytrees (same structure, None-d out complements): shared params
+    and expert params — the analogue of the reference's optimizer
+    param-group split (moe/utils.py:62-119)."""
+    mask = moe_param_mask(params)
+    shared = jax.tree.map(lambda p, m: None if m else p, params, mask)
+    expert = jax.tree.map(lambda p, m: p if m else None, params, mask)
+    return shared, expert
+
+
+def count_moe_params(params) -> Tuple[int, int]:
+    """(shared_count, expert_count) over leaves."""
+    shared = expert = 0
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in leaves:
+        n = int(jnp.size(leaf))
+        if is_moe_param(path):
+            expert += n
+        else:
+            shared += n
+    return shared, expert

@@ -1,0 +1,295 @@
+"""Flash attention, Pallas/TPU.
+
+This is the TPU-native replacement for the reference's fused attention
+kernels — the training-side softmax/attention CUDA kernels
+(``csrc/transformer/softmax_kernels.cu``, ``ds_transformer_cuda.cpp``) and
+the inference ``softmax_context`` kernel family
+(``csrc/transformer/inference/csrc/softmax.cu``). Instead of materializing
+the [S, S] score matrix in HBM, the kernel streams K/V tiles through VMEM
+with an online-softmax accumulator (Flash Attention, arXiv:2205.14135), so
+HBM traffic is O(S·D) and the MXU sees back-to-back [block, D] matmuls.
+
+Layout: q, k, v are [B, S, H, D] (model layout); kernels run per (batch,
+head) over q tiles. The backward pass recomputes attention per tile from the
+saved per-row logsumexp — the rematerialization trade the reference makes
+with activation checkpointing, here at kernel granularity.
+
+On non-TPU backends the kernels run in Pallas interpret mode, which is how
+the CPU test mesh exercises them (tests/test_pallas_ops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
+                block_k, causal, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                    # [bq, D]
+    nk = seq_len // block_k
+    if causal:
+        hi = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nk)
+    else:
+        hi = nk
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    b, s, h, d = q.shape
+    # kernel layout [B, H, S, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    nq = s // block_q
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                               block_k=block_k, causal=causal, seq_len=s)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, block_q, block_k, causal, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    nk = seq_len // block_k
+    if causal:
+        hi = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nk)
+    else:
+        hi = nk
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body,
+                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, block_q, block_k, causal,
+                    seq_len):
+    ki = pl.program_id(2)
+    kb = k_ref[0, 0].astype(jnp.float32)                   # [bk, D]
+    vb = v_ref[0, 0].astype(jnp.float32)
+    nq = seq_len // block_q
+    lo = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lseb = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        deltab = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lseb[:, None])                     # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - deltab[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    d = kb.shape[-1]
+    dk, dv = jax.lax.fori_loop(
+        lo, nq, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, g):
+    qt, kt, vt, out, lse = res
+    b, h, s, d = qt.shape
+    dot = g.transpose(0, 2, 1, 3)                          # [B,H,S,D]
+    delta = jnp.sum(dot.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                               # [B,H,S]
+    nq, nk = s // block_q, s // block_k
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
+                                  block_q=block_q, block_k=block_k,
+                                  causal=causal, seq_len=s)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
+        interpret=_interpret(),
+    )(qt, kt, vt, dot, lse, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   causal=causal, seq_len=s)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), vt.dtype),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt, dot, lse, delta)
+
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+    return tr(dq), tr(dk), tr(dv)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_bwd)
+
+
+def _reference_attention(q, k, v, causal, scale):
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused attention. q, k, v: [B, S, H, D] -> [B, S, H, D].
+
+    Falls back to the XLA einsum path when the sequence does not tile
+    (dynamic/tiny shapes), mirroring the reference's kernel-compatibility
+    gating (op_builder ``is_compatible`` checks).
+    """
+    b, s, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        return _reference_attention(q, k, v, causal, scale)
+    return _flash_attention(q, k, v, causal, scale, block_q, block_k)

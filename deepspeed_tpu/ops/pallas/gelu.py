@@ -1,0 +1,102 @@
+"""Fused bias + GeLU, Pallas/TPU.
+
+Reference analogue: ``csrc/transformer/gelu_kernels.cu`` (330 LoC:
+``gelu_kernel``, ``fused_bias_gelu``, ``d_gelu_func``) and the inference
+``bias_gelu`` binding. Uses the same tanh approximation as the reference
+kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_SQRT_2_OVER_PI = 0.7978845608028654
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _gelu(x):
+    return 0.5 * x * (1.0 + jnp.tanh(_SQRT_2_OVER_PI * (x + 0.044715 * x ** 3)))
+
+
+def _dgelu(x):
+    # d/dx of the tanh-approximated gelu (reference d_gelu_func,
+    # gelu_kernels.cu)
+    t = jnp.tanh(_SQRT_2_OVER_PI * (x + 0.044715 * x ** 3))
+    dt = (1.0 - t * t) * _SQRT_2_OVER_PI * (1.0 + 3 * 0.044715 * x * x)
+    return 0.5 * (1.0 + t) + 0.5 * x * dt
+
+
+def _fwd_kernel(x_ref, b_ref, y_ref):
+    x = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = _gelu(x).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, b_ref, dy_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    dx_ref[...] = (_dgelu(x) * dy_ref[...].astype(jnp.float32)).astype(dx_ref.dtype)
+
+
+def _rows_block(n_rows: int) -> int:
+    for cand in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n_rows % cand == 0:
+            return cand
+    return 1
+
+
+def _run_rowwise(kernel, inputs, d, out_dtype):
+    n = inputs[0].shape[0]
+    bn = _rows_block(n)
+    specs = []
+    for a in inputs:
+        if a.ndim == 1:
+            specs.append(pl.BlockSpec((d,), lambda i: (0,)))
+        else:
+            specs.append(pl.BlockSpec((bn, d), lambda i: (i, 0)))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), out_dtype),
+        interpret=_interpret(),
+    )(*inputs)
+
+
+@jax.custom_vjp
+def bias_gelu(x, bias):
+    """gelu(x + bias) fused. x: [..., D]; bias: [D]."""
+    orig = x.shape
+    d = x.shape[-1]
+    y = _run_rowwise(_fwd_kernel, (x.reshape(-1, d), bias), d, x.dtype)
+    return y.reshape(orig)
+
+
+def _bias_gelu_fwd(x, bias):
+    return bias_gelu(x, bias), (x, bias)
+
+
+def _bias_gelu_bwd(res, g):
+    x, bias = res
+    orig = x.shape
+    d = x.shape[-1]
+    dx = _run_rowwise(_bwd_kernel,
+                      (x.reshape(-1, d), bias, g.reshape(-1, d)), d, x.dtype)
+    dx = dx.reshape(orig)
+    dbias = jnp.sum(dx.astype(jnp.float32),
+                    axis=tuple(range(x.ndim - 1))).astype(bias.dtype)
+    return dx, dbias
+
+
+bias_gelu.defvjp(_bias_gelu_fwd, _bias_gelu_bwd)
+
+
+def gelu(x):
+    """Unfused-bias variant (zero bias)."""
+    return bias_gelu(x, jnp.zeros((x.shape[-1],), x.dtype))

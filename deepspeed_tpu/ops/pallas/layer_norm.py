@@ -1,0 +1,126 @@
+"""Fused LayerNorm, Pallas/TPU.
+
+Reference analogue: ``csrc/transformer/normalize_kernels.cu`` (2121 LoC of
+fused layer-norm fwd/bwd variants, incl. residual fusions) exposed through
+the transformer kernel. Here: one row-parallel Pallas kernel each for
+forward and input-gradient; the (small) parameter gradients are XLA
+reductions. Saves mean/rstd for the backward pass like the reference's
+training kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * rstd
+    y = xhat * g_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+    mean_ref[...] = mean[..., 0]
+    rstd_ref[...] = rstd[..., 0]
+
+
+def _dx_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    gamma = g_ref[...].astype(jnp.float32)
+    mean = mean_ref[...][..., None]
+    rstd = rstd_ref[...][..., None]
+    xhat = (x - mean) * rstd
+    wdy = dy * gamma
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _rows_block(n_rows: int) -> int:
+    for cand in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n_rows % cand == 0:
+            return cand
+    return 1
+
+
+def _ln_fwd(x, gamma, beta, eps):
+    orig_shape = x.shape
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    bn = _rows_block(n)
+    kernel = functools.partial(_fwd_kernel, eps=eps)
+    y, mean, rstd = pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), x.dtype),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2, gamma, beta)
+    return y.reshape(orig_shape), (x2, gamma, mean, rstd, orig_shape)
+
+
+def _ln_bwd(eps, res, g):
+    x2, gamma, mean, rstd, orig_shape = res
+    d = x2.shape[-1]
+    n = x2.shape[0]
+    dy2 = g.reshape(-1, d)
+    bn = _rows_block(n)
+    dx = pl.pallas_call(
+        _dx_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn,), lambda i: (i,)),
+            pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
+        interpret=_interpret(),
+    )(x2, gamma, mean, rstd, dy2)
+    # parameter grads: plain XLA cross-row reductions
+    xhat = (x2.astype(jnp.float32) - mean[:, None]) * rstd[:, None]
+    dyf = dy2.astype(jnp.float32)
+    dgamma = jnp.sum(dyf * xhat, axis=0).astype(gamma.dtype)
+    dbeta = jnp.sum(dyf, axis=0).astype(gamma.dtype)
+    return dx.reshape(orig_shape), dgamma, dbeta
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x, gamma, beta, eps: float = 1e-5):
+    """Fused layer norm over the last dim. x: [..., D]; gamma/beta: [D]."""
+    y, _ = _ln_fwd(x, gamma, beta, eps)
+    return y
+
+
+def _layer_norm_fwd(x, gamma, beta, eps):
+    return _ln_fwd(x, gamma, beta, eps)
+
+
+layer_norm.defvjp(_layer_norm_fwd, _ln_bwd)

@@ -33,6 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _COLUMN_PAT = re.compile(r"(qkv|up_proj|q_proj|k_proj|v_proj|lm_head|fc_in|wi|gate_proj)")
 _ROW_PAT = re.compile(r"(out_proj|down_proj|o_proj|fc_out|wo)")
 _EMBED_PAT = re.compile(r"(wte|embed|embedding)")
+# Expert-stacked params (leading dim = experts; see moe/experts.py). The
+# gate (`wg`) is NOT expert-stacked and stays replicated over ep.
+_EXPERT_PAT = re.compile(r"(^|/)experts(/|$)")
 
 
 def path_str(path) -> str:
@@ -89,16 +92,31 @@ class ShardingRules:
         self.stage = zero_stage
         self.dp = mesh.shape.get("dp", 1)
         self.tp = mesh.shape.get("tp", 1) if use_tp else 1
+        self.ep = mesh.shape.get("ep", 1)
+
+    def _base_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+        """TP + EP structural sharding shared by all three state kinds.
+        Expert-stacked params shard their leading (expert) dim over ``ep``
+        (reference: expert params tagged allreduce=False + group_name,
+        moe/experts.py:9-34, reduced over expert groups at engine.py:2171)."""
+        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        if self.ep > 1 and _EXPERT_PAT.search(path) and shape \
+                and shape[0] % self.ep == 0:
+            parts = list(spec) + [None] * (len(shape) - len(spec))
+            if parts[0] is None:
+                parts[0] = "ep"
+            spec = P(*parts)
+        return spec
 
     def param_spec(self, path: str, shape: Tuple[int, ...]) -> P:
-        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        spec = self._base_spec(path, shape)
         if self.stage >= 3:
             spec = _add_axis(spec, shape, "dp", self.dp)
         return spec
 
     def master_spec(self, path: str, shape: Tuple[int, ...]) -> P:
         """fp32 master copy / optimizer moments: sharded from stage 1 on."""
-        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        spec = self._base_spec(path, shape)
         if self.stage >= 1:
             spec = _add_axis(spec, shape, "dp", self.dp)
         return spec
@@ -106,7 +124,7 @@ class ShardingRules:
     def grad_spec(self, path: str, shape: Tuple[int, ...]) -> P:
         """Gradients: reduce-scattered from stage 2 on (constraining the grad
         output to the sharded spec turns the dp psum into psum_scatter)."""
-        spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        spec = self._base_spec(path, shape)
         if self.stage >= 2:
             spec = _add_axis(spec, shape, "dp", self.dp)
         return spec
