@@ -10,14 +10,13 @@ axis, fixed at mesh construction.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..utils.logging import log_dist
-from .experts import Experts
+from .experts import Experts, _ApplyExpert
 from .sharded_moe import MOELayer, TopKGate
 
 
@@ -65,21 +64,10 @@ class MoE(nn.Module):
             # Residual MoE: learned softmax mix of expert path and a dense
             # MLP path (reference layer.py:117-130). Clone the template so
             # the dense path gets its own (unstacked) params.
-            mlp_out = _ApplyDense(inner=self.expert.clone(),
-                                  name="mlp")(hidden_states)
+            mlp_out = _ApplyExpert(inner=self.expert.clone(),
+                                   name="mlp")(hidden_states)
             coef = nn.Dense(2, dtype=hidden_states.dtype,
                             name="coefficient")(hidden_states)
             coef = jax.nn.softmax(coef, axis=-1)
             output = output * coef[..., 0:1] + mlp_out * coef[..., 1:]
         return output, l_aux, exp_counts
-
-
-class _ApplyDense(nn.Module):
-    inner: nn.Module
-
-    @nn.compact
-    def __call__(self, x):
-        out = self.inner(x)
-        if isinstance(out, tuple):
-            out = out[0]
-        return out

@@ -41,13 +41,16 @@ def moe_param_mask(params) -> Any:
         lambda p, _: is_moe_param(p), params)
 
 
-def split_params_into_shared_and_expert(params) -> Tuple[Any, Any]:
-    """Two pytrees (same structure, None-d out complements): shared params
-    and expert params — the analogue of the reference's optimizer
-    param-group split (moe/utils.py:62-119)."""
-    mask = moe_param_mask(params)
-    shared = jax.tree.map(lambda p, m: None if m else p, params, mask)
-    expert = jax.tree.map(lambda p, m: p if m else None, params, mask)
+def split_params_into_shared_and_expert(params) -> Tuple[dict, dict]:
+    """Two flat ``{path: leaf}`` dicts: shared params and expert params —
+    the analogue of the reference's optimizer param-group split
+    (moe/utils.py:62-119). Flat dicts (not pruned pytrees) so callers can
+    zip/merge them without treedef mismatches; for masked optax transforms
+    use ``moe_param_mask`` instead."""
+    shared, expert = {}, {}
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in leaves:
+        (expert if is_moe_param(path) else shared)[path_str(path)] = leaf
     return shared, expert
 
 

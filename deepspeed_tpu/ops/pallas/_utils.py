@@ -1,0 +1,20 @@
+"""Shared helpers for the Pallas kernel modules."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Run kernels in the Pallas interpreter off-TPU (CPU test mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def rows_block(n_rows: int, max_block: int = 256) -> int:
+    """Largest power-of-two row-block <= max_block dividing n_rows."""
+    cand = max_block
+    while cand > 1:
+        if n_rows % cand == 0:
+            return cand
+        cand //= 2
+    return 1

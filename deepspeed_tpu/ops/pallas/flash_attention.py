@@ -5,14 +5,17 @@ kernels — the training-side softmax/attention CUDA kernels
 (``csrc/transformer/softmax_kernels.cu``, ``ds_transformer_cuda.cpp``) and
 the inference ``softmax_context`` kernel family
 (``csrc/transformer/inference/csrc/softmax.cu``). Instead of materializing
-the [S, S] score matrix in HBM, the kernel streams K/V tiles through VMEM
-with an online-softmax accumulator (Flash Attention, arXiv:2205.14135), so
-HBM traffic is O(S·D) and the MXU sees back-to-back [block, D] matmuls.
+the [S, S] score matrix in HBM, K/V stream through VMEM one [block_k, D]
+tile at a time with an online-softmax accumulator (Flash Attention,
+arXiv:2205.14135), so HBM traffic is O(S·D) and VMEM residency is
+O(block²) regardless of sequence length — the k loop is the innermost
+*grid* dimension with accumulators in VMEM scratch, so long sequences never
+blow the ~16 MB VMEM budget.
 
 Layout: q, k, v are [B, S, H, D] (model layout); kernels run per (batch,
-head) over q tiles. The backward pass recomputes attention per tile from the
-saved per-row logsumexp — the rematerialization trade the reference makes
-with activation checkpointing, here at kernel granularity.
+head). The backward pass recomputes attention per tile from the saved
+per-row logsumexp — the rematerialization trade the reference makes with
+activation checkpointing, here at kernel granularity.
 
 On non-TPU backends the kernels run in Pallas interpret mode, which is how
 the CPU test mesh exercises them (tests/test_pallas_ops.py).
@@ -27,56 +30,63 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._utils import interpret_mode
 
 NEG_INF = -1e30
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+def _causal_mask(s, qi, ki, block_q, block_k):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
 
 
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_q,
-                block_k, causal, seq_len):
-    qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)                    # [bq, D]
-    nk = seq_len // block_k
-    if causal:
-        hi = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nk)
-    else:
-        hi = nk
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, block_q, block_k, causal):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    # skip fully-masked tiles (strictly above the diagonal)
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
-        acc_new = acc * corr[:, None] + jax.lax.dot(
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * corr + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot(
             p, vb, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0, 1.0, l)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
@@ -85,27 +95,37 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    nq = s // block_q
+    nq, nk = s // block_q, s // block_k
 
     kernel = functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                               block_k=block_k, causal=causal, seq_len=s)
+                               block_k=block_k, causal=causal)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b, h, nq),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s), jnp.float32),
         ],
-        interpret=_interpret(),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret_mode(),
     )(qt, kt, vt)
     return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
 
@@ -114,83 +134,81 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
 # Backward
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, block_q, block_k, causal, seq_len):
-    qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    nk = seq_len // block_k
-    if causal:
-        hi = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, nk)
-    else:
-        hi = nk
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, block_q, block_k, causal):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
 
-    def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot(ds, kb, preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + jax.lax.dot(
+            ds, kb, preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, hi, body,
-                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, block_q, block_k, causal,
-                    seq_len):
-    ki = pl.program_id(2)
-    kb = k_ref[0, 0].astype(jnp.float32)                   # [bk, D]
-    vb = v_ref[0, 0].astype(jnp.float32)
-    nq = seq_len // block_q
-    lo = (ki * block_k) // block_q if causal else 0
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block_q,
+                    block_k, causal):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lseb = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        deltab = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (not causal) or (qi * block_q + block_q - 1 >= ki * block_k)
+
+    @pl.when(live)
+    def _compute():
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        qb = q_ref[0, 0].astype(jnp.float32)
+        dob = do_ref[0, 0].astype(jnp.float32)
+        lseb = lse_ref[0, 0]
+        deltab = delta_ref[0, 0]
         s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lseb[:, None])                     # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - deltab[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
 
-    d = kb.shape[-1]
-    dk, dv = jax.lax.fori_loop(
-        lo, nq, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, res, g):
@@ -203,47 +221,64 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
                                   block_q=block_q, block_k=block_k,
-                                  causal=causal, seq_len=s)
+                                  causal=causal)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b, h, nq),
+        grid=(b, h, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, qi)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
-        interpret=_interpret(),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret_mode(),
     )(qt, kt, vt, dot, lse, delta)
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
                                    block_q=block_q, block_k=block_k,
-                                   causal=causal, seq_len=s)
+                                   causal=causal)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, nk),
+        grid=(b, h, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, ki: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, hi, qi)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), kt.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), vt.dtype),
         ],
-        interpret=_interpret(),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret_mode(),
     )(qt, kt, vt, dot, lse, delta)
 
     tr = lambda x: x.transpose(0, 2, 1, 3)

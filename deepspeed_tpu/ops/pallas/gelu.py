@@ -14,11 +14,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._utils import interpret_mode, rows_block
+
 _SQRT_2_OVER_PI = 0.7978845608028654
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _gelu(x):
@@ -43,16 +41,11 @@ def _bwd_kernel(x_ref, b_ref, dy_ref, dx_ref):
     dx_ref[...] = (_dgelu(x) * dy_ref[...].astype(jnp.float32)).astype(dx_ref.dtype)
 
 
-def _rows_block(n_rows: int) -> int:
-    for cand in (256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if n_rows % cand == 0:
-            return cand
-    return 1
 
 
 def _run_rowwise(kernel, inputs, d, out_dtype):
     n = inputs[0].shape[0]
-    bn = _rows_block(n)
+    bn = rows_block(n, 256)
     specs = []
     for a in inputs:
         if a.ndim == 1:
@@ -65,7 +58,7 @@ def _run_rowwise(kernel, inputs, d, out_dtype):
         in_specs=specs,
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), out_dtype),
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(*inputs)
 
 

@@ -16,9 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from ._utils import interpret_mode, rows_block
 
 
 def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref, *, eps):
@@ -47,11 +45,6 @@ def _dx_kernel(x_ref, g_ref, mean_ref, rstd_ref, dy_ref, dx_ref):
     dx_ref[...] = dx.astype(dx_ref.dtype)
 
 
-def _rows_block(n_rows: int) -> int:
-    for cand in (256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if n_rows % cand == 0:
-            return cand
-    return 1
 
 
 def _ln_fwd(x, gamma, beta, eps):
@@ -59,7 +52,7 @@ def _ln_fwd(x, gamma, beta, eps):
     d = x.shape[-1]
     x2 = x.reshape(-1, d)
     n = x2.shape[0]
-    bn = _rows_block(n)
+    bn = rows_block(n, 256)
     kernel = functools.partial(_fwd_kernel, eps=eps)
     y, mean, rstd = pl.pallas_call(
         kernel,
@@ -79,7 +72,7 @@ def _ln_fwd(x, gamma, beta, eps):
             jax.ShapeDtypeStruct((n,), jnp.float32),
             jax.ShapeDtypeStruct((n,), jnp.float32),
         ],
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(x2, gamma, beta)
     return y.reshape(orig_shape), (x2, gamma, mean, rstd, orig_shape)
 
@@ -89,7 +82,7 @@ def _ln_bwd(eps, res, g):
     d = x2.shape[-1]
     n = x2.shape[0]
     dy2 = g.reshape(-1, d)
-    bn = _rows_block(n)
+    bn = rows_block(n, 256)
     dx = pl.pallas_call(
         _dx_kernel,
         grid=(n // bn,),
@@ -102,7 +95,7 @@ def _ln_bwd(eps, res, g):
         ],
         out_specs=pl.BlockSpec((bn, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x2.dtype),
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(x2, gamma, mean, rstd, dy2)
     # parameter grads: plain XLA cross-row reductions
     xhat = (x2.astype(jnp.float32) - mean[:, None]) * rstd[:, None]

@@ -15,11 +15,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._utils import interpret_mode, rows_block
+
 NEG_INF = -1e30
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _fwd_kernel(x_ref, y_ref, *, causal, row_offset_per_block, block_rows):
@@ -44,11 +42,6 @@ def _bwd_kernel(y_ref, dy_ref, dx_ref):
     dx_ref[...] = (y * (dy - dot)).astype(dx_ref.dtype)
 
 
-def _rows_block(n_rows: int) -> int:
-    for cand in (128, 64, 32, 16, 8, 4, 2, 1):
-        if n_rows % cand == 0:
-            return cand
-    return 1
 
 
 def _softmax_fwd(x, causal):
@@ -57,7 +50,7 @@ def _softmax_fwd(x, causal):
     rows_per_mat = x.shape[-2] if x.ndim >= 2 else 1
     x2 = x.reshape(-1, s)
     n = x2.shape[0]
-    bn = _rows_block(n)
+    bn = rows_block(n, 128)
     kernel = functools.partial(_fwd_kernel, causal=causal,
                                row_offset_per_block=rows_per_mat,
                                block_rows=bn)
@@ -67,7 +60,7 @@ def _softmax_fwd(x, causal):
         in_specs=[pl.BlockSpec((bn, s), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((bn, s), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, s), x.dtype),
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(x2)
     return y.reshape(orig), (y, orig)
 
@@ -77,7 +70,7 @@ def _softmax_bwd(causal, res, g):
     s = y.shape[-1]
     dy2 = g.reshape(-1, s)
     n = dy2.shape[0]
-    bn = _rows_block(n)
+    bn = rows_block(n, 128)
     dx = pl.pallas_call(
         _bwd_kernel,
         grid=(n // bn,),
@@ -85,7 +78,7 @@ def _softmax_bwd(causal, res, g):
                   pl.BlockSpec((bn, s), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((bn, s), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, s), dy2.dtype),
-        interpret=_interpret(),
+        interpret=interpret_mode(),
     )(y, dy2)
     return (dx.reshape(orig),)
 

@@ -294,19 +294,25 @@ class DeepSpeedEngine:
         return float(jax.device_get(self.state["scale"].cur_scale))
 
     # ------------------------------------------------------------- model fns
-    def _apply_model(self, params, batch, rng):
+    def _apply_model(self, params, batch, rng, train=True):
         if hasattr(self.module, "apply"):  # flax module
+            rngs = {"dropout": rng, "gating": jax.random.fold_in(rng, 1)}
             if isinstance(batch, dict):
                 inputs = batch.get("input_ids", batch.get("inputs"))
                 if inputs is None:
                     raise ValueError("flax-module path expects batch['input_ids']")
+            else:
+                inputs = batch
+            try:
                 return self.module.apply({"params": params}, inputs,
-                                         rngs={"dropout": rng})
-            return self.module.apply({"params": params}, batch, rngs={"dropout": rng})
+                                         deterministic=not train, rngs=rngs)
+            except TypeError:
+                # model without a `deterministic` kwarg
+                return self.module.apply({"params": params}, inputs, rngs=rngs)
         return self.module(params, batch, rng)
 
-    def _loss_of(self, params, batch, rng):
-        out = self._apply_model(params, batch, rng)
+    def _loss_of(self, params, batch, rng, train=True):
+        out = self._apply_model(params, batch, rng, train=train)
         if self.loss_fn is not None:
             return self.loss_fn(out, batch)
         if isinstance(out, jnp.ndarray) and out.ndim == 0:
@@ -504,7 +510,7 @@ class DeepSpeedEngine:
         if not hasattr(self, "_jit_eval"):
             def ev(master, batch, rng):
                 params = _cast_tree(master, self.compute_dtype)
-                return self._loss_of(params, batch, rng)
+                return self._loss_of(params, batch, rng, train=False)
             self._jit_eval = jax.jit(ev)
         batch = self._shard_batch(batch)
         return self._jit_eval(self.state["master"], batch, self.state["rng"])

@@ -94,45 +94,66 @@ class ShardingRules:
         self.tp = mesh.shape.get("tp", 1) if use_tp else 1
         self.ep = mesh.shape.get("ep", 1)
 
-    def _base_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+    def _base_spec(self, path: str, shape: Tuple[int, ...],
+                   expert_dim: int = 0) -> P:
         """TP + EP structural sharding shared by all three state kinds.
-        Expert-stacked params shard their leading (expert) dim over ``ep``
-        (reference: expert params tagged allreduce=False + group_name,
-        moe/experts.py:9-34, reduced over expert groups at engine.py:2171)."""
+        Expert-stacked params shard their expert dim over ``ep`` (reference:
+        expert params tagged allreduce=False + group_name, moe/experts.py:9-34,
+        reduced over expert groups at engine.py:2171). ``expert_dim`` is 0
+        for plain expert banks [E, ...] and 1 under scan-over-layers
+        [L, E, ...] (see _expert_axis)."""
         spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
-        if self.ep > 1 and _EXPERT_PAT.search(path) and shape \
-                and shape[0] % self.ep == 0:
+        if self.ep > 1 and _EXPERT_PAT.search(path) \
+                and len(shape) > expert_dim and shape[expert_dim] % self.ep == 0:
             parts = list(spec) + [None] * (len(shape) - len(spec))
-            if parts[0] is None:
-                parts[0] = "ep"
+            if parts[expert_dim] is None:
+                parts[expert_dim] = "ep"
             spec = P(*parts)
         return spec
 
-    def param_spec(self, path: str, shape: Tuple[int, ...]) -> P:
-        spec = self._base_spec(path, shape)
+    def param_spec(self, path: str, shape: Tuple[int, ...],
+                   expert_dim: int = 0) -> P:
+        spec = self._base_spec(path, shape, expert_dim)
         if self.stage >= 3:
             spec = _add_axis(spec, shape, "dp", self.dp)
         return spec
 
-    def master_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+    def master_spec(self, path: str, shape: Tuple[int, ...],
+                    expert_dim: int = 0) -> P:
         """fp32 master copy / optimizer moments: sharded from stage 1 on."""
-        spec = self._base_spec(path, shape)
+        spec = self._base_spec(path, shape, expert_dim)
         if self.stage >= 1:
             spec = _add_axis(spec, shape, "dp", self.dp)
         return spec
 
-    def grad_spec(self, path: str, shape: Tuple[int, ...]) -> P:
+    def grad_spec(self, path: str, shape: Tuple[int, ...],
+                  expert_dim: int = 0) -> P:
         """Gradients: reduce-scattered from stage 2 on (constraining the grad
         output to the sharded spec turns the dp psum into psum_scatter)."""
-        spec = self._base_spec(path, shape)
+        spec = self._base_spec(path, shape, expert_dim)
         if self.stage >= 2:
             spec = _add_axis(spec, shape, "dp", self.dp)
         return spec
 
     # -- tree-level helpers -------------------------------------------------
+    @staticmethod
+    def _expert_axis(tree) -> int:
+        """Which dim of expert-stacked params is the expert dim: 0 normally,
+        1 when the model scans over layers (params then stack [L, E, ...]).
+        Detected from the gate kernel's rank ([d, E] plain vs [L, d, E]
+        scanned) — the gate always lives beside the expert bank."""
+        leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+        for path, leaf in leaves:
+            p = path_str(path)
+            if "gate/wg" in p and p.endswith("kernel"):
+                return max(getattr(leaf, "ndim", 2) - 2, 0)
+        return 0
+
     def _tree_specs(self, tree, fn):
+        expert_dim = self._expert_axis(tree)
+
         def leaf(path, x):
-            return fn(path_str(path), tuple(x.shape))
+            return fn(path_str(path), tuple(x.shape), expert_dim)
         return jax.tree_util.tree_map_with_path(leaf, tree)
 
     def param_specs(self, params):
