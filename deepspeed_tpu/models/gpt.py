@@ -122,7 +122,9 @@ def causal_attention(q, k, v, *, dtype, impl: str = "xla", sparse_config=None,
         return flash_attention(q, k, v, causal=True)
     if impl == "sparse" and sparse_config is not None:
         from ..ops.sparse_attention.sparse_self_attention import sparse_attention
-        return sparse_attention(q, k, v, sparse_config)
+        # causal=True regardless of the layout's attention mode: a decoder
+        # LM must never see the future even through a bidirectional layout
+        return sparse_attention(q, k, v, sparse_config, causal=True)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     s = q.shape[1]

@@ -86,7 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[...]
         l_safe = jnp.where(l == 0, 1.0, l)
         o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
+        lse_ref[0, 0] = (m_scr[...] + jnp.log(l_safe))[:, None]
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
@@ -113,12 +113,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, qi, ki: (bi, hi, qi)),
+            # stats carry a trailing singleton lane dim: TPU lowering needs
+            # the last two block dims divisible by (8, 128) or equal to the
+            # array dims — (block_q, 1) qualifies, (1, block_q) does not
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
@@ -149,8 +152,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         kb = k_ref[0, 0].astype(jnp.float32)
         vb = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
@@ -188,8 +191,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         vb = v_ref[0, 0].astype(jnp.float32)
         qb = q_ref[0, 0].astype(jnp.float32)
         dob = do_ref[0, 0].astype(jnp.float32)
-        lseb = lse_ref[0, 0]
-        deltab = delta_ref[0, 0]
+        lseb = lse_ref[0, 0, :, 0]
+        deltab = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -216,7 +219,7 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
     b, h, s, d = qt.shape
     dot = g.transpose(0, 2, 1, 3)                          # [B,H,S,D]
     delta = jnp.sum(dot.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                               # [B,H,S]
+                    axis=-1, keepdims=True)                # [B,H,S,1]
     nq, nk = s // block_q, s // block_k
 
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
@@ -234,10 +237,10 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
                          lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, qi, ki: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, qi, ki: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -261,10 +264,10 @@ def _flash_bwd(causal, scale, block_q, block_k, res, g):
                          lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, ki, qi: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda bi, hi, ki, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d),

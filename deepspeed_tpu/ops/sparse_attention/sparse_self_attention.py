@@ -1,0 +1,440 @@
+"""Block-sparse self attention, Pallas/TPU.
+
+Reference analogue: ``deepspeed/ops/sparse_attention/sparse_self_attention.py``
+(:13-165, the QK^T -> masked softmax -> PV pipeline over a block layout) and
+the Triton block-sparse matmul/softmax machinery it drives
+(``matmul.py:214-995``, layout LUTs at ``matmul.py:613-674``).
+
+TPU-native design: the layout is compiled host-side into per-(head, q-tile)
+look-up tables of *live* k-tiles, and the kernel grid iterates only over
+live tiles — the LUT is a scalar-prefetch argument, so the BlockSpec index
+maps themselves read it to decide which K/V tile to DMA. Dead tiles are
+never fetched or computed: both FLOPs and HBM traffic scale with layout
+density (the property the reference gets from Triton's LUT kernels). Within
+a live kernel tile, the fine ``SparsityConfig.block`` mask is applied
+elementwise.
+
+Unidirectional layouts additionally get an exact elementwise causal mask
+(the reference is causal only at block granularity).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..pallas._utils import interpret_mode
+from .sparsity_config import SparsityConfig
+
+NEG_INF = -1e30
+
+
+def _expand_block_mask(fine, cb, bq, bk):
+    """[fq, fk] 0/1 block mask -> [bq, bk] elementwise bool. Expansion is
+    done with two tiny 0/1 matmuls (E_r @ fine @ E_c) instead of
+    repeat/reshape — Mosaic can't lower the cross-lane reshape a
+    ``jnp.repeat`` would need, but eats these matmuls on the MXU."""
+    fq, fk = fine.shape
+    f = fine.astype(jnp.float32)
+    er = (jax.lax.broadcasted_iota(jnp.int32, (bq, fq), 0) // cb
+          == jax.lax.broadcasted_iota(jnp.int32, (bq, fq), 1)
+          ).astype(jnp.float32)
+    ec = (jax.lax.broadcasted_iota(jnp.int32, (fk, bk), 1) // cb
+          == jax.lax.broadcasted_iota(jnp.int32, (fk, bk), 0)
+          ).astype(jnp.float32)
+    m = jax.lax.dot(er, jax.lax.dot(f, ec,
+                                    preferred_element_type=jnp.float32),
+                    preferred_element_type=jnp.float32)
+    return m > 0.5
+
+
+def _tile_mask(fine_tile, cb, bq, bk, qi, kj, causal):
+    mask = _expand_block_mask(fine_tile, cb, bq, bk)
+    if causal:
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = jnp.logical_and(mask, rows >= cols)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Kernels. Grid: (B, H, n_row_tiles, LUT_len); the innermost dim walks the
+# LUT of live column tiles. Scalar-prefetch args: lut [H, n, L], cnt [H, n].
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, o_ref,
+                lse_ref, m_scr, l_scr, acc_scr, *, scale, cb, block_q,
+                block_k, causal):
+    hi, qi, t = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    nt = pl.num_programs(3)
+
+    @pl.when(t == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(t < cnt_ref[hi, qi])
+    def _compute():
+        kj = lut_ref[hi, qi, t]
+        q = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(fine_ref[0, 0, 0], cb, block_q, block_k, qi, kj,
+                          causal)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.where(mask, jnp.exp(s - m_safe[:, None]), 0.0)
+        corr = jnp.where(m_prev <= NEG_INF / 2, 1.0, jnp.exp(m_prev - m_safe))
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * corr + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot(
+            p, vb, preferred_element_type=jnp.float32)
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        m = m_scr[...]
+        lse_ref[0, 0] = jnp.where(m <= NEG_INF / 2, NEG_INF,
+                                  m + jnp.log(l_safe))[:, None]
+
+
+def _bwd_dq_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_scr, *, scale, cb,
+                   block_q, block_k, causal):
+    hi, qi, t = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    nt = pl.num_programs(3)
+
+    @pl.when(t == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(t < cnt_ref[hi, qi])
+    def _compute():
+        kj = lut_ref[hi, qi, t]
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(fine_ref[0, 0, 0], cb, block_q, block_k, qi, kj,
+                          causal)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[...] = dq_scr[...] + jax.lax.dot(
+            ds, kb, preferred_element_type=jnp.float32)
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    scale, cb, block_q, block_k, causal):
+    hi, ki, t = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    nt = pl.num_programs(3)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(t < cnt_ref[hi, ki])
+    def _compute():
+        qi = lut_ref[hi, ki, t]
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        qb = q_ref[0, 0].astype(jnp.float32)
+        dob = do_ref[0, 0].astype(jnp.float32)
+        lseb = lse_ref[0, 0, :, 0]
+        deltab = delta_ref[0, 0, :, 0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(fine_ref[0, 0, 0], cb, block_q, block_k, qi, ki,
+                          causal)
+        p = jnp.where(mask, jnp.exp(s - lseb[:, None]), 0.0)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - deltab[:, None]) * scale
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side: layout compilation (LUTs) and pallas_call orchestration
+# ---------------------------------------------------------------------------
+
+def _kernel_block(s: int, cb: int, target: int = 128) -> int:
+    """Largest multiple of the layout block <= target that divides S."""
+    best = cb
+    m = cb
+    while m <= target:
+        if s % m == 0:
+            best = m
+        m += cb
+    return best
+
+
+class _CompiledLayout:
+    """LUTs + fine tile tensor for one (layout, seq_len, block) combo —
+    the analogue of the reference's ``make_lut`` results cached on the
+    sparse matmul objects (matmul.py:613-674)."""
+
+    def __init__(self, fine: np.ndarray, cb: int, bq: int, bk: int,
+                 causal: bool):
+        h, nb, _ = fine.shape
+        if causal:
+            fine = np.tril(np.ones((nb, nb), fine.dtype))[None] * fine
+        self.cb, self.bq, self.bk = cb, bq, bk
+        fq, fk = bq // cb, bk // cb
+        nq, nk = nb // fq, nb // fk
+        # fine tiles: [H, nq, nk, fq, fk]
+        self.fine_tiles = jnp.asarray(
+            fine.reshape(h, nq, fq, nk, fk).transpose(0, 1, 3, 2, 4)
+                .astype(np.int32))
+        coarse = fine.reshape(h, nq, fq, nk, fk).max(axis=(2, 4))
+        # row-major LUT (fwd, dq): live k-tiles per (h, qi)
+        self.lut_k, self.cnt_k = self._build_lut(coarse)
+        # column-major LUT (dkv): live q-tiles per (h, ki)
+        self.lut_q, self.cnt_q = self._build_lut(coarse.transpose(0, 2, 1))
+        self.density = float(coarse.mean())
+
+    @staticmethod
+    def _build_lut(coarse: np.ndarray):
+        h, n, m = coarse.shape
+        counts = coarse.sum(axis=2).astype(np.int32)
+        L = max(int(counts.max()), 1)
+        lut = np.zeros((h, n, L), np.int32)
+        for hh in range(h):
+            for i in range(n):
+                live = np.nonzero(coarse[hh, i])[0]
+                lut[hh, i, :len(live)] = live
+        return jnp.asarray(lut), jnp.asarray(counts)
+
+
+def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale):
+    b, s, h, d = q.shape
+    bq, bk, cb = layout.bq, layout.bk, layout.cb
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    nq = s // bq
+    L = layout.lut_k.shape[-1]
+    fq, fk = bq // cb, bk // cb
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nq, L),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, fq, fk),
+                         lambda bi, hi, qi, t, lut, cnt:
+                         (hi, qi, lut[hi, qi, t], 0, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, t, lut, cnt:
+                         (bi, hi, lut[hi, qi, t], 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, t, lut, cnt:
+                         (bi, hi, lut[hi, qi, t], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_fwd_kernel, scale=scale, cb=cb, block_q=bq,
+                               block_k=bk, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(layout.lut_k, layout.cnt_k, layout.fine_tiles, qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
+
+
+def _sparse_bwd(layout: _CompiledLayout, causal, scale, res, g):
+    qt, kt, vt, out, lse = res
+    b, h, s, d = qt.shape
+    bq, bk, cb = layout.bq, layout.bk, layout.cb
+    dot = g.transpose(0, 2, 1, 3)
+    delta = jnp.sum(dot.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    nq, nk = s // bq, s // bk
+    fq, fk = bq // cb, bk // cb
+    L = layout.lut_k.shape[-1]
+    Lq = layout.lut_q.shape[-1]
+
+    dq_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nq, L),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, fq, fk),
+                         lambda bi, hi, qi, t, lut, cnt:
+                         (hi, qi, lut[hi, qi, t], 0, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, t, lut, cnt:
+                         (bi, hi, lut[hi, qi, t], 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, qi, t, lut, cnt:
+                         (bi, hi, lut[hi, qi, t], 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi, t, lut, cnt:
+                               (bi, hi, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, cb=cb, block_q=bq,
+                          block_k=bk, causal=causal),
+        grid_spec=dq_grid,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
+        interpret=interpret_mode(),
+    )(layout.lut_k, layout.cnt_k, layout.fine_tiles, qt, kt, vt, dot, lse,
+      delta)
+
+    dkv_grid = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nk, Lq),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, fq, fk),
+                         lambda bi, hi, ki, t, lut, cnt:
+                         (hi, lut[hi, ki, t], ki, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t, lut, cnt:
+                         (bi, hi, lut[hi, ki, t], 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t, lut, cnt:
+                         (bi, hi, lut[hi, ki, t], 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, ki, t, lut, cnt:
+                         (bi, hi, lut[hi, ki, t], 0)),
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda bi, hi, ki, t, lut, cnt:
+                         (bi, hi, lut[hi, ki, t], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, cb=cb, block_q=bq,
+                          block_k=bk, causal=causal),
+        grid_spec=dkv_grid,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), vt.dtype),
+        ],
+        interpret=interpret_mode(),
+    )(layout.lut_q, layout.cnt_q, layout.fine_tiles, qt, kt, vt, dot, lse,
+      delta)
+
+    tr = lambda x: x.transpose(0, 2, 1, 3)
+    return tr(dq), tr(dk), tr(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _sparse_attn(q, k, v, layout, causal, scale):
+    out, _ = _sparse_fwd(q, k, v, layout, causal, scale)
+    return out
+
+
+def _sparse_attn_fwd(q, k, v, layout, causal, scale):
+    return _sparse_fwd(q, k, v, layout, causal, scale)
+
+
+def _sparse_attn_bwd(layout, causal, scale, res, g):
+    return _sparse_bwd(layout, causal, scale, res, g)
+
+
+_sparse_attn.defvjp(_sparse_attn_fwd, _sparse_attn_bwd)
+
+
+def sparse_attention(q, k, v, sparsity_config: SparsityConfig,
+                     sm_scale: Optional[float] = None,
+                     causal: Optional[bool] = None):
+    """Block-sparse attention. q, k, v: [B, S, H, D] -> [B, S, H, D].
+
+    ``causal=None`` derives causality from ``sparsity_config.attention``;
+    pass ``causal=True`` explicitly for autoregressive use (exact
+    elementwise masking, and the layout is tril-ified so dead tiles are
+    skipped). Compiled layouts (LUTs) are cached per (seq_len, causal) on
+    the config, mirroring the reference's master-layout buffering
+    (sparse_self_attention.py:57).
+    """
+    b, s, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if causal is None:
+        causal = getattr(sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+    cache = getattr(sparsity_config, "_layout_cache", None)
+    if cache is None:
+        cache = {}
+        sparsity_config._layout_cache = cache
+    key = (s, bool(causal))
+    if key not in cache:
+        fine = np.asarray(sparsity_config.make_layout(s), np.int64)
+        if fine.shape[0] != h:
+            raise ValueError(f"sparsity layout has {fine.shape[0]} heads, "
+                             f"tensors have {h}")
+        cb = sparsity_config.block
+        bq = _kernel_block(s, cb)
+        cache[key] = _CompiledLayout(fine, cb, bq, bq, causal)
+    layout = cache[key]
+    return _sparse_attn(q, k, v, layout, causal, scale)
