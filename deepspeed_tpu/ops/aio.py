@@ -1,0 +1,146 @@
+"""Async file I/O handle for NVMe offload (ZeRO-Infinity tier).
+
+Reference analogue: the ``aio_handle`` exposed from
+``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` (block_size / queue_depth
+knobs, async pread/pwrite + wait, sync variants) consumed by the
+swap_tensor swappers. Python fallback uses plain file I/O when the native
+build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import get_native_lib
+
+
+class AsyncIOHandle:
+    """Thread-pooled async file reader/writer over the native engine.
+
+    Usage (mirrors reference swap_tensor usage):
+        h = AsyncIOHandle(block_size=1 << 20, queue_depth=8)
+        h.async_pwrite(array, path); ...; h.wait()
+        h.async_pread(array, path); ...; h.wait()
+    """
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 num_threads: int = 0):
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self._lib = get_native_lib()
+        self._handle = None
+        self._fds = []          # fds held until wait()
+        self._pending_py = []   # python-fallback deferred ops
+        if self._lib is not None:
+            self._handle = self._lib.aio_handle_new(
+                block_size, queue_depth, num_threads or queue_depth)
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    # ------------------------------------------------------------- async
+    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0):
+        array = np.ascontiguousarray(array)
+        if self._handle is not None:
+            fd = self._lib.aio_open(path.encode(), 1, 0)
+            if fd < 0:
+                raise OSError(f"aio_open failed for {path}")
+            self._fds.append(fd)
+            self._lib.aio_pwrite(self._handle, fd,
+                                 array.ctypes.data_as(ctypes.c_void_p),
+                                 array.nbytes, offset)
+            self._keepalive = getattr(self, "_keepalive", [])
+            self._keepalive.append(array)
+        else:
+            self._pending_py.append(("w", array, path, offset))
+        return 1
+
+    def async_pread(self, array: np.ndarray, path: str, offset: int = 0):
+        assert array.flags["C_CONTIGUOUS"]
+        if self._handle is not None:
+            fd = self._lib.aio_open(path.encode(), 0, 0)
+            if fd < 0:
+                raise OSError(f"aio_open failed for {path}")
+            self._fds.append(fd)
+            self._lib.aio_pread(self._handle, fd,
+                                array.ctypes.data_as(ctypes.c_void_p),
+                                array.nbytes, offset)
+        else:
+            self._pending_py.append(("r", array, path, offset))
+        return 1
+
+    def wait(self) -> int:
+        if self._handle is not None:
+            rc = self._lib.aio_wait(self._handle)
+            for fd in self._fds:
+                self._lib.aio_close(fd)
+            self._fds.clear()
+            self._keepalive = []
+            if rc < 0:
+                raise OSError(f"aio_wait reported {-rc} failed chunks")
+            return 0
+        for op, array, path, offset in self._pending_py:
+            if op == "w":
+                self.sync_pwrite(array, path, offset)
+            else:
+                self.sync_pread(array, path, offset)
+        n = len(self._pending_py)
+        self._pending_py.clear()
+        return 0
+
+    # -------------------------------------------------------------- sync
+    def sync_pwrite(self, array: np.ndarray, path: str, offset: int = 0):
+        array = np.ascontiguousarray(array)
+        if self._lib is not None:
+            fd = self._lib.aio_open(path.encode(), 1, 0)
+            try:
+                rc = self._lib.aio_sync_pwrite(
+                    fd, array.ctypes.data_as(ctypes.c_void_p),
+                    array.nbytes, offset)
+            finally:
+                self._lib.aio_close(fd)
+            if rc != array.nbytes:
+                raise OSError(f"short write to {path}: {rc}")
+            return rc
+        with open(path, "r+b" if os.path.exists(path) else "wb") as f:
+            f.seek(offset)
+            f.write(array.tobytes())
+        return array.nbytes
+
+    def sync_pread(self, array: np.ndarray, path: str, offset: int = 0):
+        assert array.flags["C_CONTIGUOUS"]
+        if self._lib is not None:
+            fd = self._lib.aio_open(path.encode(), 0, 0)
+            try:
+                rc = self._lib.aio_sync_pread(
+                    fd, array.ctypes.data_as(ctypes.c_void_p),
+                    array.nbytes, offset)
+            finally:
+                self._lib.aio_close(fd)
+            if rc != array.nbytes:
+                raise OSError(f"short read from {path}: {rc}")
+            return rc
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(array.nbytes)
+        if len(data) != array.nbytes:
+            # match the native path: truncated swap files must fail loudly,
+            # not leave stale bytes in the destination tail
+            raise OSError(f"short read from {path}: {len(data)} of "
+                          f"{array.nbytes} bytes")
+        array.view(np.uint8)[:] = np.frombuffer(data, np.uint8)
+        return len(data)
+
+    def __del__(self):
+        try:
+            if self._handle is not None and self._lib is not None:
+                self._lib.aio_handle_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass

@@ -1,0 +1,144 @@
+"""Native op builder: JIT-compiles csrc/ into a shared library and loads it
+via ctypes.
+
+Reference analogue: ``op_builder/builder.py:107-720`` — the OpBuilder ABC
+with JIT compilation, compatibility probing (``is_compatible``), cpu-arch
+flag selection, and a build cache. Differences: no torch cpp_extension —
+plain g++ -shared -fPIC with ctypes bindings (the build contract allows
+ctypes/cffi/CPython API, not pybind11), cached per source-hash under
+~/.cache/deepspeed_tpu.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+from ..utils.logging import logger
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+_CACHE_DIR = os.environ.get(
+    "DS_BUILD_DIR", os.path.join(os.path.expanduser("~"), ".cache",
+                                 "deepspeed_tpu"))
+
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _cpu_arch_flags():
+    """-march flags gated on actual CPU support (reference
+    builder.py cpu_arch / simd_width probing)."""
+    flags = ["-O3", "-fopenmp", "-std=c++17"]
+    try:
+        cpuinfo = open("/proc/cpuinfo").read()
+        if "avx2" in cpuinfo:
+            flags += ["-mavx2", "-mfma"]
+        if "avx512f" in cpuinfo:
+            flags += ["-mavx512f"]
+    except OSError:
+        pass
+    return flags
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
+        if f.endswith(".cpp"))
+
+
+def build_native_lib(verbose: bool = False) -> Optional[str]:
+    """Compile csrc/*.cpp -> cached .so; returns path or None on failure."""
+    global _build_error
+    srcs = _sources()
+    if not srcs:
+        _build_error = "no csrc sources found"
+        return None
+    h = hashlib.sha256()
+    for s in srcs:
+        h.update(open(s, "rb").read())
+    tag = h.hexdigest()[:16]
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    out = os.path.join(_CACHE_DIR, f"libds_native_{tag}.so")
+    if os.path.exists(out):
+        return out
+    cmd = ["g++", "-shared", "-fPIC", *_cpu_arch_flags(), *srcs, "-o",
+           out + ".tmp", "-lpthread"]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _build_error = f"compiler launch failed: {e}"
+        return None
+    if res.returncode != 0:
+        _build_error = res.stderr[-2000:]
+        if verbose:
+            logger.warning(f"native build failed:\n{_build_error}")
+        return None
+    os.replace(out + ".tmp", out)
+    logger.info(f"built native lib: {out}")
+    return out
+
+
+def get_native_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build_native_lib()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    # ---- signatures ----
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    i64 = ctypes.c_int64
+    lib.ds_adam_step.argtypes = [f32p, f32p, f32p, f32p, i64,
+                                 ctypes.c_float, ctypes.c_float,
+                                 ctypes.c_float, ctypes.c_float,
+                                 ctypes.c_float, ctypes.c_int, i64]
+    lib.ds_adam_step.restype = None
+    lib.ds_adam_step_bf16.argtypes = [f32p, u16p, f32p, f32p, f32p, i64,
+                                      ctypes.c_float, ctypes.c_float,
+                                      ctypes.c_float, ctypes.c_float,
+                                      ctypes.c_float, ctypes.c_int, i64]
+    lib.ds_adam_step_bf16.restype = None
+    lib.ds_adagrad_step.argtypes = [f32p, f32p, f32p, i64, ctypes.c_float,
+                                    ctypes.c_float, ctypes.c_float]
+    lib.ds_adagrad_step.restype = None
+    lib.aio_handle_new.argtypes = [i64, ctypes.c_int, ctypes.c_int]
+    lib.aio_handle_new.restype = ctypes.c_void_p
+    lib.aio_handle_free.argtypes = [ctypes.c_void_p]
+    lib.aio_handle_free.restype = None
+    lib.aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.aio_open.restype = ctypes.c_int
+    lib.aio_close.argtypes = [ctypes.c_int]
+    lib.aio_close.restype = None
+    for fn in ("aio_pread", "aio_pwrite"):
+        g = getattr(lib, fn)
+        g.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, i64, i64]
+        g.restype = i64
+    lib.aio_wait.argtypes = [ctypes.c_void_p]
+    lib.aio_wait.restype = i64
+    for fn in ("aio_sync_pread", "aio_sync_pwrite"):
+        g = getattr(lib, fn)
+        g.argtypes = [ctypes.c_int, ctypes.c_void_p, i64, i64]
+        g.restype = i64
+    _lib = lib
+    return _lib
+
+
+def is_compatible() -> bool:
+    return get_native_lib() is not None
+
+
+def build_report() -> str:
+    """ds_report-style compatibility line (reference bin/ds_report)."""
+    lib = get_native_lib()
+    if lib is not None:
+        return f"native ops ............. OK ({_CACHE_DIR})"
+    return f"native ops ............. UNAVAILABLE ({_build_error})"

@@ -121,6 +121,12 @@ class DeepSpeedEngine:
         self.zero_stage = self.config.zero_optimization_stage
         self.rules = ShardingRules(self.mesh, self.zero_stage)
 
+        # ---- ZeRO-Offload / Infinity --------------------------------------
+        zc = self.config.zero_config
+        self.offload_device = zc.offload_optimizer.device
+        self.offload_enabled = self.offload_device in ("cpu", "nvme")
+        self._offload_nvme_path = zc.offload_optimizer.nvme_path
+
         # ---- parameters ----------------------------------------------------
         if model_parameters is None:
             raise ValueError(
@@ -192,6 +198,8 @@ class DeepSpeedEngine:
         self._client_optimizer = None
 
     def _rebuild_optimizer_with_schedule(self):
+        if self.offload_enabled:
+            return  # lr comes from get_lr() at each host step
         if self._client_optimizer is not None:
             self.optimizer = self._client_optimizer
             return
@@ -207,6 +215,9 @@ class DeepSpeedEngine:
             self._init_opt_state()
 
     def _init_state(self, model_parameters, optimizer, rng):
+        if self.offload_enabled:
+            self._init_offload_state(model_parameters, optimizer, rng)
+            return
         self._build_base_optimizer(optimizer)
 
         # copy (not alias) the user's params: engine state buffers are donated
@@ -284,13 +295,18 @@ class DeepSpeedEngine:
 
     def get_lr(self):
         if self.lr_scheduler is not None:
-            count = getattr(self.state["opt"], "count", None)
-            count = int(jax.device_get(count)) if count is not None else self.global_steps
+            if self.offload_enabled:
+                count = self.host_optimizer.step_count
+            else:
+                count = getattr(self.state["opt"], "count", None)
+                count = int(jax.device_get(count)) if count is not None else self.global_steps
             return [float(jax.device_get(self.lr_scheduler.lr_at(jnp.asarray(count, jnp.float32))))]
         return [self._base_lr if self._client_optimizer is None else float("nan")]
 
     @property
     def loss_scale(self):
+        if self.offload_enabled:
+            return float(self._host_scale)
         return float(jax.device_get(self.state["scale"].cur_scale))
 
     # ------------------------------------------------------------- model fns
@@ -425,6 +441,16 @@ class DeepSpeedEngine:
         batches = jax.tree.map(lambda *xs: np.stack(xs), *micros)
         batches = self._shard_batch(batches, stacked=True)
 
+        if self.offload_enabled:
+            self.tput_timer.start()
+            metrics = self._offload_train_batch(batches)
+            self.tput_timer.stop(sync=metrics["loss"])
+            self.global_steps += 1
+            self.micro_steps += gas
+            self.global_samples += self.train_batch_size()
+            self._after_step(metrics)
+            return metrics["loss"]
+
         if self._jit_train is None:
             self._jit_train = self._build_train_jit()
 
@@ -441,6 +467,11 @@ class DeepSpeedEngine:
     # --- 3-call parity API -------------------------------------------------
     def forward(self, batch):
         """Run one micro forward(+grad) and buffer the accumulation."""
+        if self.offload_enabled:
+            raise NotImplementedError(
+                "with offload_optimizer use engine.train_batch(data_iter) — "
+                "the offload path fuses the micro loop with the host "
+                "optimizer round-trip")
         if self._jit_micro is None:
             def micro(state, batch):
                 rng, sub = jax.random.split(state["rng"])
@@ -508,15 +539,20 @@ class DeepSpeedEngine:
     # ---------------------------------------------------------------- eval
     def eval_batch(self, batch):
         if not hasattr(self, "_jit_eval"):
+            cast = not self.offload_enabled
             def ev(master, batch, rng):
-                params = _cast_tree(master, self.compute_dtype)
+                params = _cast_tree(master, self.compute_dtype) if cast else master
                 return self._loss_of(params, batch, rng, train=False)
             self._jit_eval = jax.jit(ev)
         batch = self._shard_batch(batch)
-        return self._jit_eval(self.state["master"], batch, self.state["rng"])
+        src = self.state["params"] if self.offload_enabled else self.state["master"]
+        return self._jit_eval(src, batch, self.state["rng"])
 
     def get_params(self, dtype=None):
         """Current (compute-dtype) parameters as a pytree."""
+        if self.offload_enabled:
+            return _cast_tree(self.state["params"],
+                              dtype or self.compute_dtype)
         return _cast_tree(self.state["master"], dtype or self.compute_dtype)
 
     # ------------------------------------------------------------ dataloader
@@ -535,13 +571,19 @@ class DeepSpeedEngine:
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "micro_steps": self.micro_steps,
-            "skipped_steps": int(jax.device_get(self.state["skipped"])),
+            "skipped_steps": (self.skipped_steps if self.offload_enabled
+                              else int(jax.device_get(self.state["skipped"]))),
             "loss_scale": self.loss_scale,
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else None,
             "zero_stage": self.zero_stage,
             "dp_world_size": self.dp_world_size,
             "client_state": client_state or {},
         }
+        if self.offload_enabled:
+            return ckpt_saving.save_checkpoint_dir(
+                save_dir, tag,
+                master_params=self.host_optimizer.master_tree(),
+                opt_state=self.host_optimizer.opt_state_tree(), meta=meta)
         return ckpt_saving.save_checkpoint_dir(
             save_dir, tag, master_params=self.state["master"],
             opt_state=self.state["opt"], meta=meta)
@@ -550,32 +592,209 @@ class DeepSpeedEngine:
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True,
                         load_module_only=False):
-        res = ckpt_saving.load_checkpoint_dir(
-            load_dir, tag, master_template=self.state["master"],
-            opt_template=self.state["opt"],
-            master_shardings=self.master_shardings,
-            opt_shardings=self.opt_shardings)
+        if self.offload_enabled:
+            res = ckpt_saving.load_checkpoint_dir(
+                load_dir, tag,
+                master_template=self.host_optimizer.master_tree(),
+                opt_template=self.host_optimizer.opt_state_tree(),
+                master_shardings=None, opt_shardings=None)
+        else:
+            res = ckpt_saving.load_checkpoint_dir(
+                load_dir, tag, master_template=self.state["master"],
+                opt_template=self.state["opt"],
+                master_shardings=self.master_shardings,
+                opt_shardings=self.opt_shardings)
         if res is None:
             log_dist(f"no checkpoint found in {load_dir}", ranks=[0])
             return None, {}
         meta = res["meta"]
-        self.state["master"] = res["master_params"]
-        if load_optimizer_states and not load_module_only:
-            self.state["opt"] = res["opt_state"]
+        if self.offload_enabled:
+            self.host_optimizer.load_state(
+                master_tree=res["master_params"],
+                opt_state=(res["opt_state"] if load_optimizer_states
+                           and not load_module_only else None))
+            self.state["params"] = jax.device_put(
+                self.host_optimizer.mirror_tree(), self.param_shardings)
+            self._host_scale = float(meta["loss_scale"])
+        else:
+            self.state["master"] = res["master_params"]
+            if load_optimizer_states and not load_module_only:
+                self.state["opt"] = res["opt_state"]
+            sc = self.state["scale"]
+            self.state["scale"] = sc._replace(
+                cur_scale=jnp.asarray(meta["loss_scale"], jnp.float32))
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
         self.micro_steps = meta["micro_steps"]
-        sc = self.state["scale"]
-        self.state["scale"] = sc._replace(
-            cur_scale=jnp.asarray(meta["loss_scale"], jnp.float32))
         log_dist(f"loaded checkpoint tag={res['tag']} step={self.global_steps}",
                  ranks=[0])
         return os.path.join(load_dir, res["tag"]), meta.get("client_state", {})
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
         os.makedirs(save_dir, exist_ok=True)
-        params16 = _cast_tree(self.state["master"], self.compute_dtype)
+        if self.offload_enabled:
+            params16 = self.host_optimizer.mirror_tree()
+        else:
+            params16 = _cast_tree(self.state["master"], self.compute_dtype)
         ckpt_saving.save_tree(os.path.join(save_dir, save_filename), params16)
         return True
+
+    # =====================================================================
+    # ZeRO-Offload / Infinity path: optimizer state lives in host DRAM (or
+    # NVMe); the device program computes only grads. See
+    # runtime/zero/offload.py for the design note and reference citations.
+    # =====================================================================
+
+    def _init_offload_state(self, model_parameters, optimizer, rng):
+        from .zero.offload import HostOffloadOptimizer
+
+        if optimizer is not None:
+            raise ValueError(
+                "offload_optimizer is driven by the config optimizer; do "
+                "not pass a client optax optimizer")
+        oc = self.config.optimizer
+        params = dict(oc.params) if oc else {}
+        otype = (oc.type if oc else "Adam").lower()
+        if otype not in ("adam", "adamw", "fusedadam", "cpuadam"):
+            raise ValueError(
+                f"offload_optimizer supports Adam/AdamW, got {oc.type!r}")
+        self._base_lr = params.get("lr", 1e-3)
+        mirror = jnp.dtype(self.compute_dtype).name
+        nvme = self._offload_nvme_path if self.offload_device == "nvme" else None
+        if self.offload_device == "nvme" and not nvme:
+            raise ValueError("offload_optimizer.device=nvme requires nvme_path")
+        self.host_optimizer = HostOffloadOptimizer(
+            model_parameters,
+            lr=self._base_lr,
+            betas=tuple(params.get("betas", (0.9, 0.999))),
+            eps=params.get("eps", 1e-8),
+            weight_decay=params.get("weight_decay", 0.0),
+            adamw=(otype != "adam"),
+            mirror_dtype=mirror,
+            nvme_path=nvme,
+            aio_cfg=getattr(self.config, "aio", None))
+        self.optimizer = None
+        self._client_optimizer = None
+
+        self.master_shardings = self.rules.shardings(
+            self.rules.master_specs(model_parameters))
+        self.param_shardings = self.rules.shardings(
+            self.rules.param_specs(model_parameters))
+        self.grad_shardings = self.rules.shardings(
+            self.rules.grad_specs(model_parameters))
+
+        if rng is None:
+            rng = jax.random.PRNGKey(self.config.seed)
+        dev_params = jax.device_put(self.host_optimizer.mirror_tree(),
+                                    self.param_shardings)
+        zeros = jax.jit(
+            lambda t: jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), t),
+            out_shardings=self.grad_shardings)(dev_params)
+        self.state = {"params": dev_params, "acc": zeros, "rng": rng}
+        self._off_state_shardings = {
+            "params": self.param_shardings,
+            "acc": self.grad_shardings,
+            "rng": NamedSharding(self.mesh, P()),
+        }
+        # host-side loss-scale bookkeeping (fp16 only)
+        self._host_scale = (self.config.fp16.loss_scale
+                            if (self.fp16_enabled and
+                                self.config.fp16.loss_scale > 0)
+                            else 2.0 ** self.config.fp16.initial_scale_power
+                            if self.fp16_enabled else 1.0)
+        self._host_hysteresis = self.config.fp16.hysteresis
+        self._host_scale_step = 0
+        self._host_last_overflow = -1
+        log_dist(
+            f"ZeRO-Offload ready: {self.host_optimizer.numel():,} params on "
+            f"host ({self.offload_device}), native={self.host_optimizer.native}",
+            ranks=[0])
+
+    def _build_offload_jit(self):
+        gas = self.gradient_accumulation_steps()
+
+        def train_grads(state, batches, scale):
+            def body(carry, batch):
+                acc, loss_sum, rng = carry
+                rng, sub = jax.random.split(rng)
+
+                def scaled_loss(p):
+                    loss = self._loss_of(p, batch, sub)
+                    return loss.astype(jnp.float32) * scale, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    scaled_loss, has_aux=True)(state["params"])
+                grads = _cast_tree(grads, jnp.float32)
+                acc = jax.tree.map(jnp.add, acc, grads)
+                acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
+                return (acc, loss_sum + loss.astype(jnp.float32), rng), None
+
+            (acc, loss_sum, rng), _ = jax.lax.scan(
+                body, (state["acc"], jnp.zeros((), jnp.float32),
+                       state["rng"]), batches)
+            denom = scale * gas
+            grads = jax.tree.map(lambda a: a / denom, acc)
+            finite = grads_finite(grads) if self.fp16_enabled else jnp.asarray(True)
+            gnorm = _global_norm(grads)
+            zeros = jax.tree.map(jnp.zeros_like, acc)
+            new_state = dict(state, acc=zeros, rng=rng)
+            return new_state, grads, {"loss": loss_sum / gas,
+                                      "grad_norm": gnorm, "finite": finite}
+
+        return jax.jit(train_grads, donate_argnums=(0,),
+                       out_shardings=(self._off_state_shardings,
+                                      self.grad_shardings, None))
+
+    def _host_update_scale(self, finite: bool):
+        """Host mirror of fp16/loss_scaler.update_scale dynamics — same
+        hysteresis (consecutive overflows within the hysteresis budget do
+        not shrink again) and same clean-window growth."""
+        if not (self.fp16_enabled and self.dynamic_loss_scale):
+            return
+        self._host_scale_step += 1
+        step = self._host_scale_step
+        window = self.config.fp16.loss_scale_window
+        if finite:
+            since = step - self._host_last_overflow
+            if since >= window and since % window == 0:
+                self._host_scale *= 2.0
+        else:
+            if self._host_hysteresis <= 1:
+                self._host_scale = max(self._host_scale / 2.0,
+                                       self.config.fp16.min_loss_scale)
+                self._host_hysteresis = self.config.fp16.hysteresis
+            else:
+                self._host_hysteresis -= 1
+            self._host_last_overflow = step
+
+    def _offload_train_batch(self, batches):
+        if self._jit_train is None:
+            self._jit_train = self._build_offload_jit()
+        scale = jnp.asarray(self._host_scale, jnp.float32)
+        self.state, grads, metrics = self._jit_train(self.state, batches,
+                                                     scale)
+        finite = bool(jax.device_get(metrics["finite"]))
+        gnorm = float(jax.device_get(metrics["grad_norm"]))
+        if finite:
+            clip = self.gradient_clipping()
+            combined = 1.0
+            if clip and clip > 0 and gnorm > clip:
+                combined = gnorm / clip       # divide grads by this
+            lr = self.get_lr()[0]
+            g_np = [np.asarray(g) for g in jax.tree.leaves(
+                jax.device_get(grads))]
+            self.host_optimizer.step(g_np, lr=lr, combined_scale=combined)
+            self.state["params"] = jax.device_put(
+                self.host_optimizer.mirror_tree(), self.param_shardings)
+        else:
+            self.skipped_steps += 1
+        self._host_update_scale(finite)
+        self._last_grad_norm = gnorm
+        return metrics
+
+    @property
+    def _offload_loss_scale(self):
+        return self._host_scale

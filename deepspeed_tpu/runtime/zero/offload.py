@@ -1,0 +1,270 @@
+"""ZeRO-Offload / ZeRO-Infinity: optimizer state in host DRAM or on NVMe.
+
+Reference analogues:
+  * ZeRO-Offload — grads stream to host, CPU-Adam steps the fp32 master
+    partition, updated fp16 params stream back
+    (``runtime/zero/stage_1_and_2.py:1014`` async grad offload +
+    ``ops/adam/cpu_adam.py`` + step tail allgather).
+  * ZeRO-Infinity — optimizer state tiered to NVMe with double-buffered
+    swap-in/step/swap-out overlap
+    (``swap_tensor/partitioned_optimizer_swapper.py:28`` sync and
+    ``pipelined_optimizer_swapper.py:61`` pipelined variants; bounded
+    pinned-buffer pool per ``offload_config`` buffer_count/buffer_size).
+
+TPU-native shape of the same design: the jitted device program computes
+*only* grads (accumulated, reduce-scattered over dp by GSPMD); one
+device_get lands each host-shard of grads in DRAM; the native SIMD Adam
+(csrc/cpu_adam.cpp) steps master+moments and emits a bf16 mirror; one
+device_put ships the mirror back as the next step's working params.
+
+Memory model per parameter:
+  * device=cpu : master (4B) + moments (8B) + mirror (<=4B) in DRAM.
+  * device=nvme: master+moments (12B) live in per-leaf files; DRAM holds
+    only the compute-dtype mirror (2B for bf16) plus TWO bounded swap
+    buffers sized by the largest leaf — leaf i+1's read overlaps leaf i's
+    step through the aio engine (csrc/aio.cpp). This is the capacity tier
+    that fits 175B-class optimizer state on a host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+try:
+    import ml_dtypes
+    _BF16 = ml_dtypes.bfloat16
+except ImportError:  # ml_dtypes ships with jax; belt and braces
+    _BF16 = None
+
+from ...ops.aio import AsyncIOHandle
+from ...ops.cpu_adam import DeepSpeedCPUAdam, f32_to_bf16_bits
+from ...utils.logging import log_dist
+from ..sharding import path_str
+
+
+class _Leaf:
+    """Host bookkeeping for one parameter leaf. In DRAM mode owns the
+    master/moment arrays; in NVMe mode owns only the mirror (master and
+    moments live in the swap file, staged through shared buffers)."""
+
+    def __init__(self, path: str, value, mirror_dtype: str, resident: bool):
+        self.path = path
+        arr = np.asarray(value)
+        self.shape = arr.shape
+        self.numel = int(arr.size)
+        self.mirror_dtype = mirror_dtype
+        # ALWAYS copy: np.asarray on CPU-backend jax arrays can be
+        # zero-copy, and the native optimizer writes through raw pointers —
+        # aliasing the caller's (or another engine's) buffer would mutate it
+        master = np.array(arr, dtype=np.float32, copy=True).reshape(-1)
+        if resident:
+            self.master: Optional[np.ndarray] = master
+            self.exp_avg: Optional[np.ndarray] = np.zeros_like(master)
+            self.exp_avg_sq: Optional[np.ndarray] = np.zeros_like(master)
+        else:
+            self.master = self.exp_avg = self.exp_avg_sq = None
+        if mirror_dtype == "bfloat16":
+            self.mirror_buf = f32_to_bf16_bits(master)
+        elif mirror_dtype == "float16":
+            self.mirror_buf = master.astype(np.float16)
+        else:
+            self.mirror_buf = master.copy() if not resident else None
+        self._init_master = None if resident else master  # for swap init
+
+    def sync_mirror(self, master: np.ndarray):
+        if self.mirror_dtype == "bfloat16":
+            f32_to_bf16_bits(master, out=self.mirror_buf)
+        elif self.mirror_dtype == "float16":
+            self.mirror_buf[:] = master.astype(np.float16)
+        elif self.mirror_buf is not None:
+            self.mirror_buf[:] = master
+
+    def mirror(self) -> np.ndarray:
+        """Working-copy view in the compute dtype, shaped like the param."""
+        if self.mirror_dtype == "bfloat16":
+            return self.mirror_buf.view(_BF16).reshape(self.shape)
+        if self.mirror_buf is not None:
+            return self.mirror_buf.reshape(self.shape)
+        return self.master.reshape(self.shape)  # resident fp32: no copy
+
+
+class NVMeLeafSwapper:
+    """Per-leaf [master | exp_avg | exp_avg_sq] files with double-buffered
+    async swap (reference PipelinedOptimizerSwapper:61). DRAM footprint is
+    exactly two buffers of 3x the largest leaf."""
+
+    def __init__(self, nvme_path: str, max_numel: int, aio_cfg=None):
+        self.dir = os.path.join(nvme_path, "zero_offload_swap")
+        os.makedirs(self.dir, exist_ok=True)
+        bs = getattr(aio_cfg, "block_size", 1 << 20)
+        qd = getattr(aio_cfg, "queue_depth", 8)
+        self.read_handle = AsyncIOHandle(block_size=bs, queue_depth=qd)
+        self.write_handle = AsyncIOHandle(block_size=bs, queue_depth=qd)
+        self.slots = [np.empty(3 * max_numel, np.float32) for _ in range(2)]
+
+    def _file(self, idx: int) -> str:
+        return os.path.join(self.dir, f"leaf_{idx}.bin")
+
+    def write_init(self, idx: int, master: np.ndarray):
+        buf = np.concatenate([master, np.zeros_like(master),
+                              np.zeros_like(master)])
+        self.write_handle.sync_pwrite(buf, self._file(idx))
+
+    def start_read(self, idx: int, numel: int, slot: int):
+        view = self.slots[slot][:3 * numel]
+        self.read_handle.async_pread(view, self._file(idx))
+
+    def finish_reads(self):
+        self.read_handle.wait()
+
+    def views(self, numel: int, slot: int):
+        buf = self.slots[slot]
+        return (buf[:numel], buf[numel:2 * numel], buf[2 * numel:3 * numel])
+
+    def start_write(self, idx: int, numel: int, slot: int):
+        self.write_handle.async_pwrite(self.slots[slot][:3 * numel],
+                                       self._file(idx))
+
+    def finish_writes(self):
+        self.write_handle.wait()
+
+    def read_sync(self, idx: int, numel: int, slot: int = 0):
+        self.start_read(idx, numel, slot)
+        self.finish_reads()
+        return self.views(numel, slot)
+
+    def write_sync(self, idx: int, numel: int, slot: int = 0):
+        self.start_write(idx, numel, slot)
+        self.finish_writes()
+
+
+class HostOffloadOptimizer:
+    """Flat-per-leaf host master + Adam moments; optional NVMe tier."""
+
+    def __init__(self, params_tree, *, lr: float, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw: bool = True, mirror_dtype: str = "bfloat16",
+                 nvme_path: Optional[str] = None, aio_cfg=None):
+        self.opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
+                                    weight_decay=weight_decay,
+                                    adamw_mode=adamw)
+        self.step_count = 0
+        self.nvme = nvme_path is not None
+        self.treedef = jax.tree_util.tree_structure(params_tree)
+        flat, _ = jax.tree_util.tree_flatten_with_path(params_tree)
+        self.leaves: List[_Leaf] = [
+            _Leaf(path_str(p), leaf, mirror_dtype, resident=not self.nvme)
+            for p, leaf in flat]
+        self.swapper = None
+        if self.nvme:
+            max_numel = max(l.numel for l in self.leaves)
+            self.swapper = NVMeLeafSwapper(nvme_path, max_numel, aio_cfg)
+            for i, leaf in enumerate(self.leaves):
+                self.swapper.write_init(i, leaf._init_master)
+                leaf._init_master = None  # DRAM reclaimed
+            log_dist(
+                f"NVMe offload: master+moments for {len(self.leaves)} leaves "
+                f"({self.numel():,} params, "
+                f"{12 * self.numel() / 1e9:.2f} GB) swapped to "
+                f"{self.swapper.dir}; DRAM window = 2 x "
+                f"{3 * max_numel * 4 / 1e6:.1f} MB", ranks=[0])
+
+    @property
+    def native(self) -> bool:
+        return self.opt.native
+
+    def numel(self) -> int:
+        return sum(l.numel for l in self.leaves)
+
+    # ------------------------------------------------------------- step
+    def step(self, grads_flat: List[np.ndarray], lr: float,
+             combined_scale: float = 1.0) -> None:
+        """One optimizer step over all leaves. ``grads_flat`` must align
+        with the flattened param order. ``combined_scale`` divides grads
+        (loss-scale unscaling x grad clipping)."""
+        self.step_count += 1
+        inv = np.float32(1.0 / combined_scale) if combined_scale != 1.0 else None
+
+        if self.swapper is not None:
+            sw = self.swapper
+            sw.start_read(0, self.leaves[0].numel, slot=0)
+            for i, leaf in enumerate(self.leaves):
+                slot = i % 2
+                sw.finish_reads()
+                if i + 1 < len(self.leaves):
+                    # the other slot may still be flushing leaf i-1
+                    sw.finish_writes()
+                    sw.start_read(i + 1, self.leaves[i + 1].numel,
+                                  slot=(i + 1) % 2)
+                master, m, v = sw.views(leaf.numel, slot)
+                self._step_arrays(leaf, master, m, v, grads_flat[i], lr, inv)
+                sw.start_write(i, leaf.numel, slot)
+            sw.finish_writes()
+        else:
+            for i, leaf in enumerate(self.leaves):
+                self._step_arrays(leaf, leaf.master, leaf.exp_avg,
+                                  leaf.exp_avg_sq, grads_flat[i], lr, inv)
+
+    def _step_arrays(self, leaf: _Leaf, master, m, v, grad, lr, inv):
+        g = np.ascontiguousarray(np.asarray(grad).reshape(-1), np.float32)
+        if inv is not None:
+            g = g * inv
+        bf16 = leaf.mirror_buf if leaf.mirror_dtype == "bfloat16" else None
+        self.opt.step(master, g, m, v, params_bf16=bf16, lr=lr,
+                      step=self.step_count)
+        if bf16 is None:
+            leaf.sync_mirror(master)
+
+    # -------------------------------------------------------- tree views
+    def mirror_tree(self):
+        """Compute-dtype params pytree (numpy) for device_put."""
+        return jax.tree_util.tree_unflatten(
+            self.treedef, [l.mirror() for l in self.leaves])
+
+    def _gather(self, which: str):
+        out = []
+        for i, leaf in enumerate(self.leaves):
+            if self.swapper is not None:
+                master, m, v = self.swapper.read_sync(i, leaf.numel)
+            else:
+                master, m, v = leaf.master, leaf.exp_avg, leaf.exp_avg_sq
+            src = {"master": master, "exp_avg": m, "exp_avg_sq": v}[which]
+            out.append(np.array(src, copy=True).reshape(leaf.shape))
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def master_tree(self):
+        return self._gather("master")
+
+    def opt_state_tree(self) -> Dict[str, Any]:
+        return {"exp_avg": self._gather("exp_avg"),
+                "exp_avg_sq": self._gather("exp_avg_sq"),
+                "step": np.asarray(self.step_count, np.int64)}
+
+    def load_state(self, master_tree=None, opt_state=None):
+        new_master = ([np.asarray(x, np.float32).reshape(-1) for x in
+                       jax.tree_util.tree_leaves(master_tree)]
+                      if master_tree is not None else None)
+        new_m = new_v = None
+        if opt_state is not None:
+            new_m = [np.asarray(x, np.float32).reshape(-1) for x in
+                     jax.tree_util.tree_leaves(opt_state["exp_avg"])]
+            new_v = [np.asarray(x, np.float32).reshape(-1) for x in
+                     jax.tree_util.tree_leaves(opt_state["exp_avg_sq"])]
+            self.step_count = int(opt_state.get("step", self.step_count))
+        for i, leaf in enumerate(self.leaves):
+            if self.swapper is not None:
+                master, m, v = self.swapper.read_sync(i, leaf.numel)
+            else:
+                master, m, v = leaf.master, leaf.exp_avg, leaf.exp_avg_sq
+            if new_master is not None:
+                master[:] = new_master[i]
+                leaf.sync_mirror(master)
+            if new_m is not None:
+                m[:] = new_m[i]
+                v[:] = new_v[i]
+            if self.swapper is not None:
+                self.swapper.write_sync(i, leaf.numel)

@@ -1,0 +1,155 @@
+"""Native op tests (reference analogue: tests/unit/test_cpu_adam.py —
+CPU-Adam vs torch Adam parity — and tests/unit/test_aio.py)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad
+from deepspeed_tpu.ops.op_builder import get_native_lib
+
+
+def _ref_adam(params, grads, m, v, lr, b1, b2, eps, wd, adamw, step):
+    """Straight-line numpy Adam for parity checking."""
+    p, g, m, v = (x.astype(np.float64) for x in (params, grads, m, v))
+    if wd:
+        if adamw:
+            p = p * (1 - lr * wd)
+        else:
+            g = g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    denom = np.sqrt(v) / np.sqrt(1 - b2 ** step) + eps
+    p = p - (lr / (1 - b1 ** step)) * m / denom
+    return p, m, v
+
+
+def test_native_lib_builds():
+    assert get_native_lib() is not None, "native library must build"
+
+
+@pytest.mark.parametrize("adamw", [True, False])
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_cpu_adam_matches_reference(adamw, wd):
+    rng = np.random.default_rng(0)
+    n = 10_001  # odd size exercises the SIMD tail
+    params = rng.normal(size=n).astype(np.float32)
+    grads = rng.normal(size=n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2, weight_decay=wd, adamw_mode=adamw)
+    assert opt.native
+
+    ref_p, ref_m, ref_v = params.copy(), m.copy(), v.copy()
+    for step in range(1, 4):
+        opt.step(params, grads, m, v)
+        ref_p, ref_m, ref_v = _ref_adam(ref_p, grads, ref_m, ref_v, 1e-2,
+                                        0.9, 0.999, 1e-8, wd, adamw, step)
+    np.testing.assert_allclose(params, ref_p, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m, ref_m, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(v, ref_v, rtol=2e-5, atol=2e-6)
+
+
+def test_cpu_adam_bf16_mirror():
+    rng = np.random.default_rng(1)
+    n = 512
+    params = rng.normal(size=n).astype(np.float32)
+    bf16 = np.zeros(n, np.uint16)
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    opt.step(params, rng.normal(size=n).astype(np.float32),
+             np.zeros(n, np.float32), np.zeros(n, np.float32),
+             params_bf16=bf16)
+    # reinterpret mirror as bf16 and compare to fp32 params
+    import jax.numpy as jnp
+    mirrored = np.asarray(jnp.asarray(bf16).view(jnp.bfloat16),
+                          np.float32)
+    np.testing.assert_allclose(mirrored, params, rtol=1e-2, atol=1e-2)
+
+
+def test_cpu_adam_numpy_fallback_matches_native():
+    rng = np.random.default_rng(2)
+    n = 4097
+    p1 = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m1, v1 = np.zeros(n, np.float32), np.zeros(n, np.float32)
+    p2, m2, v2 = p1.copy(), m1.copy(), v1.copy()
+
+    native = DeepSpeedCPUAdam(lr=1e-2, weight_decay=0.01)
+    fallback = DeepSpeedCPUAdam(lr=1e-2, weight_decay=0.01)
+    fallback._lib = None
+    for step in range(1, 3):
+        native.step(p1, g, m1, v1)
+        fallback.step(p2, g, m2, v2)
+    np.testing.assert_allclose(p1, p2, rtol=3e-5, atol=3e-6)
+
+
+def test_cpu_adagrad():
+    rng = np.random.default_rng(3)
+    n = 1000
+    params = rng.normal(size=n).astype(np.float32)
+    grads = rng.normal(size=n).astype(np.float32)
+    sq = np.zeros(n, np.float32)
+    ref = params - 1e-2 * grads / (np.abs(grads) + 1e-10)
+    DeepSpeedCPUAdagrad(lr=1e-2).step(params, grads, sq)
+    np.testing.assert_allclose(params, ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(sq, grads * grads, rtol=1e-6)
+
+
+# ------------------------------------------------------------------- aio
+
+def test_aio_roundtrip_async(tmp_path):
+    h = AsyncIOHandle(block_size=1 << 16, queue_depth=4)
+    rng = np.random.default_rng(0)
+    arrays = [rng.normal(size=100_003).astype(np.float32) for _ in range(4)]
+    paths = [str(tmp_path / f"shard_{i}.bin") for i in range(4)]
+    for a, p in zip(arrays, paths):
+        h.async_pwrite(a, p)
+    assert h.wait() == 0
+    outs = [np.empty_like(a) for a in arrays]
+    for o, p in zip(outs, paths):
+        h.async_pread(o, p)
+    assert h.wait() == 0
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+
+
+def test_aio_sync_roundtrip_with_offset(tmp_path):
+    h = AsyncIOHandle()
+    path = str(tmp_path / "f.bin")
+    a = np.arange(1000, dtype=np.float32)
+    b = np.arange(1000, 2000, dtype=np.float32)
+    h.sync_pwrite(a, path, offset=0)
+    h.sync_pwrite(b, path, offset=a.nbytes)
+    out = np.empty(1000, np.float32)
+    h.sync_pread(out, path, offset=a.nbytes)
+    np.testing.assert_array_equal(out, b)
+
+
+def test_aio_python_fallback(tmp_path):
+    h = AsyncIOHandle()
+    h._lib = None
+    h._handle = None
+    a = np.arange(64, dtype=np.float32)
+    path = str(tmp_path / "fb.bin")
+    h.async_pwrite(a, path)
+    h.wait()
+    out = np.empty_like(a)
+    h.async_pread(out, path)
+    h.wait()
+    np.testing.assert_array_equal(a, out)
+
+
+def test_aio_throughput_smoke(tmp_path):
+    """The async path must at least not be pathologically slow (reference
+    perf tests tests/benchmarks)."""
+    h = AsyncIOHandle(block_size=1 << 20, queue_depth=8)
+    a = np.random.default_rng(0).normal(size=4 << 20).astype(np.float32)
+    path = str(tmp_path / "big.bin")
+    t0 = time.time()
+    h.async_pwrite(a, path)
+    h.wait()
+    dt = time.time() - t0
+    assert dt < 10.0  # 16 MB in <10s even on slow disks
