@@ -65,6 +65,20 @@ def load_tree_arrays(path: str) -> Dict[str, np.ndarray]:
         return {k: f[k] for k in f.files}
 
 
+def unflatten_tree(arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Rebuild a nested dict tree from '/'-joined path keys (inverse of
+    ``_flatten`` for dict-of-dict param trees — the template-free load used
+    by the inference engine's checkpoint path)."""
+    root: Dict[str, Any] = {}
+    for key, arr in arrays.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root
+
+
 def save_checkpoint_dir(save_dir: str, tag: str, *, master_params, opt_state,
                         meta: Dict[str, Any]) -> str:
     ckpt_dir = os.path.join(save_dir, tag)

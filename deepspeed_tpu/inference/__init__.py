@@ -1,0 +1,3 @@
+"""Inference subsystem (reference: deepspeed/inference/)."""
+
+from .engine import InferenceEngine

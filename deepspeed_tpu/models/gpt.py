@@ -23,6 +23,7 @@ the engine milestones (BASELINE.json configs: GPT-2 125M -> GPT-NeoX 20B ->
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Callable, Optional
 
@@ -51,6 +52,12 @@ class GPTConfig:
     attention_impl: str = "xla"      # xla | pallas | sparse
     sparse_attention: Any = None     # SparsityConfig when attention_impl=sparse
     layer_norm_eps: float = 1e-5
+    # attention-score scale; None -> 1/sqrt(head_dim). GPT-Neo uses 1.0.
+    qk_scale: Any = None
+    # per-layer local-attention windows (None entry = global); requires
+    # scan_layers=False since layers become heterogeneous (GPT-Neo
+    # alternates global/local-256)
+    attn_windows: Any = None
     # --- MoE (reference: deepspeed/moe/; MoE-NLG model family) ------------
     moe: bool = False
     num_experts: int = 1
@@ -115,20 +122,29 @@ def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray, rotary_dim: int):
 
 
 def causal_attention(q, k, v, *, dtype, impl: str = "xla", sparse_config=None,
-                     mask: Optional[jnp.ndarray] = None):
-    """q,k,v: [B, S, H, D]. Routes to the configured attention kernel."""
-    if impl == "pallas":
+                     mask: Optional[jnp.ndarray] = None,
+                     scale: Optional[float] = None,
+                     window: Optional[int] = None):
+    """q,k,v: [B, S, H, D]. Routes to the configured attention kernel.
+    ``window``: local (sliding-window) attention over the last N keys."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl == "pallas" and window is None:
         from ..ops.pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, sm_scale=scale)
     if impl == "sparse" and sparse_config is not None:
         from ..ops.sparse_attention.sparse_self_attention import sparse_attention
         # causal=True regardless of the layout's attention mode: a decoder
         # LM must never see the future even through a bidirectional layout
-        return sparse_attention(q, k, v, sparse_config, causal=True)
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+        return sparse_attention(q, k, v, sparse_config, sm_scale=scale,
+                                causal=True)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     s = q.shape[1]
     causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    if window is not None:
+        causal = jnp.logical_and(causal,
+                                 jnp.triu(jnp.ones((s, s), dtype=bool),
+                                          k=-(window - 1)))
     logits = jnp.where(causal[None, None], logits, -1e10)
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :], logits, -1e10)
@@ -138,9 +154,14 @@ def causal_attention(q, k, v, *, dtype, impl: str = "xla", sparse_config=None,
 
 class SelfAttention(nn.Module):
     cfg: GPTConfig
+    window: Optional[int] = None    # local-attention window (GPT-Neo style)
 
     @nn.compact
     def __call__(self, x, positions, deterministic=True):
+        """Training/prefill path (full sequence) OR single-token decode when
+        a ``cache`` variable collection is mutable (flax autoregressive
+        cache idiom — the TPU analogue of the reference inference kernel's
+        KV-cache arena, csrc/transformer/inference/includes/context.h)."""
         cfg = self.cfg
         qkv = nn.Dense(3 * cfg.d_model, use_bias=True, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="qkv")(x)
@@ -152,12 +173,51 @@ class SelfAttention(nn.Module):
             rd = int(cfg.rotary_pct * cfg.head_dim)
             q = rotary_embedding(q, positions, rd)
             k = rotary_embedding(k, positions, rd)
-        out = causal_attention(q, k, v, dtype=cfg.dtype,
-                               impl=cfg.attention_impl,
-                               sparse_config=cfg.sparse_attention)
+
+        decode = self.has_variable("cache", "cached_key") or \
+            (not self.is_initializing() and self.is_mutable_collection("cache"))
+        if decode:
+            out = self._decode_attention(q, k, v, positions)
+        else:
+            out = causal_attention(q, k, v, dtype=cfg.dtype,
+                                   impl=cfg.attention_impl,
+                                   sparse_config=cfg.sparse_attention,
+                                   scale=cfg.qk_scale, window=self.window)
         out = out.reshape(b, s, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=True, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="out_proj")(out)
+
+    def _decode_attention(self, q, k, v, positions):
+        """KV-cache attention (reference ``softmax_context`` kernel with
+        cache append, inference/csrc/softmax.cu): writes this step's k/v at
+        ``cache_index`` and attends over the filled prefix."""
+        cfg = self.cfg
+        b, s, h, d = q.shape
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (b, cfg.max_seq_len, h, d), cfg.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (b, cfg.max_seq_len, h, d), cfg.dtype)
+        idx = self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((), jnp.int32))
+        cur = idx.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+        idx.value = cur + s
+        scale = (cfg.qk_scale if cfg.qk_scale is not None
+                 else 1.0 / math.sqrt(d))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value
+                            ).astype(jnp.float32) * scale
+        key_pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
+        q_pos = (cur + jnp.arange(s))[None, None, :, None]
+        visible = key_pos <= q_pos
+        if self.window is not None:
+            visible = jnp.logical_and(visible,
+                                      key_pos > q_pos - self.window)
+        logits = jnp.where(visible, logits, -1e10)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
 
 
 class MLP(nn.Module):
@@ -178,8 +238,10 @@ class Block(nn.Module):
     ``nn.scan`` directly (carry, per-step-output) — the scan-over-layers
     structure is what makes ZeRO-3 gather/release and per-layer remat
     idiomatic on TPU. ``l_aux`` is the MoE load-balancing loss (0 for dense
-    blocks), summed over layers by GPT."""
+    blocks), summed over layers by GPT. ``layer_idx`` is set only on the
+    non-scanned path (heterogeneous layers, e.g. GPT-Neo local windows)."""
     cfg: GPTConfig
+    layer_idx: Optional[int] = None
 
     def _ffn(self, cfg, h, deterministic):
         if cfg.moe:
@@ -204,7 +266,10 @@ class Block(nn.Module):
                            param_dtype=cfg.param_dtype, name="ln_1")
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_2")
-        attn = SelfAttention(cfg, name="attn")
+        window = None
+        if cfg.attn_windows is not None and self.layer_idx is not None:
+            window = cfg.attn_windows[self.layer_idx]
+        attn = SelfAttention(cfg, window=window, name="attn")
         if cfg.parallel_residual:
             # NeoX: x + attn(ln1(x)) + ffn(ln2(x))
             ffn_out, l_aux = self._ffn(cfg, ln2(x), deterministic)
@@ -221,10 +286,11 @@ class GPT(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True):
+    def __call__(self, input_ids, deterministic=True, positions=None):
         cfg = self.cfg
         b, s = input_ids.shape
-        positions = jnp.arange(s)[None, :].repeat(b, axis=0)
+        if positions is None:
+            positions = jnp.arange(s)[None, :].repeat(b, axis=0)
 
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wte")
@@ -233,17 +299,20 @@ class GPT(nn.Module):
             pos_emb = self.param(
                 "wpe", nn.initializers.normal(0.02),
                 (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
-            x = x + pos_emb[None, :s].astype(cfg.dtype)
+            x = x + pos_emb[positions].astype(cfg.dtype)
 
         block = Block
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False,
                              policy=jax.checkpoint_policies.nothing_saveable)
 
+        if cfg.attn_windows is not None and cfg.scan_layers:
+            raise ValueError("attn_windows (heterogeneous layers) requires "
+                             "scan_layers=False")
         if cfg.scan_layers:
             ScannedBlock = nn.scan(
                 block,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True, "gating": True},
                 in_axes=(nn.broadcast, nn.broadcast),
                 length=cfg.num_layers,
@@ -254,7 +323,8 @@ class GPT(nn.Module):
         else:
             moe_aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
-                x, aux = block(cfg, name=f"block_{i}")(x, positions, deterministic)
+                x, aux = block(cfg, layer_idx=i,
+                               name=f"block_{i}")(x, positions, deterministic)
                 moe_aux = moe_aux + aux
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,

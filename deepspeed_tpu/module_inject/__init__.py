@@ -1,0 +1,4 @@
+"""Module injection (reference: deepspeed/module_inject/)."""
+
+from .policies import (HFGPT2Policy, HFGPTNeoPolicy, load_hf_model,
+                       policy_for)

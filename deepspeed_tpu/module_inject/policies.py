@@ -1,0 +1,183 @@
+"""Injection policies: map HuggingFace checkpoints into our model families.
+
+Reference analogue: ``deepspeed/module_inject/replace_policy.py`` — the
+per-architecture weight-extraction adapters (``HFGPT2LayerPolicy``,
+``HFGPTNEOLayerPolicy``:113, ``MegatronLayerPolicy``:203 ...) consumed by
+``replace_transformer_layer`` (``replace_module.py:124``), which slices
+qkv/mlp weights across TP ranks (``ReplaceWithTensorSlicing.qkv_copy``:55).
+
+TPU-native: a policy converts an HF state dict (torch CPU tensors or
+numpy) into (GPTConfig, flax param tree); TP "slicing" is not done here —
+placement against the mesh's NamedShardings at load time IS the slicing
+(runtime/sharding.py tp specs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..models.gpt import GPTConfig
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _stack(sd: Dict[str, Any], fmt: str, n: int, transform=None):
+    mats = [_np(sd[fmt.format(i)]) for i in range(n)]
+    if transform is not None:
+        mats = [transform(m) for m in mats]
+    return np.stack(mats)
+
+
+class HFGPT2Policy:
+    """GPT-2 family (reference HFGPT2LayerPolicy / client_module gpt2).
+
+    HF GPT2 uses Conv1D ([in, out] kernels — already flax Dense layout)
+    with fused c_attn = [q|k|v], matching our qkv Dense split order.
+    """
+
+    @staticmethod
+    def config_from_hf(hf_config) -> GPTConfig:
+        import jax.numpy as jnp
+        return GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.n_positions,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            d_model=hf_config.n_embd,
+            d_ff=4 * hf_config.n_embd,
+            rotary=False, parallel_residual=False, tie_embeddings=True,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+            scan_layers=True, remat=False)
+
+    @staticmethod
+    def convert(state_dict: Dict[str, Any], n_layer: int) -> Dict[str, Any]:
+        sd = {k.removeprefix("transformer."): v
+              for k, v in state_dict.items()}
+        blocks = {
+            "ln_1": {"scale": _stack(sd, "h.{}.ln_1.weight", n_layer),
+                     "bias": _stack(sd, "h.{}.ln_1.bias", n_layer)},
+            "ln_2": {"scale": _stack(sd, "h.{}.ln_2.weight", n_layer),
+                     "bias": _stack(sd, "h.{}.ln_2.bias", n_layer)},
+            "attn": {
+                "qkv": {"kernel": _stack(sd, "h.{}.attn.c_attn.weight", n_layer),
+                        "bias": _stack(sd, "h.{}.attn.c_attn.bias", n_layer)},
+                "out_proj": {"kernel": _stack(sd, "h.{}.attn.c_proj.weight", n_layer),
+                             "bias": _stack(sd, "h.{}.attn.c_proj.bias", n_layer)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": _stack(sd, "h.{}.mlp.c_fc.weight", n_layer),
+                            "bias": _stack(sd, "h.{}.mlp.c_fc.bias", n_layer)},
+                "down_proj": {"kernel": _stack(sd, "h.{}.mlp.c_proj.weight", n_layer),
+                              "bias": _stack(sd, "h.{}.mlp.c_proj.bias", n_layer)},
+            },
+        }
+        return {
+            "wte": {"embedding": _np(sd["wte.weight"])},
+            "wpe": _np(sd["wpe.weight"]),
+            "blocks": blocks,
+            "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                     "bias": _np(sd["ln_f.bias"])},
+        }
+
+
+class HFGPTNeoPolicy:
+    """GPT-Neo (reference HFGPTNEOLayerPolicy:113): separate q/k/v Linears
+    ([out, in] torch layout -> transpose), no attn biases on q/k/v,
+    **unscaled** attention scores (qk_scale=1.0) and alternating
+    global/local(window-256) layers per ``config.attention_layers`` —
+    heterogeneous layers force scan_layers=False."""
+
+    @staticmethod
+    def config_from_hf(hf_config) -> GPTConfig:
+        import jax.numpy as jnp
+        windows = tuple(
+            hf_config.window_size if t == "local" else None
+            for t in hf_config.attention_layers)
+        return GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            num_layers=hf_config.num_layers,
+            num_heads=hf_config.num_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.intermediate_size or 4 * hf_config.hidden_size,
+            rotary=False, tie_embeddings=True,
+            qk_scale=1.0, attn_windows=windows,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+            scan_layers=False, remat=False)
+
+    @staticmethod
+    def convert(state_dict: Dict[str, Any], n_layer: int) -> Dict[str, Any]:
+        sd = {k.removeprefix("transformer."): v
+              for k, v in state_dict.items()}
+        d = _np(sd["h.0.attn.attention.q_proj.weight"]).shape[1]
+
+        def qkv_kernel(i):
+            q = _np(sd[f"h.{i}.attn.attention.q_proj.weight"]).T
+            k = _np(sd[f"h.{i}.attn.attention.k_proj.weight"]).T
+            v = _np(sd[f"h.{i}.attn.attention.v_proj.weight"]).T
+            return np.concatenate([q, k, v], axis=1)
+
+        def qkv_bias(i):
+            z = np.zeros((d,), np.float32)
+            def get(name):
+                key = f"h.{i}.attn.attention.{name}.bias"
+                return _np(sd[key]) if key in sd else z
+            return np.concatenate([get("q_proj"), get("k_proj"),
+                                   get("v_proj")])
+
+        out = {
+            "wte": {"embedding": _np(sd["wte.weight"])},
+            "wpe": _np(sd["wpe.weight"]),
+            "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                     "bias": _np(sd["ln_f.bias"])},
+        }
+        for i in range(n_layer):  # per-layer blocks (no scan stacking)
+            out[f"block_{i}"] = {
+                "ln_1": {"scale": _np(sd[f"h.{i}.ln_1.weight"]),
+                         "bias": _np(sd[f"h.{i}.ln_1.bias"])},
+                "ln_2": {"scale": _np(sd[f"h.{i}.ln_2.weight"]),
+                         "bias": _np(sd[f"h.{i}.ln_2.bias"])},
+                "attn": {
+                    "qkv": {"kernel": qkv_kernel(i), "bias": qkv_bias(i)},
+                    "out_proj": {
+                        "kernel": _np(sd[f"h.{i}.attn.attention.out_proj.weight"]).T,
+                        "bias": _np(sd[f"h.{i}.attn.attention.out_proj.bias"])},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": _np(sd[f"h.{i}.mlp.c_fc.weight"]).T,
+                                "bias": _np(sd[f"h.{i}.mlp.c_fc.bias"])},
+                    "down_proj": {"kernel": _np(sd[f"h.{i}.mlp.c_proj.weight"]).T,
+                                  "bias": _np(sd[f"h.{i}.mlp.c_proj.bias"])},
+                },
+            }
+        return out
+
+
+_POLICIES = {
+    "gpt2": HFGPT2Policy,
+    "gpt_neo": HFGPTNeoPolicy,
+}
+
+
+def policy_for(model_type: str):
+    if model_type not in _POLICIES:
+        raise ValueError(
+            f"no injection policy for {model_type!r}; have "
+            f"{sorted(_POLICIES)}")
+    return _POLICIES[model_type]
+
+
+def load_hf_model(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """replace_transformer_layer analogue: HF model -> (GPTConfig, params).
+    Works on any loaded ``transformers`` model of a supported type."""
+    model_type = hf_model.config.model_type
+    pol = policy_for(model_type)
+    cfg = pol.config_from_hf(hf_model.config)
+    params = pol.convert(dict(hf_model.state_dict()), cfg.num_layers)
+    return cfg, params
