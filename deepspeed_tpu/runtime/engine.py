@@ -335,9 +335,13 @@ class DeepSpeedEngine:
             return out
         raise ValueError("model output is not a scalar loss; pass loss_fn")
 
-    def _micro_grads(self, master, scale, batch, rng):
-        params = _cast_tree(master, self.compute_dtype)
-        params = jax.lax.with_sharding_constraint(params, self.param_shardings)
+    def _micro_grads(self, master, scale, batch, rng, params=None):
+        if params is None:
+            # compute-dtype copy of the master weights; callers that loop over
+            # microbatches pass a pre-cast tree so the cast runs once per
+            # train step, not once per micro step
+            params = _cast_tree(master, self.compute_dtype)
+            params = jax.lax.with_sharding_constraint(params, self.param_shardings)
 
         def scaled_loss(p):
             loss = self._loss_of(p, batch, rng)
@@ -394,11 +398,17 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
 
         def train_step(state, batches):
+            # fp32->compute cast hoisted out of the micro loop (the scan body
+            # would otherwise re-cast the full master tree every micro step)
+            params = _cast_tree(state["master"], self.compute_dtype)
+            params = jax.lax.with_sharding_constraint(params, self.param_shardings)
+
             def body(carry, batch):
                 acc, loss_sum, rng = carry
                 rng, sub = jax.random.split(rng)
                 loss, grads = self._micro_grads(
-                    state["master"], state["scale"].cur_scale, batch, sub)
+                    state["master"], state["scale"].cur_scale, batch, sub,
+                    params=params)
                 acc = jax.tree.map(jnp.add, acc, grads)
                 acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
                 return (acc, loss_sum + loss, rng), None
@@ -456,7 +466,10 @@ class DeepSpeedEngine:
 
         self.tput_timer.start()
         self.state, metrics = self._jit_train(self.state, batches)
-        self.tput_timer.stop(sync=metrics["loss"])
+        # sync only on report steps: a per-step block_until_ready would
+        # serialize dispatch against the device and stall the pipeline
+        will_report = (self.global_steps + 1) % self.steps_per_print() == 0
+        self.tput_timer.stop(sync=metrics["loss"] if will_report else None)
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()

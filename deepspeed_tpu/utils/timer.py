@@ -98,20 +98,33 @@ class ThroughputTimer:
         self.logging = logging_fn or (lambda m: log_dist(m, ranks=[0]))
         self.initialized = False
         self.global_step_count = 0
+        self.counted_steps = 0
         self.total_elapsed_time = 0.0
+        self._pending_time = 0.0
+        self._pending_steps = 0
         self._t0 = None
 
     def start(self):
         self._t0 = time.perf_counter()
 
     def stop(self, sync=None, report_speed: bool = True):
+        """Without ``sync`` the measured time is dispatch-only (the device
+        may still be working); such steps are held pending and folded into
+        the window that ends at the next synced stop, so
+        ``avg_samples_per_sec`` never divides by an under-measured clock."""
         if self._t0 is None:
             return
         if sync is not None:
             jax.block_until_ready(sync)
         self.global_step_count += 1
         if self.global_step_count > self.start_step:
-            self.total_elapsed_time += time.perf_counter() - self._t0
+            self._pending_time += time.perf_counter() - self._t0
+            self._pending_steps += 1
+            if sync is not None:
+                self.total_elapsed_time += self._pending_time
+                self.counted_steps += self._pending_steps
+                self._pending_time = 0.0
+                self._pending_steps = 0
             if report_speed and self.global_step_count % self.steps_per_output == 0:
                 self.logging(
                     f"step={self.global_step_count}, "
@@ -119,7 +132,6 @@ class ThroughputTimer:
         self._t0 = None
 
     def avg_samples_per_sec(self) -> float:
-        steps = self.global_step_count - self.start_step
-        if steps <= 0 or self.total_elapsed_time == 0:
+        if self.counted_steps <= 0 or self.total_elapsed_time == 0:
             return 0.0
-        return steps * self.batch_size / self.total_elapsed_time
+        return self.counted_steps * self.batch_size / self.total_elapsed_time
