@@ -1,0 +1,116 @@
+"""GPT as a pipeline layer list (reference analogue: GPT2ModelPipe in the
+Megatron-DeepSpeed examples — the model family users feed to PipelineModule,
+built from LayerSpec/TiedLayerSpec as in runtime/pipe/module.py:25,73).
+
+The embedding and the LM head are a tied pair: both are ``PipeGPTEmbed``
+instances under one ``TiedLayerSpec`` key, sharing a single param tree.
+``PipeGPTEmbed`` embeds int token ids and projects float hidden states with
+the transposed table (flax's ``Embed.attend`` idiom), so the same module
+serves both ends of the pipe — the tied-weight contract the reference keeps
+with ``module.py:419-441`` + ``ReduceTiedGrads``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from .gpt import GPTConfig, MLP, SelfAttention, lm_loss_fn
+
+
+class PipeGPTEmbed(nn.Module):
+    """Token+position embedding (int input) / tied LM head (float input)."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        wpe = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        if jnp.issubdtype(x.dtype, jnp.integer):   # embedding end
+            h = wte(x)
+            pos = jnp.arange(x.shape[1])
+            return h + wpe[pos][None].astype(cfg.dtype)
+        return wte.attend(x)                        # LM-head end
+
+    @staticmethod
+    def num_params(cfg: GPTConfig) -> int:
+        return cfg.vocab_size * cfg.d_model + cfg.max_seq_len * cfg.d_model
+
+
+class PipeGPTBlock(nn.Module):
+    """One transformer block with a single-array interface (x -> x)."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        positions = jnp.arange(x.shape[1])[None, :].repeat(x.shape[0], axis=0)
+        h = x + SelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_1")(x),
+            positions)
+        return h + MLP(cfg, name="mlp")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_2")(h))
+
+    @staticmethod
+    def num_params(cfg: GPTConfig) -> int:
+        return 12 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff
+
+
+class PipeGPTFinalNorm(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.LayerNorm(epsilon=self.cfg.layer_norm_eps,
+                            dtype=self.cfg.dtype,
+                            param_dtype=self.cfg.param_dtype, name="ln_f")(x)
+
+    @staticmethod
+    def num_params(cfg: GPTConfig) -> int:
+        return 2 * cfg.d_model
+
+
+class PipeGPTLMHead(nn.Module):
+    """Untied vocabulary projection (NeoX-style tie_embeddings=False)."""
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.cfg.vocab_size, use_bias=False,
+                        dtype=self.cfg.dtype,
+                        param_dtype=self.cfg.param_dtype, name="lm_head")(x)
+
+    @staticmethod
+    def num_params(cfg: GPTConfig) -> int:
+        return cfg.vocab_size * cfg.d_model
+
+
+def gpt_pipe_specs(cfg: GPTConfig):
+    """LayerSpec list for a GPT; the embedding/LM-head pair is tied (one
+    shared param tree) when cfg.tie_embeddings, else an untied Dense head."""
+    specs = [TiedLayerSpec("embed", PipeGPTEmbed, cfg)
+             if cfg.tie_embeddings else LayerSpec(PipeGPTEmbed, cfg)]
+    specs += [LayerSpec(PipeGPTBlock, cfg) for _ in range(cfg.num_layers)]
+    specs += [LayerSpec(PipeGPTFinalNorm, cfg)]
+    specs += [TiedLayerSpec("embed", PipeGPTEmbed, cfg)
+              if cfg.tie_embeddings else LayerSpec(PipeGPTLMHead, cfg)]
+    return specs
+
+
+def gpt_pipe_module(cfg: GPTConfig, num_stages: int,
+                    partition_method: str = "parameters",
+                    loss_fn=None) -> PipelineModule:
+    return PipelineModule(gpt_pipe_specs(cfg), num_stages=num_stages,
+                          loss_fn=loss_fn or
+                          (lambda logits, labels: lm_loss_fn(
+                              logits, {"input_ids": labels})),
+                          partition_method=partition_method)

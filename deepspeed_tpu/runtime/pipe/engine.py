@@ -3,18 +3,28 @@
 Reference analogue: ``PipelineEngine`` (runtime/pipe/engine.py:46) with its
 ``_INSTRUCTION_MAP`` dispatch (:1346-1375) and ``train_batch`` (:302).
 
-TPU-native design, round 1: HOST-DRIVEN execution (the reference's own model
-— a Python loop dispatching per-instruction handlers), with each stage's
-forward/backward as jitted programs and activations handed between stages as
-device arrays. On a real pod each stage lives on a ``pp`` sub-mesh and the
-hand-off is a resharding (``jax.device_put`` across sub-meshes rides ICI);
-in tests all stages share one mesh. The schedule math (warmup spacing,
-1F1B steady state, buffer counts) is identical to the reference's.
+TPU-native design (v2): HOST-DRIVEN dispatch of JITTED per-stage programs.
 
-Gradient flow per micro-batch: ``jax.vjp`` at each ForwardPass stores the
-pullback; BackwardPass applies it, accumulates parameter grads, and ships the
-input-cotangent to the previous stage (the reference stores activations +
-re-runs autograd; vjp is JAX's native equivalent).
+  * Each stage owns a ``pp`` sub-mesh sliced out of the global mesh (axes
+    ``dp`` x ``tp``); stage params and optimizer state live on that sub-mesh
+    and activations cross stages with ``jax.device_put`` — the resharding
+    rides ICI on hardware (reference p2p.py:21-86 send/recv).
+  * ForwardPass / BackwardPass run as cached jitted programs. The backward
+    re-derives the stage vjp *inside* its jit from the saved stage input —
+    i.e. activation checkpointing at stage granularity (the reference's
+    default activation_checkpoint_interval in pipelines), so no Python
+    closures cross the jit boundary and the whole hot path is compiled.
+  * Data parallelism composes inside each stage program: the micro-batch is
+    sharded over the sub-mesh's ``dp`` axis while params stay replicated, so
+    XLA's partitioner emits the gradient all-reduce over ``dp`` on its own —
+    that collective IS the reference's ``ReduceGrads``
+    (runtime/pipe/engine.py:257).
+  * ``ReduceTiedGrads`` (reference :240): tied-layer grads are summed across
+    all owner stages and written back to every owner, so each replica takes
+    the same update from identical optimizer state — equivalent to the
+    reference's allreduce over the tied-weight group (module.py:419-441).
+  * Mixed precision: stage masters stay fp32; the stage programs cast to the
+    configured compute dtype in-graph and produce fp32 grads.
 """
 
 from __future__ import annotations
@@ -25,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ... import comm
 from ...ops.adam import fused_adam
@@ -56,10 +67,27 @@ class PipelineEngine:
                  rng=None):
         comm.init_distributed()
         self.module = model
-        self.mesh = mesh_lib.get_global_mesh()
         self.num_stages = model.num_stages
         pre = DeepSpeedConfig(config, dp_world_size=1)
-        dp = pre.mesh.dp if pre.mesh.dp is not None else 1
+        mc = pre.mesh
+        if mc.dp is not None or mc.pp > 1 or mc.tp > 1:
+            shape = mesh_lib.MeshShape(dp=mc.dp or 1, pp=mc.pp, ep=mc.ep,
+                                       sp=mc.sp, tp=mc.tp)
+            if shape.total() > len(jax.devices()):
+                raise ValueError(
+                    f"mesh {shape.as_dict()} needs {shape.total()} devices, "
+                    f"have {len(jax.devices())}")
+            # an explicit shape may cover a subset of the host's devices
+            # (e.g. dp=1 pipelines on a multi-device test host). The mesh is
+            # kept engine-local — mutating the process-global mesh here would
+            # hijack later default-mesh engines.
+            self.mesh = mesh_lib.build_mesh(
+                shape, devices=jax.devices()[:shape.total()])
+            self._mesh_shape = shape
+        else:
+            self.mesh = mesh_lib.get_global_mesh()
+            self._mesh_shape = mesh_lib.get_global_mesh_shape()
+        dp = mc.dp if mc.dp is not None else 1
         self.config = DeepSpeedConfig(
             config if not isinstance(config, DeepSpeedConfig) else config._raw,
             dp_world_size=dp)
@@ -67,19 +95,30 @@ class PipelineEngine:
         self.collate_fn = collate_fn
         self.global_steps = 0
         self.micro_batches = self.config.gradient_accumulation_steps
+        self.compute_dtype = self.config.compute_dtype
+
+        self._build_stage_meshes()
 
         rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         self._build_stages(model, rng, model_parameters)
 
         oc = self.config.optimizer
         params = dict(oc.params) if oc else {}
+        otype = (oc.type if oc else "Adam").lower()
         self._lr = params.pop("lr", 1e-3)
         self.lr_scheduler = lr_scheduler or build_lr_scheduler(self.config.scheduler)
         lr_fn = (lambda c: self.lr_scheduler.lr_at(c)) if self.lr_scheduler else self._lr
-        self.optimizer = optimizer or fused_adam(
-            lr_fn, betas=tuple(params.pop("betas", (0.9, 0.999))),
-            eps=params.pop("eps", 1e-8),
-            weight_decay=params.pop("weight_decay", 0.0))
+        if optimizer is not None:
+            self.optimizer = optimizer
+        elif otype == "sgd":
+            self.optimizer = optax.sgd(lr_fn,
+                                       momentum=params.pop("momentum", 0.0))
+        else:
+            self.optimizer = fused_adam(
+                lr_fn, betas=tuple(params.pop("betas", (0.9, 0.999))),
+                eps=params.pop("eps", 1e-8),
+                weight_decay=params.pop("weight_decay", 0.0),
+                adam_w_mode=(otype == "adamw"))
         self.opt_states: List[Any] = []  # built lazily with stage params
 
         self.training_dataloader = None
@@ -90,16 +129,60 @@ class PipelineEngine:
                 batch_size=self.config.train_micro_batch_size_per_gpu,
                 collate_fn=collate_fn)
 
+        # jit caches, one entry per stage
         self._jit_fwd: Dict[int, Callable] = {}
+        self._jit_bwd: Dict[int, Callable] = {}
+        self._jit_step: Dict[int, Callable] = {}
         log_dist(f"pipeline engine: {model.num_layers} layers over "
-                 f"{self.num_stages} stages, parts={model.parts}", ranks=[0])
+                 f"{self.num_stages} stages, parts={model.parts}, "
+                 f"stage_mesh={'per-stage' if self._per_stage_mesh else 'shared'}",
+                 ranks=[0])
+
+    # ------------------------------------------------------------ sub-meshes
+    def _build_stage_meshes(self):
+        """Slice the global (dp, pp, ep, sp, tp) mesh into one (dp, tp)
+        sub-mesh per stage when the mesh's pp axis matches num_stages;
+        otherwise all stages share the full mesh (CPU tests, pp=1)."""
+        shape = self._mesh_shape
+        self._per_stage_mesh = shape.pp == self.num_stages and shape.pp > 1
+        if not self._per_stage_mesh:
+            self.stage_meshes = [self.mesh] * self.num_stages
+            self._stage_dp = shape.dp
+            return
+        if shape.ep != 1 or shape.sp != 1:
+            raise NotImplementedError("pp does not compose with ep/sp yet")
+        devs = self.mesh.devices  # [dp, pp, ep, sp, tp]
+        self.stage_meshes = [
+            Mesh(devs[:, s, 0, 0, :], ("dp", "tp"))
+            for s in range(self.num_stages)
+        ]
+        self._stage_dp = shape.dp
+
+    def _stage_sharding(self, s: int, spec: P) -> NamedSharding:
+        return NamedSharding(self.stage_meshes[s], spec)
+
+    def _batch_spec(self, x) -> P:
+        """Shard the leading (batch) dim over dp when it divides."""
+        if getattr(x, "ndim", 0) >= 1 and self._stage_dp > 1 \
+                and x.shape[0] % self._stage_dp == 0:
+            return P("dp")
+        return P()
+
+    def _put_stage(self, x, s: int):
+        """Move an activation/batch onto stage s's sub-mesh (the p2p hop —
+        reference SendActivation/RecvActivation, p2p.py:48,69)."""
+        return jax.tree.map(
+            lambda a: jax.device_put(
+                jnp.asarray(a), self._stage_sharding(s, self._batch_spec(a))),
+            x)
 
     # ----------------------------------------------------------- stage build
     def _build_stages(self, model: PipelineModule, rng, model_parameters):
         self.stage_layers: List[List[Any]] = []
         self.stage_params: List[Any] = []
         self.tied_params: Dict[str, Any] = {}
-        self.tied_owners: Dict[str, tuple] = {}
+        # key -> [(stage, layer_idx), ...]; first entry is the canonical owner
+        self.tied_owners: Dict[str, List[tuple]] = {}
 
         # Need an example input to init; defer until first batch if not given.
         self._built = False
@@ -117,28 +200,111 @@ class PipelineEngine:
             for li, (spec, layer) in enumerate(zip(self.module.stage_layers(s), layers)):
                 rng, sub = jax.random.split(rng)
                 if isinstance(spec, TiedLayerSpec) and spec.key in self.tied_params:
-                    p = self.tied_params[spec.key]
+                    # materialize an independent replica: owners' buffers must
+                    # not alias (each stage donates its params to its jitted
+                    # optimizer step); ReduceTiedGrads keeps replicas equal
+                    p = jax.tree.map(lambda a: jnp.array(a, copy=True),
+                                     self.tied_params[spec.key])
+                    self.tied_owners[spec.key].append((s, li))
                 else:
                     p = _layer_init(layer, sub, x)
                     if isinstance(spec, TiedLayerSpec):
                         self.tied_params[spec.key] = p
-                        self.tied_owners[spec.key] = (s, li)
+                        self.tied_owners[spec.key] = [(s, li)]
                 params.append(p)
                 x = _layer_apply(layer, p, x)
+            repl = self._stage_sharding(s, P())
+            params = jax.tree.map(lambda a: jax.device_put(a, repl), params)
             self.stage_layers.append(layers)
             self.stage_params.append(params)
-        self.opt_states = [self.optimizer.init(p) for p in self.stage_params]
+        self.opt_states = [
+            jax.tree.map(lambda a: jax.device_put(a, self._stage_sharding(s, P())),
+                         self.optimizer.init(p))
+            for s, p in enumerate(self.stage_params)]
         self._built = True
 
     def _stage_apply(self, stage_id: int):
         layers = self.stage_layers[stage_id]
+        cdt = self.compute_dtype
 
         def apply(params_list, x):
+            # fp32 master -> compute dtype, traced (grads flow through the cast)
+            if cdt != jnp.float32:
+                params_list = jax.tree.map(lambda a: a.astype(cdt)
+                                           if jnp.issubdtype(a.dtype, jnp.floating)
+                                           else a, params_list)
             for layer, p in zip(layers, params_list):
                 x = _layer_apply(layer, p, x)
             return x
 
         return apply
+
+    # ---------------------------------------------------------- jitted progs
+    def _fwd_prog(self, s: int):
+        """out = stage_s(params, x); on the last stage returns the loss."""
+        if s in self._jit_fwd:
+            return self._jit_fwd[s]
+        apply = self._stage_apply(s)
+        last = s == self.num_stages - 1
+        loss_fn = self.loss_fn
+
+        if last:
+            def fwd(params_list, x, labels):
+                out = apply(params_list, x)
+                return loss_fn(out, labels).astype(jnp.float32)
+        else:
+            def fwd(params_list, x):
+                return apply(params_list, x)
+
+        self._jit_fwd[s] = jax.jit(fwd)
+        return self._jit_fwd[s]
+
+    def _bwd_prog(self, s: int):
+        """(new_acc, dx) from (params, x, g_or_labels, acc). Recomputes the
+        stage forward inside the jit (stage-granular activation
+        checkpointing) and accumulates param grads in fp32; the dp grad
+        all-reduce is inserted by XLA here."""
+        if s in self._jit_bwd:
+            return self._jit_bwd[s]
+        apply = self._stage_apply(s)
+        last = s == self.num_stages - 1
+        loss_fn = self.loss_fn
+
+        if last:
+            def bwd(params_list, x, labels, acc):
+                def f(pl, xx):
+                    out = apply(pl, xx)
+                    return loss_fn(out, labels).astype(jnp.float32)
+                loss, vjp_fn = jax.vjp(f, params_list, x)
+                dparams, dx = vjp_fn(jnp.ones((), jnp.float32))
+                new_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, dparams)
+                return new_acc, dx, loss
+        else:
+            def bwd(params_list, x, g, acc):
+                _, vjp_fn = jax.vjp(apply, params_list, x)
+                dparams, dx = vjp_fn(g)
+                new_acc = jax.tree.map(
+                    lambda a, g2: a + g2.astype(jnp.float32), acc, dparams)
+                return new_acc, dx
+
+        self._jit_bwd[s] = jax.jit(bwd, donate_argnums=(3,))
+        return self._jit_bwd[s]
+
+    def _step_prog(self, s: int):
+        if s in self._jit_step:
+            return self._jit_step[s]
+        M = float(self.micro_batches)
+        opt = self.optimizer
+
+        def step(params_list, opt_state, acc):
+            grads = jax.tree.map(lambda g: g / M, acc)
+            updates, new_opt = opt.update(grads, opt_state, params_list)
+            new_params = optax.apply_updates(params_list, updates)
+            return new_params, new_opt
+
+        self._jit_step[s] = jax.jit(step, donate_argnums=(0, 1))
+        return self._jit_step[s]
 
     # ------------------------------------------------------------- training
     def train_batch(self, data_iter=None):
@@ -155,12 +321,17 @@ class PipelineEngine:
         ex_inputs, _ = self._split_batch(micros[0])
         self._lazy_build(jnp.asarray(ex_inputs))
 
-        grads_acc = [jax.tree.map(jnp.zeros_like, p) for p in self.stage_params]
+        grads_acc = [
+            jax.tree.map(
+                lambda p, _sh=self._stage_sharding(s, P()): jax.device_put(
+                    jnp.zeros(p.shape, jnp.float32), _sh),
+                self.stage_params[s])
+            for s in range(S)]
         total_loss = jnp.zeros((), jnp.float32)
 
-        # per-(stage, micro) storage
+        # per-(stage, micro) storage: stage inputs (for the in-jit vjp replay)
+        # and inbound cotangents
         acts: Dict[tuple, Any] = {}
-        vjps: Dict[tuple, Any] = {}
         cotangents: Dict[tuple, Any] = {}
 
         schedules = [sched_lib.TrainSchedule(M, S, s) for s in range(S)]
@@ -168,7 +339,7 @@ class PipelineEngine:
         for _tick in range(2 * (M + S - 1)):
             for s in range(S):
                 for cmd in next(iters[s]):
-                    total_loss = self._exec(cmd, s, micros, acts, vjps,
+                    total_loss = self._exec(cmd, s, micros, acts,
                                             cotangents, grads_acc, total_loss)
         self._optimizer_step(grads_acc)
         self.global_steps += 1
@@ -183,7 +354,7 @@ class PipelineEngine:
             return batch
         return batch, batch
 
-    def _exec(self, cmd, s, micros, acts, vjps, cots, grads_acc, total_loss):
+    def _exec(self, cmd, s, micros, acts, cots, grads_acc, total_loss):
         t = type(cmd)
         if t is sched_lib.LoadMicroBatch:
             return total_loss
@@ -191,39 +362,50 @@ class PipelineEngine:
             m = self._micro_of(cmd, s, forward=True)
             if s == 0:
                 x, _ = self._split_batch(micros[m])
-                x = jnp.asarray(x)
+                x = self._put_stage(x, s)
             else:
                 x = acts[(s, m)]
-            apply = self._stage_apply(s)
+            acts[(s, m)] = x  # keep the stage INPUT for the backward replay
             if s == self.num_stages - 1:
+                # the last stage's forward is folded into its BackwardPass
+                # (which replays the stage anyway and returns the loss) —
+                # the 1F1B schedule runs B right after F on the last stage,
+                # so deferring costs no pipeline bubble and saves a full
+                # forward per micro-batch
                 _, labels = self._split_batch(micros[m])
-                labels = jnp.asarray(labels)
-
-                def fwd_loss(params_list, xx):
-                    out = apply(params_list, xx)
-                    return self.loss_fn(out, labels).astype(jnp.float32)
-
-                loss, vjp_fn = jax.vjp(fwd_loss, self.stage_params[s], x)
-                vjps[(s, m)] = vjp_fn
-                return total_loss + loss
-            out, vjp_fn = jax.vjp(apply, self.stage_params[s], x)
-            vjps[(s, m)] = vjp_fn
-            if s + 1 < self.num_stages:
-                acts[(s + 1, m)] = out  # SendActivation/RecvActivation pair
+                acts[("labels", m)] = self._put_stage(labels, s)
+                return total_loss
+            out = self._fwd_prog(s)(self.stage_params[s], x)
+            # SendActivation / RecvActivation: hop onto the next stage's mesh
+            acts[(s + 1, m)] = self._put_stage(out, s + 1)
             return total_loss
         if t is sched_lib.BackwardPass:
             m = self._micro_of(cmd, s, forward=False)
+            x = acts.pop((s, m))
             if s == self.num_stages - 1:
-                g = jnp.ones((), jnp.float32)
+                labels = acts.pop(("labels", m))
+                grads_acc[s], dx, loss = self._bwd_prog(s)(
+                    self.stage_params[s], x, labels, grads_acc[s])
+                total_loss = total_loss + jax.device_put(
+                    loss, NamedSharding(self.mesh, P()))
             else:
-                g = cots[(s, m)]
-            dparams, dx = vjps.pop((s, m))(g)
-            grads_acc[s] = jax.tree.map(jnp.add, grads_acc[s], dparams)
+                g = cots.pop((s, m))
+                grads_acc[s], dx = self._bwd_prog(s)(
+                    self.stage_params[s], x, g, grads_acc[s])
             if s > 0:
-                cots[(s - 1, m)] = dx  # SendGrad/RecvGrad pair
-            acts.pop((s, m), None)
+                # SendGrad / RecvGrad: cotangent hops to the previous stage
+                cots[(s - 1, m)] = self._put_stage(dx, s - 1)
             return total_loss
-        # Send/Recv handled inline above; Reduce/OptimizerStep handled after.
+        if t is sched_lib.ReduceTiedGrads:
+            # every stage's schedule emits this at the final tick (each rank
+            # runs it in the reference); this host drives ALL stages, so the
+            # global reduction must run exactly once per step
+            if s == 0:
+                self._reduce_tied_grads(grads_acc)
+            return total_loss
+        # ReduceGrads: the dp all-reduce already ran inside each _bwd_prog
+        # (XLA partitioner, see class docstring); OptimizerStep runs after
+        # the tick loop in train_batch.
         return total_loss
 
     def _micro_of(self, cmd, s, forward):
@@ -238,22 +420,42 @@ class PipelineEngine:
         counters[key] = m + 1
         return m
 
+    def _reduce_tied_grads(self, grads_acc):
+        """Sum each tied layer's grads over its owner stages and write the
+        sum back to every owner (reference _exec_reduce_tied_grads,
+        runtime/pipe/engine.py:240). All owners then apply identical updates
+        from identical optimizer state, keeping the replicas bit-equal."""
+        for key, owners in self.tied_owners.items():
+            if len(owners) < 2:
+                continue
+            s0, li0 = owners[0]
+            gsum = grads_acc[s0][li0]
+            for s, li in owners[1:]:
+                g = jax.tree.map(
+                    lambda a: jax.device_put(a, self._stage_sharding(s0, P())),
+                    grads_acc[s][li])
+                gsum = jax.tree.map(jnp.add, gsum, g)
+            for s, li in owners:
+                grads_acc[s][li] = jax.tree.map(
+                    lambda a: jax.device_put(a, self._stage_sharding(s, P())),
+                    gsum)
+
     def _optimizer_step(self, grads_acc):
-        M = float(self.micro_batches)
         for s in range(self.num_stages):
-            grads = jax.tree.map(lambda g: g / M, grads_acc[s])
-            updates, self.opt_states[s] = self.optimizer.update(
-                grads, self.opt_states[s], self.stage_params[s])
-            self.stage_params[s] = optax.apply_updates(self.stage_params[s], updates)
+            self.stage_params[s], self.opt_states[s] = self._step_prog(s)(
+                self.stage_params[s], self.opt_states[s], grads_acc[s])
 
     def eval_batch(self, data_iter):
         batch = next(data_iter) if not isinstance(data_iter, (dict, tuple, list)) else data_iter
         x, labels = self._split_batch(batch)
         x = jnp.asarray(x)
         self._lazy_build(x)
-        for s in range(self.num_stages):
-            x = self._stage_apply(s)(self.stage_params[s], x)
-        return self.loss_fn(x, jnp.asarray(labels))
+        x = self._put_stage(x, 0)
+        for s in range(self.num_stages - 1):
+            x = self._put_stage(self._fwd_prog(s)(self.stage_params[s], x), s + 1)
+        last = self.num_stages - 1
+        labels = self._put_stage(labels, last)
+        return self._fwd_prog(last)(self.stage_params[last], x, labels)
 
     # kept for API parity
     @property
