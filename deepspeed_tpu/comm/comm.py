@@ -79,11 +79,22 @@ def init_distributed(dist_backend: str = "xla",
         return
     coord = os.environ.get("COORDINATOR_ADDRESS")
     nproc = int(os.environ.get("NUM_PROCESSES", "1"))
+    pid = int(os.environ.get("PROCESS_ID", "0"))
+    if auto_mpi_discovery and not coord and "OMPI_COMM_WORLD_SIZE" in os.environ:
+        # launched under mpirun (OpenMPIRunner): take identity from the OMPI
+        # env (reference mpi_discovery, comm/comm.py:399-427); rank 0's host
+        # coordinates
+        nproc = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+        pid = int(os.environ["OMPI_COMM_WORLD_RANK"])
+        coord = os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" + \
+            os.environ.get("MASTER_PORT", "29500")
+        os.environ.setdefault(
+            "LOCAL_RANK", os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK", "0"))
     if coord and nproc > 1:
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=nproc,
-            process_id=int(os.environ.get("PROCESS_ID", "0")),
+            process_id=pid,
         )
         logger.info(f"jax.distributed initialized: process {jax.process_index()}"
                     f"/{jax.process_count()}")

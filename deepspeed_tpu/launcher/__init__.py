@@ -1,0 +1,2 @@
+"""Launcher subsystem (reference: deepspeed/launcher/ + bin/ scripts)."""
+from . import runner, launch, multinode_runner, env_report  # noqa: F401

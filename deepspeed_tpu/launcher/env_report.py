@@ -1,0 +1,87 @@
+"""Environment / op-compatibility report (reference: ``deepspeed/env_report.py``
+driving the ``ds_report`` bin script — version matrix + op build status)."""
+
+from __future__ import annotations
+
+import importlib
+import os
+import platform
+import shutil
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try_version(mod: str):
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def op_report() -> list:
+    """Build/compat status of the native + pallas ops (reference
+    op_builder ``is_compatible`` matrix)."""
+    rows = []
+    from ..ops.op_builder import available_builders
+    for name, builder in available_builders().items():
+        try:
+            compatible = builder.is_compatible()
+        except Exception:
+            compatible = False
+        loaded = False
+        if compatible:
+            try:
+                builder.load()
+                loaded = True
+            except Exception:
+                loaded = False
+        rows.append((name, compatible, loaded))
+    return rows
+
+
+def main() -> int:
+    print("-" * 64)
+    print("deepspeed_tpu environment report")
+    print("-" * 64)
+    from .. import version
+    print(f"deepspeed_tpu .......... {version.__version__}")
+    print(f"python ................. {platform.python_version()}")
+    print(f"platform ............... {platform.platform()}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        v = _try_version(mod)
+        print(f"{mod:<22} {'.' * 1} {v if v else RED_NO}")
+    for tool in ("g++", "cmake", "ninja"):
+        path = shutil.which(tool)
+        print(f"{tool:<22} . {path or RED_NO}")
+
+    print("-" * 64)
+    print("devices")
+    print("-" * 64)
+    try:
+        import jax
+        devs = jax.devices()
+        print(f"backend ................ {jax.default_backend()}")
+        print(f"device count ........... {len(devs)}")
+        for d in devs[:8]:
+            print(f"  {d}")
+        if len(devs) > 8:
+            print(f"  ... and {len(devs) - 8} more")
+    except Exception as e:
+        print(f"jax devices unavailable: {e}")
+
+    print("-" * 64)
+    print("op compatibility")
+    print("-" * 64)
+    print(f"{'op name':<24}{'compatible':<16}{'built'}")
+    for name, compatible, loaded in op_report():
+        print(f"{name:<24}"
+              f"{GREEN_OK if compatible else RED_NO:<25}"
+              f"{GREEN_OK if loaded else RED_NO}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Multi-node launch backends (reference: ``launcher/multinode_runner.py`` —
+``PDSHRunner``:45, ``OpenMPIRunner``:101; an ssh fallback replaces the
+MVAPICH variant, which targets InfiniBand clusters that TPU pods don't have).
+
+Each backend builds a command line that starts ``deepspeed_tpu.launcher.launch``
+on every node with the node's rank and the shared world info."""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import sys
+from typing import Dict, List
+
+
+class MultiNodeRunner:
+    def __init__(self, args, world_info_b64: str,
+                 active: Dict[str, List[int]], master_addr: str):
+        self.args = args
+        self.world_info = world_info_b64
+        self.active = active
+        self.master_addr = master_addr
+
+    def backend_exists(self) -> bool:
+        raise NotImplementedError
+
+    def get_cmd(self, exports: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def _launch_args(self, node_rank: int) -> List[str]:
+        return [f"--world_info={self.world_info}",
+                f"--node_rank={node_rank}",
+                f"--master_addr={self.master_addr}",
+                f"--master_port={self.args.master_port}",
+                self.args.user_script] + list(self.args.user_args)
+
+
+class PDSHRunner(MultiNodeRunner):
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, exports: Dict[str, str]) -> List[str]:
+        env_exports = " ".join(
+            f"export {k}={shlex.quote(v)};" for k, v in exports.items())
+        hosts = ",".join(self.active.keys())
+        # pdsh runs one identical command everywhere; the remote side
+        # recovers its node rank from its hostname (see _launch_args_pdsh)
+        remote = (f"{env_exports} cd {os.path.abspath(os.getcwd())}; "
+                  f"{sys.executable} -u -m deepspeed_tpu.launcher.launch "
+                  + " ".join(self._launch_args_pdsh()))
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, remote]
+
+    def _launch_args_pdsh(self) -> List[str]:
+        # node_rank resolved on the remote side by matching %HOSTNAME%
+        hosts = list(self.active.keys())
+        ranks = ";".join(f"{h}={i}" for i, h in enumerate(hosts))
+        return [f"--world_info={self.world_info}",
+                "--node_rank=$(python -c \"import socket,sys;"
+                f"m=dict(p.split('=') for p in '{ranks}'.split(';'));"
+                "h=socket.gethostname();"
+                "sys.exit(f'host {h} not in world info') "
+                "if h not in m else print(m[h])\")",
+                f"--master_addr={self.master_addr}",
+                f"--master_port={self.args.master_port}",
+                self.args.user_script] + list(self.args.user_args)
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain ssh fan-out, one session per node (background + wait)."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, exports: Dict[str, str]) -> List[str]:
+        env_exports = " ".join(
+            f"export {k}={shlex.quote(v)};" for k, v in exports.items())
+        parts = []
+        for rank, host in enumerate(self.active):
+            launch = (f"{env_exports} cd {os.path.abspath(os.getcwd())}; "
+                      f"{sys.executable} -u -m deepspeed_tpu.launcher.launch "
+                      + " ".join(self._launch_args(rank)))
+            parts.append(f"ssh {host} {launch!r} & pids+=($!);")
+        script = ("pids=(); " + " ".join(parts) +
+                  " rc=0; for p in \"${pids[@]}\"; do"
+                  " wait $p || rc=$?; done; exit $rc")
+        return ["/bin/bash", "-c", script]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, exports: Dict[str, str]) -> List[str]:
+        total_procs = sum(len(s) for s in self.active.values())
+        cmd = ["mpirun", "-n", str(total_procs), "-hostfile",
+               self._write_hostfile(), "--allow-run-as-root"]
+        exports = dict(exports,
+                       MASTER_ADDR=self.master_addr,
+                       MASTER_PORT=str(self.args.master_port))
+        for k, v in exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        # under mpirun every rank IS a training process; launch.py is skipped
+        # and comm.init_distributed picks rank/size from OMPI env
+        cmd += [sys.executable, "-u", self.args.user_script]
+        cmd += list(self.args.user_args)
+        return cmd
+
+    def _write_hostfile(self) -> str:
+        import tempfile
+        f = tempfile.NamedTemporaryFile(
+            "w", prefix="ds_tpu_mpi_hostfile_", suffix=".txt", delete=False)
+        with f:
+            for host, slots in self.active.items():
+                f.write(f"{host} slots={len(slots)}\n")
+        return f.name

@@ -1,0 +1,202 @@
+"""Cluster runner CLI (reference: ``launcher/runner.py`` — ``main``:317,
+``fetch_hostfile``:157, ``parse_inclusion_exclusion``:288,
+``encode_world_info``:298, backend dispatch :403-455).
+
+TPU redesign: ranks are *processes*, not GPUs — on a TPU pod each host runs
+one JAX process that owns all local chips, so a hostfile slot count is the
+number of processes to start on that host (1 for TPU VMs, N for CPU-mesh
+testing). The runner resolves the host list, applies ``--include/--exclude``
+filters, encodes the world info, and hands off to the node launcher
+(``launcher.launch``) locally or over pdsh/ssh/mpirun for multi-node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("PYTHONPATH", "PATH", "JAX_PLATFORMS", "XLA_FLAGS",
+               "LIBTPU_INIT_ARGS", "TPU_ACCELERATOR_TYPE")
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse ``host slots=N`` lines -> {host: num_processes}."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resources: Dict[str, int] = {}
+    with open(hostfile_path) as fd:
+        for line in fd:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                if "slots=" in line:
+                    host, slots = line.split()
+                    count = int(slots.split("=")[1])
+                else:
+                    host, count = line, 1
+            except ValueError as e:
+                raise ValueError(f"malformed hostfile line: {line!r}") from e
+            if host in resources:
+                raise ValueError(f"host {host!r} repeated in hostfile")
+            resources[host] = count
+    if not resources:
+        raise ValueError(f"hostfile {hostfile_path} is empty")
+    return resources
+
+
+def _parse_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """``host1@host2:0,2`` -> {host1: None, host2: [0, 2]} (None = all slots)."""
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in spec.split("@"):
+        if not part:
+            continue
+        if ":" in part:
+            host, idx = part.split(":")
+            out[host] = [int(i) for i in idx.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resources: Dict[str, int], include: str,
+                              exclude: str) -> Dict[str, List[int]]:
+    """Apply --include/--exclude slot filters (reference runner.py:198-287).
+    Returns {host: [process slot ids]}."""
+    active = {host: list(range(n)) for host, n in resources.items()}
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    if include:
+        pick = _parse_filter(include)
+        bad = set(pick) - set(active)
+        if bad:
+            raise ValueError(f"--include names unknown hosts: {sorted(bad)}")
+        active = {h: (active[h] if ids is None else ids)
+                  for h, ids in pick.items()}
+    elif exclude:
+        drop = _parse_filter(exclude)
+        bad = set(drop) - set(active)
+        if bad:
+            raise ValueError(f"--exclude names unknown hosts: {sorted(bad)}")
+        for h, ids in drop.items():
+            if ids is None:
+                active.pop(h)
+            else:
+                active[h] = [i for i in active[h] if i not in ids]
+                if not active[h]:
+                    active.pop(h)
+    for h, ids in active.items():
+        limit = resources[h]
+        for i in ids:
+            if not 0 <= i < limit:
+                raise ValueError(f"slot {i} out of range for host {h} "
+                                 f"(has {limit})")
+    if not active:
+        raise ValueError("no hosts left after include/exclude filtering")
+    return active
+
+
+def encode_world_info(world_info: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def decode_world_info(encoded: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_tpu",
+        description="deepspeed_tpu launcher: start a (multi-host) training "
+                    "job; mirrors the reference `deepspeed` CLI")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of `host slots=N` lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="e.g. host1@host2:0,2")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="e.g. host1:1@host2")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_procs", type=int, default=-1,
+                        help="processes per node (default: hostfile slots; "
+                             "1 process per TPU host)")
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=("pdsh", "openmpi", "ssh"),
+                        help="multi-node backend")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    resources = fetch_hostfile(args.hostfile)
+    if resources is None:
+        if args.hostfile != DLTS_HOSTFILE:
+            # an explicitly named hostfile that doesn't exist is an error,
+            # not a silent single-host fallback (a typo'd pod file must not
+            # quietly train on the login host)
+            raise FileNotFoundError(f"hostfile not found: {args.hostfile}")
+        logger.warning(
+            f"no hostfile at {DLTS_HOSTFILE}; launching on localhost only")
+        n = args.num_procs if args.num_procs > 0 else 1
+        resources = {"localhost": n}
+    if args.num_nodes > 0:
+        resources = dict(list(resources.items())[:args.num_nodes])
+    if args.num_procs > 0:
+        resources = {h: args.num_procs for h in resources}
+
+    active = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    world_info = encode_world_info(active)
+
+    master_addr = args.master_addr
+    if not master_addr:
+        first = next(iter(active))
+        master_addr = "127.0.0.1" if first == "localhost" else first
+
+    multi_node = args.force_multi or len(active) > 1 or \
+        next(iter(active)) != "localhost"
+
+    if not multi_node:
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={world_info}",
+               f"--master_addr={master_addr}",
+               f"--master_port={args.master_port}",
+               "--node_rank=0",
+               args.user_script] + list(args.user_args)
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+        return result.returncode
+
+    from .multinode_runner import PDSHRunner, OpenMPIRunner, SSHRunner
+    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "ssh": SSHRunner}[args.launcher]
+    runner = runner_cls(args, world_info, active, master_addr)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {args.launcher!r} not found "
+                           "on PATH")
+    env = os.environ.copy()
+    exports = {k: env[k] for k in EXPORT_ENVS if k in env}
+    cmd = runner.get_cmd(exports)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,3 +142,65 @@ def build_report() -> str:
     if lib is not None:
         return f"native ops ............. OK ({_CACHE_DIR})"
     return f"native ops ............. UNAVAILABLE ({_build_error})"
+
+
+class _NativeOpBuilder:
+    """Per-op view over the single native library (reference: one OpBuilder
+    subclass per op, op_builder/cpu_adam.py:8, async_io.py:10). All native
+    ops here live in one .so; compat is shared, the symbol check is per-op."""
+
+    def __init__(self, name: str, symbols):
+        self.name = name
+        self.symbols = symbols
+
+    def is_compatible(self) -> bool:
+        lib = get_native_lib()
+        return lib is not None and all(hasattr(lib, s) for s in self.symbols)
+
+    def load(self):
+        lib = get_native_lib()
+        if lib is None:
+            raise RuntimeError(f"native lib unavailable: {_build_error}")
+        return lib
+
+
+class _PallasOpBuilder:
+    """Device-kernel 'builder': Pallas kernels need no compilation step
+    (XLA jits them); compat = importable + a TPU backend or interpret mode."""
+
+    def __init__(self, name: str, module: str):
+        self.name = name
+        self.module = module
+
+    def is_compatible(self) -> bool:
+        try:
+            __import__(self.module, fromlist=["_"])
+            return True
+        except Exception:
+            return False
+
+    def load(self):
+        return __import__(self.module, fromlist=["_"])
+
+
+def available_builders():
+    """Name -> builder map for ds_report (reference op_builder.ALL_OPS)."""
+    pk = "deepspeed_tpu.ops"
+    return {
+        "cpu_adam": _NativeOpBuilder("cpu_adam",
+                                     ["ds_adam_step", "ds_adam_step_bf16"]),
+        "cpu_adagrad": _NativeOpBuilder("cpu_adagrad", ["ds_adagrad_step"]),
+        "async_io": _NativeOpBuilder("async_io",
+                                     ["aio_handle_new", "aio_pread",
+                                      "aio_pwrite", "aio_wait"]),
+        "flash_attn": _PallasOpBuilder("flash_attn",
+                                       f"{pk}.pallas.flash_attention"),
+        "fused_layer_norm": _PallasOpBuilder("fused_layer_norm",
+                                             f"{pk}.pallas.layer_norm"),
+        "fused_softmax": _PallasOpBuilder("fused_softmax",
+                                          f"{pk}.pallas.softmax"),
+        "fused_gelu": _PallasOpBuilder("fused_gelu", f"{pk}.pallas.gelu"),
+        "sparse_attn": _PallasOpBuilder(
+            "sparse_attn", f"{pk}.sparse_attention.sparse_self_attention"),
+        "quantizer": _PallasOpBuilder("quantizer", f"{pk}.quantizer"),
+    }
