@@ -626,8 +626,7 @@ class DeepSpeedEngine:
                 master_tree=res["master_params"],
                 opt_state=(res["opt_state"] if load_optimizer_states
                            and not load_module_only else None))
-            self.state["params"] = jax.device_put(
-                self.host_optimizer.mirror_tree(), self.param_shardings)
+            self.state["params"] = self._offload_restore_params()
             self._host_scale = float(meta["loss_scale"])
         else:
             self.state["master"] = res["master_params"]
@@ -687,7 +686,8 @@ class DeepSpeedEngine:
             adamw=(otype != "adam"),
             mirror_dtype=mirror,
             nvme_path=nvme,
-            aio_cfg=getattr(self.config, "aio", None))
+            aio_cfg=getattr(self.config, "aio", None),
+            dp_shard=self._local_dp_shard())
         self.optimizer = None
         self._client_optimizer = None
 
@@ -698,10 +698,19 @@ class DeepSpeedEngine:
         self.grad_shardings = self.rules.shardings(
             self.rules.grad_specs(model_parameters))
 
+        # flat-partition plumbing: grads leave the device program as padded
+        # flat [padded] arrays sharded over dp (one per leaf), and updated
+        # mirrors come back the same way — the reference's reduce-scatter of
+        # grads to owner ranks + step-tail all-gather of updated partitions
+        # (stage_1_and_2.py:889,1652-1792), here expressed as shardings.
+        self._flat_sh = NamedSharding(self.mesh, P("dp"))
+        self._off_meta = [(l.padded, l.global_numel, l.shape)
+                          for l in self.host_optimizer.leaves]
+        self._params_treedef = jax.tree_util.tree_structure(model_parameters)
+
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed)
-        dev_params = jax.device_put(self.host_optimizer.mirror_tree(),
-                                    self.param_shardings)
+        dev_params = self._offload_restore_params()
         zeros = jax.jit(
             lambda t: jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), t),
@@ -722,9 +731,44 @@ class DeepSpeedEngine:
         self._host_scale_step = 0
         self._host_last_overflow = -1
         log_dist(
-            f"ZeRO-Offload ready: {self.host_optimizer.numel():,} params on "
-            f"host ({self.offload_device}), native={self.host_optimizer.native}",
+            f"ZeRO-Offload ready: {self.host_optimizer.numel():,}/"
+            f"{self.host_optimizer.global_numel():,} params on this host "
+            f"({self.offload_device}, dp_shard={self.host_optimizer.dp_shard})"
+            f", native={self.host_optimizer.native}",
             ranks=[0])
+
+    def _local_dp_shard(self):
+        """(rank_start, rank_count, dp_world): which contiguous dp-rank range
+        this process's addressable devices cover. Single-process: all of it."""
+        dp = self.dp_world_size
+        if jax.process_count() == 1:
+            return (0, dp, dp)
+        devs = self.mesh.devices  # [dp, pp, ep, sp, tp]
+        me = jax.process_index()
+        mine = sorted(i for i in range(devs.shape[0])
+                      if any(d.process_index == me for d in devs[i].flat))
+        if not mine or mine != list(range(mine[0], mine[-1] + 1)):
+            raise RuntimeError(
+                f"process {me}'s devices do not cover a contiguous dp range "
+                f"({mine}); offload partitioning needs dp-major device order")
+        return (mine[0], len(mine), dp)
+
+    def _offload_restore_params(self):
+        """Updated mirror shards -> device params: each host contributes its
+        dp-shard of every flat leaf; the compiled unflatten re-gathers to the
+        param sharding (the step-tail all-gather)."""
+        shards = self.host_optimizer.mirror_flat_shards()
+        flats = [jax.make_array_from_process_local_data(self._flat_sh, s)
+                 for s in shards]
+        if not hasattr(self, "_jit_unflatten_params"):
+            meta, treedef = self._off_meta, self._params_treedef
+            def unflat(flats):
+                leaves = [f[:n].reshape(shape)
+                          for f, (_p, n, shape) in zip(flats, meta)]
+                return jax.tree_util.tree_unflatten(treedef, leaves)
+            self._jit_unflatten_params = jax.jit(
+                unflat, out_shardings=self.param_shardings)
+        return self._jit_unflatten_params(flats)
 
     def _build_offload_jit(self):
         gas = self.gradient_accumulation_steps()
@@ -754,12 +798,20 @@ class DeepSpeedEngine:
             gnorm = _global_norm(grads)
             zeros = jax.tree.map(jnp.zeros_like, acc)
             new_state = dict(state, acc=zeros, rng=rng)
-            return new_state, grads, {"loss": loss_sum / gas,
+            # flatten+pad each leaf and constrain to the dp sharding: XLA
+            # reduce-scatters here, so each host's D2H copies only its shard
+            flats = [
+                jax.lax.with_sharding_constraint(
+                    jnp.pad(g.reshape(-1), (0, padded - n)), self._flat_sh)
+                for g, (padded, n, _shape) in zip(
+                    jax.tree_util.tree_leaves(grads), self._off_meta)]
+            return new_state, flats, {"loss": loss_sum / gas,
                                       "grad_norm": gnorm, "finite": finite}
 
         return jax.jit(train_grads, donate_argnums=(0,),
                        out_shardings=(self._off_state_shardings,
-                                      self.grad_shardings, None))
+                                      [self._flat_sh] * len(self._off_meta),
+                                      None))
 
     def _host_update_scale(self, finite: bool):
         """Host mirror of fp16/loss_scaler.update_scale dynamics — same
@@ -787,7 +839,7 @@ class DeepSpeedEngine:
         if self._jit_train is None:
             self._jit_train = self._build_offload_jit()
         scale = jnp.asarray(self._host_scale, jnp.float32)
-        self.state, grads, metrics = self._jit_train(self.state, batches,
+        self.state, flats, metrics = self._jit_train(self.state, batches,
                                                      scale)
         finite = bool(jax.device_get(metrics["finite"]))
         gnorm = float(jax.device_get(metrics["grad_norm"]))
@@ -797,16 +849,34 @@ class DeepSpeedEngine:
             if clip and clip > 0 and gnorm > clip:
                 combined = gnorm / clip       # divide grads by this
             lr = self.get_lr()[0]
-            g_np = [np.asarray(g) for g in jax.tree.leaves(
-                jax.device_get(grads))]
-            self.host_optimizer.step(g_np, lr=lr, combined_scale=combined)
-            self.state["params"] = jax.device_put(
-                self.host_optimizer.mirror_tree(), self.param_shardings)
+            # overlap: start ALL D2H copies now; the host step of leaf i
+            # then only waits on leaf i while later leaves keep streaming
+            # (the aio double-buffer discipline applied to the host hop;
+            # reference async_accumulate_grad_in_cpu_via_gpu,
+            # stage_1_and_2.py:1014)
+            for f in flats:
+                f.copy_to_host_async()
+            if jax.process_count() > 1:
+                grads_local = [self._extract_local_shard(f) for f in flats]
+            else:
+                grads_local = flats  # np.asarray per leaf inside the step
+            self.host_optimizer.step(grads_local, lr=lr,
+                                     combined_scale=combined)
+            self.state["params"] = self._offload_restore_params()
         else:
             self.skipped_steps += 1
         self._host_update_scale(finite)
         self._last_grad_norm = gnorm
         return metrics
+
+    @staticmethod
+    def _extract_local_shard(f):
+        """Assemble this process's contiguous slice of a dp-sharded flat
+        array from its addressable shards (no cross-host gather)."""
+        shards = sorted(f.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data).reshape(-1)
+                               for s in shards])
 
     @property
     def _offload_loss_scale(self):

@@ -47,20 +47,34 @@ from ..sharding import path_str
 
 
 class _Leaf:
-    """Host bookkeeping for one parameter leaf. In DRAM mode owns the
-    master/moment arrays; in NVMe mode owns only the mirror (master and
-    moments live in the swap file, staged through shared buffers)."""
+    """Host bookkeeping for this host's dp-shard of one parameter leaf.
 
-    def __init__(self, path: str, value, mirror_dtype: str, resident: bool):
+    The flattened leaf is zero-padded to a multiple of ``dp_world`` and each
+    dp rank owns one contiguous ``padded/dp_world`` slice (the reference's
+    flat-partition scheme, stage_1_and_2.py:228-254); this host holds the
+    slices of its local ranks. In DRAM mode it owns master/moment arrays for
+    that slice; in NVMe mode only the mirror (master and moments live in the
+    swap file, staged through shared buffers)."""
+
+    def __init__(self, path: str, value, mirror_dtype: str, resident: bool,
+                 shard):
         self.path = path
         arr = np.asarray(value)
         self.shape = arr.shape
-        self.numel = int(arr.size)
+        self.global_numel = int(arr.size)
+        rank_start, rank_count, world = shard
+        self.shard_len = -(-self.global_numel // world)  # ceil
+        self.padded = self.shard_len * world
+        self.offset = rank_start * self.shard_len
+        self.numel = rank_count * self.shard_len          # local numel
         self.mirror_dtype = mirror_dtype
         # ALWAYS copy: np.asarray on CPU-backend jax arrays can be
         # zero-copy, and the native optimizer writes through raw pointers —
         # aliasing the caller's (or another engine's) buffer would mutate it
-        master = np.array(arr, dtype=np.float32, copy=True).reshape(-1)
+        flat = np.zeros(self.padded, np.float32)
+        flat[:self.global_numel] = np.asarray(arr, np.float32).reshape(-1)
+        master = np.ascontiguousarray(flat[self.offset:self.offset + self.numel])
+        del flat
         if resident:
             self.master: Optional[np.ndarray] = master
             self.exp_avg: Optional[np.ndarray] = np.zeros_like(master)
@@ -83,13 +97,22 @@ class _Leaf:
         elif self.mirror_buf is not None:
             self.mirror_buf[:] = master
 
-    def mirror(self) -> np.ndarray:
-        """Working-copy view in the compute dtype, shaped like the param."""
+    def mirror_flat(self) -> np.ndarray:
+        """This host's flat mirror shard (compute dtype, padded slice)."""
         if self.mirror_dtype == "bfloat16":
-            return self.mirror_buf.view(_BF16).reshape(self.shape)
+            return self.mirror_buf.view(_BF16)
         if self.mirror_buf is not None:
-            return self.mirror_buf.reshape(self.shape)
-        return self.master.reshape(self.shape)  # resident fp32: no copy
+            return self.mirror_buf
+        return self.master
+
+    def mirror(self) -> np.ndarray:
+        """Full-leaf working copy, shaped like the param. Only valid when
+        this host owns the whole leaf (single-host or dp_world==1)."""
+        if self.numel != self.padded:
+            raise RuntimeError(
+                f"leaf {self.path}: host owns {self.numel}/{self.padded} "
+                "elements; full mirror requires whole-leaf ownership")
+        return self.mirror_flat()[:self.global_numel].reshape(self.shape)
 
 
 class NVMeLeafSwapper:
@@ -148,16 +171,23 @@ class HostOffloadOptimizer:
     def __init__(self, params_tree, *, lr: float, betas=(0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  adamw: bool = True, mirror_dtype: str = "bfloat16",
-                 nvme_path: Optional[str] = None, aio_cfg=None):
+                 nvme_path: Optional[str] = None, aio_cfg=None,
+                 dp_shard=(0, 1, 1)):
+        """``dp_shard=(rank_start, rank_count, dp_world)``: this host owns
+        the contiguous dp-rank range [rank_start, rank_start+rank_count) of
+        every flat-partitioned leaf — host work and DRAM scale ~1/hosts
+        (reference: per-rank offloaded partitions, stage_1_and_2.py:1014)."""
         self.opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
                                     weight_decay=weight_decay,
                                     adamw_mode=adamw)
         self.step_count = 0
         self.nvme = nvme_path is not None
+        self.dp_shard = tuple(dp_shard)
         self.treedef = jax.tree_util.tree_structure(params_tree)
         flat, _ = jax.tree_util.tree_flatten_with_path(params_tree)
         self.leaves: List[_Leaf] = [
-            _Leaf(path_str(p), leaf, mirror_dtype, resident=not self.nvme)
+            _Leaf(path_str(p), leaf, mirror_dtype, resident=not self.nvme,
+                  shard=self.dp_shard)
             for p, leaf in flat]
         self.swapper = None
         if self.nvme:
@@ -178,7 +208,19 @@ class HostOffloadOptimizer:
         return self.opt.native
 
     def numel(self) -> int:
+        """LOCAL element count (this host's shards)."""
         return sum(l.numel for l in self.leaves)
+
+    def global_numel(self) -> int:
+        return sum(l.global_numel for l in self.leaves)
+
+    def owns_all(self) -> bool:
+        start, count, world = self.dp_shard
+        return count == world
+
+    def mirror_flat_shards(self) -> List[np.ndarray]:
+        """Per-leaf flat mirror shards (compute dtype) for device upload."""
+        return [l.mirror_flat() for l in self.leaves]
 
     # ------------------------------------------------------------- step
     def step(self, grads_flat: List[np.ndarray], lr: float,
@@ -211,6 +253,10 @@ class HostOffloadOptimizer:
 
     def _step_arrays(self, leaf: _Leaf, master, m, v, grad, lr, inv):
         g = np.ascontiguousarray(np.asarray(grad).reshape(-1), np.float32)
+        if g.size != leaf.numel:
+            raise ValueError(
+                f"leaf {leaf.path}: grad shard has {g.size} elements, "
+                f"host owns {leaf.numel}")
         if inv is not None:
             g = g * inv
         bf16 = leaf.mirror_buf if leaf.mirror_dtype == "bfloat16" else None
@@ -226,6 +272,10 @@ class HostOffloadOptimizer:
             self.treedef, [l.mirror() for l in self.leaves])
 
     def _gather(self, which: str):
+        if not self.owns_all():
+            raise RuntimeError(
+                "full state-tree views need whole-model ownership; under "
+                "multi-host dp partitioning use the sharded checkpoint path")
         out = []
         for i, leaf in enumerate(self.leaves):
             if self.swapper is not None:
@@ -233,7 +283,8 @@ class HostOffloadOptimizer:
             else:
                 master, m, v = leaf.master, leaf.exp_avg, leaf.exp_avg_sq
             src = {"master": master, "exp_avg": m, "exp_avg_sq": v}[which]
-            out.append(np.array(src, copy=True).reshape(leaf.shape))
+            out.append(np.array(src[:leaf.global_numel],
+                                copy=True).reshape(leaf.shape))
         return jax.tree_util.tree_unflatten(self.treedef, out)
 
     def master_tree(self):
@@ -245,15 +296,23 @@ class HostOffloadOptimizer:
                 "step": np.asarray(self.step_count, np.int64)}
 
     def load_state(self, master_tree=None, opt_state=None):
-        new_master = ([np.asarray(x, np.float32).reshape(-1) for x in
-                       jax.tree_util.tree_leaves(master_tree)]
+        def local_slices(tree):
+            """Full leaves -> this host's padded flat shards."""
+            out = []
+            for leaf, x in zip(self.leaves,
+                               jax.tree_util.tree_leaves(tree)):
+                flat = np.zeros(leaf.padded, np.float32)
+                flat[:leaf.global_numel] = np.asarray(
+                    x, np.float32).reshape(-1)
+                out.append(flat[leaf.offset:leaf.offset + leaf.numel])
+            return out
+
+        new_master = (local_slices(master_tree)
                       if master_tree is not None else None)
         new_m = new_v = None
         if opt_state is not None:
-            new_m = [np.asarray(x, np.float32).reshape(-1) for x in
-                     jax.tree_util.tree_leaves(opt_state["exp_avg"])]
-            new_v = [np.asarray(x, np.float32).reshape(-1) for x in
-                     jax.tree_util.tree_leaves(opt_state["exp_avg_sq"])]
+            new_m = local_slices(opt_state["exp_avg"])
+            new_v = local_slices(opt_state["exp_avg_sq"])
             self.step_count = int(opt_state.get("step", self.step_count))
         for i, leaf in enumerate(self.leaves):
             if self.swapper is not None:
