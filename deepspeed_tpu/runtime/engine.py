@@ -179,11 +179,11 @@ class DeepSpeedEngine:
         params.pop("bias_correction", None)
         params.pop("torch_adam", None)
         params.pop("adam_w_mode", None)
-        if otype in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam"):
+        if otype in ("adam", "adamw", "fusedadam"):
             self._opt_factory = lambda lr_fn: fused_adam(
                 lr_fn, betas=betas, eps=eps, weight_decay=wd,
                 adam_w_mode=(otype != "adam"))
-        elif otype in ("lamb", "onebitlamb"):
+        elif otype == "lamb":
             self._opt_factory = lambda lr_fn: fused_lamb(
                 lr_fn, betas=betas, eps=eps, weight_decay=wd, **params)
         elif otype == "adagrad":
@@ -198,6 +198,8 @@ class DeepSpeedEngine:
         self._client_optimizer = None
 
     def _rebuild_optimizer_with_schedule(self):
+        if getattr(self, "_onebit", None) is not None:
+            return  # runner late-binds the schedule via engine.lr_scheduler
         if self.offload_enabled:
             return  # lr comes from get_lr() at each host step
         if self._client_optimizer is not None:
@@ -215,6 +217,25 @@ class DeepSpeedEngine:
             self._init_opt_state()
 
     def _init_state(self, model_parameters, optimizer, rng):
+        oc = self.config.optimizer
+        otype = (oc.type if oc else "").lower()
+        if otype in ("onebitadam", "onebitlamb", "zerooneadam"):
+            # 1-bit optimizers own their communication (compressed momentum
+            # exchange) and state layout; they get a dedicated runner instead
+            # of silently degrading to dense Adam/LAMB.
+            if self.offload_enabled:
+                raise ValueError(f"{oc.type} is incompatible with "
+                                 "offload_optimizer (reference parity)")
+            from .fp16.onebit.integration import OnebitRunner
+            self._onebit = OnebitRunner(self, otype, dict(oc.params),
+                                        model_parameters, rng)
+            self.state = self._onebit.state
+            self.master_shardings = self._onebit.master_shardings
+            self.opt_shardings = self._onebit.opt_shardings
+            self._client_optimizer = None
+            self.optimizer = None
+            return
+        self._onebit = None
         if self.offload_enabled:
             self._init_offload_state(model_parameters, optimizer, rng)
             return
@@ -451,6 +472,19 @@ class DeepSpeedEngine:
         batches = jax.tree.map(lambda *xs: np.stack(xs), *micros)
         batches = self._shard_batch(batches, stacked=True)
 
+        if getattr(self, "_onebit", None) is not None:
+            self.tput_timer.start()
+            metrics = self._onebit.train_batch(batches)
+            self.state = self._onebit.state
+            will_report = (self.global_steps + 1) % self.steps_per_print() == 0
+            self.tput_timer.stop(sync=metrics["loss"] if will_report else None)
+            self.global_steps += 1
+            self.micro_steps += gas
+            self.global_samples += self.train_batch_size()
+            self._last_grad_norm = metrics["grad_norm"]
+            self._after_step(metrics)
+            return metrics["loss"]
+
         if self.offload_enabled:
             self.tput_timer.start()
             metrics = self._offload_train_batch(batches)
@@ -480,6 +514,10 @@ class DeepSpeedEngine:
     # --- 3-call parity API -------------------------------------------------
     def forward(self, batch):
         """Run one micro forward(+grad) and buffer the accumulation."""
+        if getattr(self, "_onebit", None) is not None:
+            raise NotImplementedError(
+                "1-bit optimizers fuse the micro loop with the compressed "
+                "exchange — use engine.train_batch(data_iter)")
         if self.offload_enabled:
             raise NotImplementedError(
                 "with offload_optimizer use engine.train_batch(data_iter) — "
@@ -637,6 +675,14 @@ class DeepSpeedEngine:
                 cur_scale=jnp.asarray(meta["loss_scale"], jnp.float32))
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        if getattr(self, "_onebit", None) is not None:
+            # phase selection (warmup vs compressed, 0/1 Adam intervals) is
+            # keyed on the device step counter — realign it and the host-side
+            # policy counters to the restored step
+            self.state["step"] = jax.device_put(
+                jnp.asarray(meta["global_steps"], jnp.int32),
+                self._onebit._rep)
+            self._onebit.restore_step(meta["global_steps"])
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
         self.micro_steps = meta["micro_steps"]

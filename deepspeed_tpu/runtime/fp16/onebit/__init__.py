@@ -1,0 +1,27 @@
+"""1-bit optimizers: communication-compressed Adam/LAMB variants.
+
+Reference: ``deepspeed/runtime/fp16/onebit/{adam,lamb,zoadam}.py`` — warmup
+phase with dense gradient allreduce, then a compression phase where only
+error-feedback sign-compressed state crosses the wire (via the backends in
+``deepspeed/runtime/comm/nccl.py``).
+
+TPU redesign: each optimizer is a pure per-rank step function executed inside
+``shard_map`` over the ``dp`` mesh axis, with the compressed exchange
+(`deepspeed_tpu.comm.compressed.compressed_allreduce`) emitted as in-graph
+lax collectives. Phase selection (warmup vs compressed vs local-step) is
+host-side control flow — the engine compiles one program per phase and picks
+by global step, mirroring the reference's host-driven ``freeze_key`` logic.
+"""
+
+from .adam import OnebitAdam
+from .lamb import OnebitLamb
+from .zoadam import ZeroOneAdam, ZeroOnePolicy
+
+ONEBIT_OPTIMIZERS = {
+    "onebitadam": OnebitAdam,
+    "onebitlamb": OnebitLamb,
+    "zerooneadam": ZeroOneAdam,
+}
+
+__all__ = ["OnebitAdam", "OnebitLamb", "ZeroOneAdam", "ZeroOnePolicy",
+           "ONEBIT_OPTIMIZERS"]

@@ -1,0 +1,178 @@
+"""1-bit LAMB (reference: deepspeed/runtime/fp16/onebit/lamb.py:11).
+
+Warmup: baseline LAMB with per-tensor trust ratios, maintaining an EMA of
+each tensor's coefficient (``lamb_coeff_freeze``, coeff_beta; lamb.py:244).
+At the freeze boundary the fresh-variance buffer snapshots the variance
+(lamb.py:228) and per-tensor ``scaling_coeff``s equalize momentum magnitudes
+so one flat 1-bit compression serves all tensors (lamb.py:169-184).
+Compression phase: momentum is updated locally, scaled, 1-bit-allreduced,
+then each tensor's frozen coefficient is modulated by the
+frozen-vs-fresh-variance factor with clamps (lamb.py:330-385).
+
+Per-tensor reductions use segment ops over a static leaf-id vector instead
+of the reference's Python loop over params — one fused XLA kernel for all
+tensors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....comm.compressed import compressed_allreduce, padded_size
+
+
+class OnebitLamb:
+    MODES = ("warmup", "comp")
+
+    def __init__(self, n: int, world: int, leaf_slices=None, *,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, freeze_step: int = 100000,
+                 max_coeff: float = 10.0, min_coeff: float = 0.01,
+                 coeff_beta: float = 0.9, factor_max: float = 4.0,
+                 factor_min: float = 0.5, factor_threshold: float = 0.1,
+                 **_ignored):
+        if not leaf_slices:
+            leaf_slices = [(0, n)]
+        self.n = n
+        self.world = world
+        self.npad = padded_size(n, world)
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.coeff_beta = coeff_beta
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.factor_threshold = factor_threshold
+        self.L = len(leaf_slices)
+        ids = jnp.zeros((n,), jnp.int32)
+        sizes = []
+        for i, (s, e) in enumerate(leaf_slices):
+            ids = ids.at[s:e].set(i)
+            sizes.append(e - s)
+        self.leaf_ids = ids
+        self.leaf_sizes = jnp.asarray(sizes, jnp.float32)
+
+    def mode_for(self, step: int) -> str:
+        return "warmup" if step <= self.freeze_step else "comp"
+
+    def transition_actions(self, step: int):
+        return ()
+
+    def comm_is_compressed(self, mode: str) -> bool:
+        return mode == "comp"
+
+    def init_state(self):
+        z = lambda m: jnp.zeros((m,), jnp.float32)
+        return {
+            "mu": z(self.npad),
+            "nu": z(self.npad),
+            "nu_fresh": z(self.npad),
+            "worker_error": z(self.npad),
+            "server_error": z(self.npad // self.world),
+            "scaling": jnp.zeros((self.L,), jnp.float32),   # 0 = not yet set
+            "coeff_freeze": jnp.ones((self.L,), jnp.float32),
+            "last_factor": jnp.ones((self.L,), jnp.float32),
+        }
+
+    def effective_params(self, st, p_flat):
+        return p_flat
+
+    # ---- per-leaf helpers ----------------------------------------------------
+    def _seg_sum(self, x):
+        return jax.ops.segment_sum(x, self.leaf_ids, num_segments=self.L)
+
+    def _seg_max(self, x):
+        return jax.ops.segment_max(x, self.leaf_ids, num_segments=self.L)
+
+    def _leaf_norms(self, x):
+        return jnp.sqrt(self._seg_sum(x * x))
+
+    def _bcast(self, per_leaf):
+        return jnp.take(per_leaf, self.leaf_ids)
+
+    # ---- per-rank step --------------------------------------------------------
+    def step(self, mode: str, g: jnp.ndarray, st, p: jnp.ndarray,
+             lr, count, axis: str):
+        b1, b2 = self.betas
+        st = dict(st)
+        if mode == "warmup":
+            return self._warmup(g, st, p, lr, count, axis)
+        return self._comp(g, st, p, lr, axis)
+
+    def _warmup(self, g, st, p, lr, count, axis):
+        b1, b2 = self.betas
+        g = jax.lax.pmean(g, axis)
+        mu = b1 * st["mu"] + (1 - b1) * g
+        nu = b2 * st["nu"] + (1 - b2) * g * g
+        # freeze-boundary snapshot of the variance (lamb.py:228)
+        at_freeze = (count == self.freeze_step)
+        nu_fresh = jnp.where(at_freeze, nu, st["nu_fresh"])
+
+        update = mu[:self.n] / (jnp.sqrt(nu[:self.n]) + self.eps)
+        if self.weight_decay > 0.0:
+            update = update + self.weight_decay * p
+        w_norm = self._leaf_norms(p)
+        u_norm = self._leaf_norms(update)
+        raw = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / jnp.maximum(u_norm, 1e-30),
+                        jnp.ones_like(w_norm))
+        coeff = jnp.clip(raw, self.min_coeff, self.max_coeff)
+        # EMA only where a real (non-unity) coefficient was computed (lamb.py:244)
+        cf = jnp.where(coeff != 1.0,
+                       self.coeff_beta * st["coeff_freeze"] + (1 - self.coeff_beta) * coeff,
+                       st["coeff_freeze"])
+        new_p = p - lr * self._bcast(coeff) * update
+        st.update(mu=mu, nu=nu, nu_fresh=nu_fresh, coeff_freeze=cf)
+        return new_p, st
+
+    def _comp(self, g, st, p, lr, axis):
+        b1, b2 = self.betas
+        mu_prev = st["mu"]
+        mu_local = b1 * mu_prev + (1 - b1) * g
+
+        # one-time scaling coefficients on entry to the compression phase
+        # (lamb.py:169-184): equalize per-tensor momentum scale around the
+        # united mean so a single flat sign-compression fits every tensor
+        m_scale = self._leaf_norms(mu_local[:self.n]) / jnp.sqrt(self.leaf_sizes)
+        m_scale = jnp.maximum(m_scale, 1e-30)
+        united = jnp.mean(m_scale)
+        first = st["scaling"][0] == 0
+        scaling = jnp.where(first, united / m_scale, st["scaling"])
+        scale_flat = jnp.ones((self.npad,), jnp.float32).at[:self.n].set(
+            self._bcast(scaling))
+
+        red, we, se = compressed_allreduce(
+            mu_local * scale_flat, st["worker_error"], st["server_error"],
+            axis, self.world)
+        mu = red / scale_flat
+
+        # fresh-variance update from the reconstructed gradient (lamb.py:352-356)
+        grad_recon = (mu - b1 * mu_prev) / (1 - b1)
+        nu_fresh = b2 * st["nu_fresh"] + (1 - b2) * grad_recon * grad_recon
+
+        denom = jnp.sqrt(st["nu"][:self.n]) + self.eps
+        denom_real = jnp.sqrt(nu_fresh[:self.n]) + self.eps
+        update_prelim = mu[:self.n] / denom
+        if self.weight_decay > 0.0:
+            update = update_prelim + self.weight_decay * p
+        else:
+            update = update_prelim
+
+        factor = self._seg_max(denom / denom_real)
+        if self.weight_decay > 0.0:
+            ratio = jnp.minimum(
+                1.0, self._leaf_norms(update_prelim) /
+                jnp.maximum(self._leaf_norms(update), 1e-30))
+            factor = factor * ratio + (1.0 - ratio)
+        factor = jnp.clip(factor, self.factor_min, self.factor_max)
+        factor = jnp.clip(factor,
+                          st["last_factor"] * (1.0 - self.factor_threshold),
+                          st["last_factor"] * (1.0 + self.factor_threshold))
+        coeff = st["coeff_freeze"] * factor
+        new_p = p - lr * self._bcast(coeff) * update
+        st.update(mu=mu, nu_fresh=nu_fresh, worker_error=we, server_error=se,
+                  scaling=scaling, last_factor=factor)
+        return new_p, st

@@ -1,0 +1,175 @@
+"""0/1 Adam (reference: deepspeed/runtime/fp16/onebit/zoadam.py:14,
+paper arxiv 2202.06009).
+
+Three regimes, all host-scheduled (the reference drives them with
+``var_interval``/``local_step_interval`` counters; ``ZeroOnePolicy`` mirrors
+that math exactly):
+
+  * variance steps (pre-freeze, step % var_interval == 0): dense-allreduced
+    gradient updates BOTH moments; the interval doubles every
+    ``var_update_scaler`` occurrences (zoadam.py:289-296).
+  * compressed-gradient steps (pre-freeze, otherwise): the gradient itself is
+    1-bit-allreduced and folded into the momentum only (zoadam.py:215-227).
+  * after ``var_freeze_step``: local steps — each rank applies its own
+    momentum update with NO communication, accumulating the applied update
+    (the paper's ``u`` variable / reference ``momentum_accumulator``); every
+    ``local_step_interval`` steps the accumulated update is scaled back to
+    momentum space, 1-bit-allreduced, and used to (a) re-synchronize params
+    and (b) rebuild the momentum (zoadam.py:252-273).
+
+TPU twist: params diverge across ranks during local steps. The engine keeps
+ONE replicated master and a per-rank ``delta`` (sharded over dp); effective
+params are ``master + delta``. Master only ever changes by rank-invariant
+amounts (dense/compressed allreduce results), so its replication is
+preserved by construction — no parameter broadcast needed at sync.
+
+Note on eval/export between syncs: ``eval_batch``/``save_16bit_model`` read
+the replicated master, which trails the per-rank effective params by up to
+``local_step_interval`` (<= local_step_clipper) local updates. This skew is
+inherent to the algorithm (the reference's per-rank params diverge the same
+way, zoadam.py:252); the master is the last globally-agreed iterate — the
+conservative choice for export.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....comm.compressed import compressed_allreduce, padded_size
+
+
+class ZeroOnePolicy:
+    """Host-side mirror of the reference's interval counters
+    (zoadam.py:289-305, 172-186). Call ``next()`` once per optimizer step."""
+
+    def __init__(self, var_freeze_step=100000, var_update_scaler=16,
+                 local_step_scaler=32678, local_step_clipper=16):
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+        self.step = 0
+        self.var_interval = 1
+        self.var_counter = 0
+        self.local_interval = 1
+        self.local_counter = 0
+        self.frozen = False
+        self._errors_reinit = False
+
+    def next(self):
+        """Advance one step; returns (mode, actions) where mode is one of
+        dense | grad_comp | local | sync and actions may contain
+        'reinit_errors' (the reference zeroes the error buffers when entering
+        the local-step regime since they switch metrics, zoadam.py:306-313)."""
+        self.step += 1
+        actions = ()
+        if not self.frozen:
+            mode = "dense" if self.step % self.var_interval == 0 else "grad_comp"
+            if self.step % self.var_interval == 0:
+                self.var_counter += 1
+                if self.var_counter == self.var_update_scaler:
+                    self.var_counter = 0
+                    self.var_interval *= 2
+            if self.step > self.var_freeze_step:
+                self.frozen = True
+        else:
+            if not self._errors_reinit:
+                actions = ("reinit_errors",)
+                self._errors_reinit = True
+            mode = "sync" if self.step % self.local_interval == 0 else "local"
+            self.local_counter += 1
+            if self.local_counter == self.local_step_scaler:
+                self.local_counter = 0
+                self.local_interval = min(self.local_step_clipper,
+                                          self.local_interval * 2)
+        return mode, actions
+
+
+class ZeroOneAdam:
+    MODES = ("dense", "grad_comp", "local", "sync")
+
+    def __init__(self, n: int, world: int, leaf_slices=None, *,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, var_freeze_step: int = 100000,
+                 var_update_scaler: int = 16, local_step_scaler: int = 32678,
+                 local_step_clipper: int = 16, **_ignored):
+        self.n = n
+        self.world = world
+        self.npad = padded_size(n, world)
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.policy = ZeroOnePolicy(var_freeze_step, var_update_scaler,
+                                    local_step_scaler, local_step_clipper)
+
+    def mode_for(self, step: int) -> str:
+        # policy is stateful; runner must call each step in order
+        self._mode, self._actions = self.policy.next()
+        assert self.policy.step == step, (
+            f"ZeroOneAdam policy out of sync: policy step {self.policy.step}, "
+            f"engine step {step}")
+        return self._mode
+
+    def transition_actions(self, step: int):
+        return self._actions
+
+    def comm_is_compressed(self, mode: str) -> bool:
+        return mode in ("grad_comp", "sync")
+
+    def init_state(self):
+        z = lambda m: jnp.zeros((m,), jnp.float32)
+        return {
+            "mu": z(self.npad),
+            "nu": z(self.npad),
+            "delta": z(self.n),            # per-rank param divergence
+            "lrs": jnp.zeros((), jnp.float32),
+            "worker_error": z(self.npad),
+            "server_error": z(self.npad // self.world),
+        }
+
+    def effective_params(self, st, p_flat):
+        return p_flat + st["delta"]
+
+    def step(self, mode: str, g: jnp.ndarray, st, p: jnp.ndarray,
+             lr, count, axis: str):
+        b1, b2 = self.betas
+        st = dict(st)
+        if mode == "dense":
+            g = jax.lax.pmean(g, axis)
+            st["nu"] = b2 * st["nu"] + (1 - b2) * g * g
+            st["mu"] = b1 * st["mu"] + (1 - b1) * g
+        elif mode == "grad_comp":
+            g_red, we, se = compressed_allreduce(
+                g, st["worker_error"], st["server_error"], axis, self.world)
+            st.update(mu=b1 * st["mu"] + (1 - b1) * g_red,
+                      worker_error=we, server_error=se)
+        else:  # local / sync: momentum from LOCAL gradient, no comm yet
+            st["mu"] = b1 * st["mu"] + (1 - b1) * g
+            st["lrs"] = st["lrs"] + lr
+
+        denom = jnp.sqrt(st["nu"][:self.n]) + self.eps
+        update = st["mu"][:self.n] / denom
+        if self.weight_decay > 0.0:
+            update = update + self.weight_decay * self.effective_params(st, p)
+
+        if mode in ("dense", "grad_comp"):
+            return p - lr * update, st
+
+        # local regime: apply to the per-rank delta, master untouched
+        st["delta"] = st["delta"] - lr * update
+        if mode == "local":
+            return p, st
+
+        # sync (zoadam.py:252-273): exchange the accumulated update in
+        # momentum space, rebuild momentum, fold the averaged update into the
+        # replicated master, zero the divergence
+        buf = jnp.zeros((self.npad,), jnp.float32).at[:self.n].set(
+            st["delta"] * denom)
+        red, we, se = compressed_allreduce(
+            buf, st["worker_error"], st["server_error"], axis, self.world)
+        st.update(mu=-red / st["lrs"],
+                  worker_error=we, server_error=se,
+                  delta=jnp.zeros_like(st["delta"]),
+                  lrs=jnp.zeros((), jnp.float32))
+        return p + red[:self.n] / denom, st
