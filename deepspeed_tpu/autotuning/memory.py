@@ -1,0 +1,100 @@
+"""ZeRO memory models for autotuning and user-facing estimation.
+
+Reference analogues: ``autotuning/autotuner.py:261-285``
+(get_instantiation_memory_required_per_gpu — the stage-aware params/grads/
+optimizer arithmetic) and the ``estimate_zero{2,3}_model_states_mem_needs``
+helpers in ``runtime/zero/utils``. The arithmetic is the published ZeRO
+paper's: with Adam, fp16 params (2N) + fp16 grads (2N) + fp32 master+
+momentum+variance (12N), divided over the dp world according to stage.
+
+TPU adaptations: bf16 instead of fp16 (same 2 bytes), per-chip HBM budgets
+for common TPU generations, and a mesh-aware divisor (tp shards everything
+multiplicatively with dp for the states it touches).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# per-chip HBM, bytes (usable ~95%); used when the backend can't report it
+TPU_HBM_BYTES = {
+    "v4": 32e9,
+    "v5e": 16e9,
+    "v5p": 95e9,
+    "v6e": 32e9,
+}
+
+
+def chip_memory_bytes(default: float = 16e9) -> float:
+    """Best-effort HBM size of the attached chip (falls back to `default`)."""
+    try:
+        import jax
+        d = jax.devices()[0]
+        stats = d.memory_stats() or {}
+        if "bytes_limit" in stats:
+            return float(stats["bytes_limit"])
+    except Exception:
+        pass
+    return default
+
+
+def model_states_memory_per_chip(num_params: int, *, zero_stage: int,
+                                 dp: int = 1, mp: int = 1,
+                                 half_precision: bool = True,
+                                 optimizer_factor: int = 12) -> float:
+    """Bytes/chip for params+grads+optimizer states (no activations).
+
+    optimizer_factor: bytes per param of optimizer state at fp32 master —
+    12 for Adam (master + mu + nu), 8 for momentum-SGD, 4 for master-only.
+    """
+    p_bytes = 2 if half_precision else 4
+    params = num_params * p_bytes
+    grads = num_params * 4          # grads accumulated in fp32 on TPU
+    optim = num_params * optimizer_factor
+    if zero_stage >= 1:
+        optim /= dp
+    if zero_stage >= 2:
+        grads /= dp
+    if zero_stage >= 3:
+        params /= dp
+    return (params + grads + optim) / mp
+
+
+def activation_memory_per_chip(*, micro_batch: int, seq_len: int,
+                               hidden: int, layers: int, dp_shard: bool = False,
+                               bytes_per_el: int = 2,
+                               checkpoint_activations: bool = False) -> float:
+    """Transformer activation estimate (per chip): the standard
+    ~ B*S*H*layers*C term, C≈16 without remat, ≈2 with full remat (only
+    layer inputs saved)."""
+    c = 2 if checkpoint_activations else 16
+    total = micro_batch * seq_len * hidden * layers * c * bytes_per_el
+    return total
+
+
+def max_micro_batch_for_budget(budget_bytes: float, *, num_params: int,
+                               zero_stage: int, dp: int, mp: int,
+                               seq_len: int, hidden: int, layers: int,
+                               checkpoint_activations: bool = False) -> int:
+    """Largest micro-batch whose states+activations fit in budget_bytes."""
+    states = model_states_memory_per_chip(
+        num_params, zero_stage=zero_stage, dp=dp, mp=mp)
+    if states >= budget_bytes:
+        return 0
+    per_sample = activation_memory_per_chip(
+        micro_batch=1, seq_len=seq_len, hidden=hidden, layers=layers,
+        checkpoint_activations=checkpoint_activations)
+    if per_sample <= 0:
+        return 1
+    return max(0, int((budget_bytes - states) // per_sample))
+
+
+def estimate_zero_model_states_mem_needs(num_params: int,
+                                         num_chips_per_host: int = 4,
+                                         num_hosts: int = 1) -> Dict[int, float]:
+    """Per-stage bytes/chip table (the reference's estimate_zero*_mem_needs
+    user helpers, printed by ds_report-style tooling)."""
+    world = num_chips_per_host * num_hosts
+    return {stage: model_states_memory_per_chip(
+        num_params, zero_stage=stage, dp=world)
+        for stage in (0, 1, 2, 3)}

@@ -1,0 +1,19 @@
+"""Batch-size elasticity (reference: deepspeed/elasticity/elasticity.py).
+
+Picks a total train batch size whose factor structure admits MANY valid
+device counts, so a resource scheduler can grow/shrink the job across
+restarts without changing convergence (batch size and thus the effective
+data distribution stay fixed; only micro-batch x GAS x world factorization
+changes). Not fault tolerance — that's checkpoint/resume.
+"""
+
+from .elasticity import (ElasticityConfig, ElasticityConfigError,
+                         ElasticityError, ElasticityIncompatibleWorldSize,
+                         compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config,
+                         highly_composite_numbers)
+
+__all__ = ["compute_elastic_config", "elasticity_enabled",
+           "ensure_immutable_elastic_config", "ElasticityConfig",
+           "ElasticityError", "ElasticityConfigError",
+           "ElasticityIncompatibleWorldSize", "highly_composite_numbers"]

@@ -1,0 +1,36 @@
+"""CLI for elastic-config resolution (reference: bin/ds_elastic).
+
+Usage: python -m deepspeed_tpu.elasticity --config ds_config.json [-w N]
+"""
+
+import argparse
+import json
+
+from . import compute_elastic_config
+
+
+def main():
+    parser = argparse.ArgumentParser(prog="ds_elastic")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json with an elasticity block")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="resolve the micro-batch for this chip count")
+    args = parser.parse_args()
+    with open(args.config) as fh:
+        ds_config = json.load(fh)
+    print(json.dumps(ds_config.get("elasticity", {}), indent=2))
+    if args.world_size > 0:
+        batch, worlds, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size)
+        print(f"train_batch_size = {batch}")
+        print(f"valid chip counts = {worlds}")
+        print(f"micro_batch @ world {args.world_size} = {micro}, "
+              f"gas = {batch // (micro * args.world_size)}")
+    else:
+        batch, worlds = compute_elastic_config(ds_config)
+        print(f"train_batch_size = {batch}")
+        print(f"valid chip counts = {worlds}")
+
+
+if __name__ == "__main__":
+    main()

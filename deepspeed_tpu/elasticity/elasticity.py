@@ -1,0 +1,258 @@
+"""Batch-size elasticity math.
+
+Reference: ``deepspeed/elasticity/elasticity.py:63-320`` — candidate batch
+sizes are micro-batch bases scaled by highly-composite numbers (HCNs), and
+the winner is the candidate compatible with the most device counts.
+
+Differences from the reference (TPU-first, not a port):
+
+  * The reference ships a hardcoded HCN table (elasticity.py:27-61); here
+    HCNs are *generated* by divisor-count search up to the needed bound, so
+    arbitrary ``max_train_batch_size`` values work.
+  * ``chip_multiple``: TPU jobs scale in whole hosts (4 or 8 chips per VM)
+    or pod slices, so valid device counts can be constrained to multiples
+    of a chip granule — an axis the GPU reference doesn't have.
+  * Counting valid worlds enumerates divisors directly instead of the
+    reference's half-range scan (same result, O(sqrt) per candidate).
+
+The elastic config schema is kept verbatim for drop-in compatibility
+(enabled / max_train_batch_size / micro_batch_sizes / min_gpus / max_gpus /
+prefer_larger_batch_size / version).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from functools import lru_cache
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+from ..version import __version__
+
+LATEST_ELASTICITY_VERSION = 0.1
+MINIMUM_DEEPSPEED_VERSION = "0.0.1"
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+
+class ElasticityError(Exception):
+    """Base exception for elasticity errors."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Bad or missing elastic configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """World size not in the valid device-count list."""
+
+
+class ElasticityConfig:
+    """Typed view of the ``elasticity`` config block (schema-compatible with
+    reference elasticity/config.py:27)."""
+
+    def __init__(self, param_dict: dict):
+        self.enabled = param_dict.get("enabled", False)
+        if self.enabled:
+            if "max_train_batch_size" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing max_train_batch_size")
+            if "micro_batch_sizes" not in param_dict:
+                raise ElasticityConfigError(
+                    "Elasticity config missing micro_batch_sizes")
+        self.max_acceptable_batch_size = param_dict.get("max_train_batch_size", 0)
+        self.micro_batches = param_dict.get("micro_batch_sizes", [])
+        if self.micro_batches:
+            if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+                raise ElasticityConfigError(
+                    f"micro_batch_sizes must be positive ints, got "
+                    f"{self.micro_batches}")
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", -1)
+        self.chip_multiple = param_dict.get("chip_multiple", 1)
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            "ignore_non_elastic_batch_info", False)
+
+    def as_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "max_train_batch_size": self.max_acceptable_batch_size,
+            "micro_batch_sizes": list(self.micro_batches),
+            "min_gpus": self.min_gpus,
+            "max_gpus": self.max_gpus,
+            "chip_multiple": self.chip_multiple,
+            "version": self.version,
+        }
+
+
+@lru_cache(maxsize=None)
+def highly_composite_numbers(bound: int) -> Tuple[int, ...]:
+    """All highly composite numbers <= bound (each has more divisors than any
+    smaller positive integer). Generated, not tabulated — the reference's
+    HCN_LIST (elasticity.py:27) is the prefix of this sequence."""
+    hcns, best = [], 0
+    n = 1
+    while n <= bound:
+        d = _divisor_count(n)
+        if d > best:
+            best = d
+            hcns.append(n)
+        n += 1 if n < 60 else _hcn_stride(n)
+    return tuple(hcns)
+
+
+def _divisor_count(n: int) -> int:
+    cnt, i = 1, 2
+    while i * i <= n:
+        if n % i == 0:
+            e = 0
+            while n % i == 0:
+                n //= i
+                e += 1
+            cnt *= e + 1
+        i += 1
+    if n > 1:
+        cnt *= 2
+    return cnt
+
+
+def _hcn_stride(n: int) -> int:
+    # HCNs > 60 are all divisible by 60; stepping by 60 keeps generation
+    # O(bound/60 * sqrt(bound)) while provably visiting every HCN
+    return 60 - (n % 60) if n % 60 else 60
+
+
+def _divisors(n: int) -> List[int]:
+    out = []
+    i = 1
+    while i * i <= n:
+        if n % i == 0:
+            out.append(i)
+            if i != n // i:
+                out.append(n // i)
+        i += 1
+    return sorted(out)
+
+
+def get_candidate_batch_sizes(base_list: Sequence[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """For each base (micro-batches and their lcm), the largest HCN multiple
+    of the base <= the cap (reference get_candidate_batch_sizes:103)."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+            continue
+        limit = max_acceptable_batch_size // base
+        hcns = [h for h in highly_composite_numbers(limit) if h <= limit]
+        if hcns:
+            candidates.add(hcns[-1] * base)
+    out = sorted(candidates)
+    logger.info(f"Candidate batch sizes: {out}")
+    return out
+
+
+def get_valid_worlds(batch_size: int, micro_batches: Sequence[int],
+                     min_worlds: int, max_worlds: int,
+                     chip_multiple: int = 1) -> List[int]:
+    """Device counts w such that batch_size = micro * gas * w for some micro
+    in micro_batches and integer gas >= 1 (reference get_valid_gpus:117,
+    re-derived as divisor enumeration), optionally restricted to whole-host
+    multiples."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        per_step = batch_size // micro  # = gas * world
+        for w in _divisors(per_step):
+            if min_worlds <= w <= max_worlds and w % chip_multiple == 0:
+                valid.add(w)
+    return sorted(valid)
+
+
+def _best_candidate(candidates, micro_batches, min_worlds, max_worlds,
+                    chip_multiple, prefer_larger):
+    best_bs, best_worlds = int(min(micro_batches)), []
+    for bs in candidates:
+        worlds = get_valid_worlds(bs, micro_batches, min_worlds, max_worlds,
+                                  chip_multiple)
+        better = (len(worlds) > len(best_worlds)
+                  or (len(worlds) == len(best_worlds)
+                      and (bs > best_bs if prefer_larger else bs < best_bs)))
+        if better:
+            best_bs, best_worlds = bs, worlds
+    return best_bs, best_worlds
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return ds_config.get("elasticity", {}).get("enabled", False)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict) -> None:
+    """Guard against the scheduler and the runtime disagreeing on the elastic
+    config (reference elasticity.py:193-224): the scheduler serialized its
+    view into DEEPSPEED_ELASTICITY_CONFIG at job-submission time."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            "DEEPSPEED_ELASTICITY_CONFIG not set; cannot verify the resource "
+            "scheduler is scaling this job with compatible chip counts")
+        return
+    sched = ElasticityConfig(json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+    run = ElasticityConfig(runtime_elastic_config_dict)
+    for field in ("max_acceptable_batch_size", "micro_batches", "version"):
+        if getattr(sched, field) != getattr(run, field):
+            raise ElasticityConfigError(
+                f"Elastic config mismatch on {field}: scheduler saw "
+                f"{getattr(sched, field)}, runtime has {getattr(run, field)}")
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = None,
+                           world_size: int = 0):
+    """Reference compute_elastic_config (elasticity.py:226): returns
+    (final_batch_size, valid_worlds[, micro_batch_size if world_size>0]).
+
+    Deterministic for a given config, so scheduler and runtime agree."""
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"expected ds_config dict, got {type(ds_config)}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError(
+            "'elasticity' block missing from config; add it for elastic jobs")
+    ecd = ds_config["elasticity"]
+    if not ecd.get("enabled", False):
+        raise ElasticityConfigError("elasticity is disabled ('enabled': false)")
+    ec = ElasticityConfig(ecd)
+    if float(ec.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {ec.version} > supported "
+            f"{LATEST_ELASTICITY_VERSION}")
+
+    micro_batches = list(ec.micro_batches)
+    cap = ec.max_acceptable_batch_size
+    if not all(m <= cap for m in micro_batches):
+        raise ElasticityConfigError(
+            f"all micro batches must be <= max_train_batch_size={cap}")
+    min_w = ec.min_gpus or 1
+    max_w = ec.max_gpus if ec.max_gpus and ec.max_gpus > 0 else cap // min(micro_batches)
+
+    bases = sorted(set(micro_batches) | {math.lcm(*micro_batches)})
+    candidates = get_candidate_batch_sizes(bases, cap)
+    final_bs, valid = _best_candidate(candidates, micro_batches, min_w, max_w,
+                                      ec.chip_multiple,
+                                      ec.prefer_larger_batch_size)
+    logger.info(f"elastic batch size {final_bs}, valid chip counts {valid}")
+
+    if world_size > 0:
+        if world_size not in valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid chip counts {valid}")
+        micro = next((m for m in sorted(set(micro_batches), reverse=True)
+                      if (final_bs // world_size) % m == 0), None)
+        if micro is None:
+            raise ElasticityError(
+                f"no micro batch divides {final_bs}/{world_size}")
+        return final_bs, valid, micro
+    return final_bs, valid

@@ -281,6 +281,7 @@ class ElasticityConfig:
     version: float = 0.1
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    chip_multiple: int = 1   # TPU extension: scale in whole hosts/slices
 
 
 @dataclass
@@ -437,6 +438,9 @@ class DeepSpeedConfig:
 
     # -- batch algebra (reference config.py:934-1024) ----------------------
     def _resolve_batch_sizes(self):
+        if self.elasticity.enabled:
+            self._resolve_elastic_batch_sizes()
+            return
         tb = self.train_batch_size
         mb = self.train_micro_batch_size_per_gpu
         gas = self.gradient_accumulation_steps
@@ -461,6 +465,33 @@ class DeepSpeedConfig:
         self.train_batch_size = tb
         self.train_micro_batch_size_per_gpu = mb
         self.gradient_accumulation_steps = gas
+
+    def _resolve_elastic_batch_sizes(self):
+        """Elasticity owns the batch algebra (reference config.py:34-44 via
+        elasticity/elasticity.py:226): the elastic block determines
+        train_batch_size and the micro-batch for this world size."""
+        from ..elasticity import compute_elastic_config
+        ec = self.elasticity
+        user_set = any(v is not None for v in (
+            self.train_batch_size, self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps))
+        if user_set and not ec.ignore_non_elastic_batch_info:
+            raise DeepSpeedConfigError(
+                "elasticity is enabled: remove train_batch_size/"
+                "train_micro_batch_size_per_gpu/gradient_accumulation_steps "
+                "from the config, or set elasticity."
+                "ignore_non_elastic_batch_info to let elasticity override")
+        block = {"enabled": True,
+                 "max_train_batch_size": ec.max_train_batch_size,
+                 "micro_batch_sizes": list(ec.micro_batch_sizes),
+                 "min_gpus": ec.min_gpus, "max_gpus": ec.max_gpus,
+                 "chip_multiple": ec.chip_multiple, "version": ec.version,
+                 "prefer_larger_batch": ec.prefer_larger_batch}
+        tb, _, micro = compute_elastic_config({"elasticity": block},
+                                              world_size=self.dp_world_size)
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = tb // (micro * self.dp_world_size)
 
     def _sanity_check(self):
         tb = self.train_batch_size

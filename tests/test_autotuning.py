@@ -1,0 +1,110 @@
+"""Autotuner tests (reference: autotuner fast-mode pruning + measured sweep,
+deepspeed/autotuning/autotuner.py)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.autotuning import (
+    Autotuner, TuningSpace, estimate_zero_model_states_mem_needs,
+    max_micro_batch_for_budget, model_states_memory_per_chip)
+from simple_model import SimpleModel, mse_loss, random_batch
+
+
+# ---------------------------------------------------------------- memory model
+
+def test_zero_memory_model_stages():
+    n = 1_000_000_000  # 1B params
+    m0 = model_states_memory_per_chip(n, zero_stage=0, dp=8)
+    m1 = model_states_memory_per_chip(n, zero_stage=1, dp=8)
+    m2 = model_states_memory_per_chip(n, zero_stage=2, dp=8)
+    m3 = model_states_memory_per_chip(n, zero_stage=3, dp=8)
+    assert m0 > m1 > m2 > m3
+    # stage0 = 2N + 4N + 12N = 18N; stage3 = 18N/8
+    assert m0 == pytest.approx(18 * n)
+    assert m3 == pytest.approx(18 * n / 8)
+    # mp divides everything
+    assert model_states_memory_per_chip(n, zero_stage=0, dp=8, mp=4) == \
+        pytest.approx(m0 / 4)
+
+
+def test_estimate_table():
+    t = estimate_zero_model_states_mem_needs(10_000_000, 4, 2)
+    assert set(t) == {0, 1, 2, 3} and t[3] < t[0]
+
+
+def test_max_micro_batch_for_budget():
+    kw = dict(num_params=1_000_000, zero_stage=1, dp=8, mp=1,
+              seq_len=128, hidden=64, layers=2)
+    big = max_micro_batch_for_budget(1e9, **kw)
+    small = max_micro_batch_for_budget(4e7, **kw)
+    assert big > small >= 0
+    assert max_micro_batch_for_budget(1e3, **kw) == 0  # states don't fit
+
+
+# ---------------------------------------------------------------- e2e sweep
+
+def _factories(hidden=16):
+    model = SimpleModel(hidden_dim=hidden)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, hidden), np.float32))["params"]
+
+    def engine_factory(cfg):
+        engine, *_ = ds.initialize(model=model, model_parameters=params,
+                                   loss_fn=mse_loss, config=cfg)
+        return engine
+
+    def data_factory(micro):
+        batch = random_batch(micro * 8, dim=hidden)  # dp=8 shards dim 0
+        return lambda: iter([batch])
+
+    return engine_factory, data_factory
+
+
+def test_autotuner_sweep(tmp_path):
+    engine_factory, data_factory = _factories()
+    base = {"gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10000}
+    tuner = Autotuner(engine_factory, data_factory, base,
+                      warmup_steps=1, measure_steps=2,
+                      results_dir=str(tmp_path))
+    best = tuner.tune(TuningSpace(zero_stages=(0, 1), micro_batches=(4, 8)))
+    assert best is not None
+    assert best["zero_optimization"]["stage"] in (0, 1)
+    assert best["train_micro_batch_size_per_gpu"] in (4, 8)
+    # all 4 experiments ran and recorded
+    assert len(tuner.records) == 4
+    assert all(r.metric_val is not None for r in tuner.records)
+    # results persisted
+    with open(os.path.join(str(tmp_path), "summary.json")) as f:
+        summary = json.load(f)
+    assert summary["best"]["config"] == best
+    assert len(summary["records"]) == 4
+
+
+def test_autotuner_memory_pruning(tmp_path):
+    engine_factory, data_factory = _factories()
+    base = {"gradient_accumulation_steps": 1, "steps_per_print": 10000}
+    # a "model" so big that only stage 3 could fit in HBM
+    tuner = Autotuner(engine_factory, data_factory, base,
+                      num_params=20_000_000_000, results_dir=str(tmp_path),
+                      warmup_steps=0, measure_steps=1)
+    exps = tuner._experiments(TuningSpace(zero_stages=(0, 3),
+                                          micro_batches=(4,)))
+    stages = {e.config["zero_optimization"]["stage"] for e in exps}
+    assert 0 not in stages  # pruned by the memory model
+
+
+def test_autotuner_records_failures(tmp_path):
+    def bad_factory(cfg):
+        raise RuntimeError("boom")
+    tuner = Autotuner(bad_factory, lambda m: lambda: iter([]), {},
+                      results_dir=str(tmp_path))
+    best = tuner.tune(TuningSpace(zero_stages=(1,), micro_batches=(4,)))
+    assert best is None
+    assert tuner.records[0].error and "boom" in tuner.records[0].error

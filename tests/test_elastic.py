@@ -1,0 +1,111 @@
+"""Elasticity tests (reference: tests/unit/test_elastic.py)."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError, ElasticityIncompatibleWorldSize,
+    compute_elastic_config, elasticity_enabled,
+    ensure_immutable_elastic_config, highly_composite_numbers)
+
+BASE = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                       "micro_batch_sizes": [2, 4, 6], "min_gpus": 1,
+                       "max_gpus": 10000, "version": 0.1}}
+
+
+def test_hcn_generation_matches_known_sequence():
+    known = [1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840,
+             1260, 1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720,
+             45360, 50400]
+    assert list(highly_composite_numbers(50400)) == known
+
+
+def test_basic_config():
+    bs, worlds = compute_elastic_config(json.loads(json.dumps(BASE)))
+    assert bs <= 2000
+    # every valid world admits an integral micro*gas factorization
+    for w in worlds:
+        assert any(bs % (m * w) == 0 for m in [2, 4, 6])
+    # high elasticity: dozens of valid counts
+    assert len(worlds) > 20
+
+
+def test_world_size_resolution():
+    bs, worlds, micro = compute_elastic_config(BASE, world_size=12)
+    assert 12 in worlds
+    assert micro in (2, 4, 6)
+    assert bs % (micro * 12) == 0
+
+
+def test_invalid_world_size():
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(BASE, world_size=1327)
+
+
+def test_missing_block_and_disabled():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    assert not elasticity_enabled({})
+    assert elasticity_enabled(BASE)
+
+
+def test_micro_batch_larger_than_max_rejected():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 4,
+                          "micro_batch_sizes": [8]}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+
+
+def test_chip_multiple_constraint():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2048,
+                          "micro_batch_sizes": [8], "chip_multiple": 4}}
+    _, worlds = compute_elastic_config(cfg)
+    assert worlds and all(w % 4 == 0 for w in worlds)
+
+
+def test_immutable_config_guard(monkeypatch):
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG",
+                       json.dumps(BASE["elasticity"]))
+    ensure_immutable_elastic_config(BASE["elasticity"])  # matches: no raise
+    bad = dict(BASE["elasticity"], max_train_batch_size=999)
+    with pytest.raises(ElasticityConfigError):
+        ensure_immutable_elastic_config(bad)
+
+
+def test_deterministic():
+    a = compute_elastic_config(json.loads(json.dumps(BASE)))
+    b = compute_elastic_config(json.loads(json.dumps(BASE)))
+    assert a == b
+
+
+def test_engine_config_integration():
+    """Elasticity enabled in a DeepSpeedConfig drives the batch algebra
+    (reference runtime/config.py:34-44)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+    cfg = DeepSpeedConfig(
+        {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                        "micro_batch_sizes": [2, 4, 6]}},
+        dp_world_size=8)
+    assert cfg.train_batch_size == 1680
+    assert cfg.train_micro_batch_size_per_gpu in (2, 4, 6)
+    assert (cfg.train_batch_size ==
+            cfg.train_micro_batch_size_per_gpu *
+            cfg.gradient_accumulation_steps * 8)
+    # conflicting explicit batch info is rejected unless explicitly ignored
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"train_batch_size": 64,
+             "elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                            "micro_batch_sizes": [2, 4, 6]}},
+            dp_world_size=8)
+    cfg2 = DeepSpeedConfig(
+        {"train_batch_size": 64,
+         "elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                        "micro_batch_sizes": [2, 4, 6],
+                        "ignore_non_elastic_batch_info": True}},
+        dp_world_size=8)
+    assert cfg2.train_batch_size == 1680
