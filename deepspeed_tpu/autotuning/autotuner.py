@@ -41,11 +41,12 @@ METRIC_LATENCY = "latency"           # sec/step (lower is better)
 class Experiment:
     name: str
     config: Dict[str, Any]
+    group: str = ""          # (stage, mesh) family — plateau stops per group
     metric_val: Optional[float] = None
     error: Optional[str] = None
 
     def as_record(self):
-        return {"name": self.name, "config": self.config,
+        return {"name": self.name, "config": self.config, "group": self.group,
                 "metric_val": self.metric_val, "error": self.error}
 
 
@@ -146,12 +147,14 @@ class Autotuner:
                         cfg.setdefault("mesh", {}).update(mesh)
                     for k, v in zip(extra_axes, extras):
                         _set_path(cfg, k, v)
-                    name = f"z{stage}_mbs{micro}" + \
+                    group = f"z{stage}" + \
                         ("_" + "_".join(f"{a}{b}" for a, b in mesh.items())
-                         if mesh else "") + \
+                         if mesh else "")
+                    name = f"{group}_mbs{micro}" + \
                         "".join(f"_{k.split('.')[-1]}{v}"
                                 for k, v in zip(extra_axes, extras))
-                    exps.append(Experiment(name=name, config=cfg))
+                    exps.append(Experiment(name=name, config=cfg,
+                                           group=group))
         if self.tuner_type == "random":
             order = self.rng.permutation(len(exps))
             exps = [exps[i] for i in order]
@@ -166,9 +169,11 @@ class Autotuner:
             micro = exp.config["train_micro_batch_size_per_gpu"]
             gas = exp.config.get("gradient_accumulation_steps", 1)
             make_iter = self.data_factory(micro)
+            loss = None
             for _ in range(self.warmup_steps):
                 loss = engine.train_batch(make_iter())
-            float(jax.device_get(loss))        # sync before timing
+            if loss is not None:
+                float(jax.device_get(loss))    # sync before timing
             t0 = time.perf_counter()
             for _ in range(self.measure_steps):
                 loss = engine.train_batch(make_iter())
@@ -190,8 +195,18 @@ class Autotuner:
         exps = self._experiments(space)
         log_dist(f"autotuner: {len(exps)} experiments", ranks=[0])
         os.makedirs(self.results_dir, exist_ok=True)
-        plateau = 0
+        plateau: Dict[str, int] = {}
+        stopped: set = set()
         for exp in exps:
+            if exp.group in stopped:
+                # micro-batch sweeps are monotone until the knee; after N
+                # consecutive regressions the rest of this (stage, mesh)
+                # family is skipped (reference get_plauteu_mbs,
+                # autotuner.py:638)
+                exp.error = "skipped: plateau early-stop"
+                self.records.append(exp)
+                self._write_record(exp)
+                continue
             try:
                 exp.metric_val = self._run_experiment(exp)
             except Exception as e:  # OOM / compile failure = infeasible point
@@ -203,18 +218,15 @@ class Autotuner:
                 if self.best is None or self._better(exp.metric_val,
                                                      self.best.metric_val):
                     self.best = exp
-                    plateau = 0
+                    plateau[exp.group] = 0
                 else:
-                    plateau += 1
+                    plateau[exp.group] = plateau.get(exp.group, 0) + 1
                 log_dist(f"autotuner: {exp.name} {self.metric}="
                          f"{exp.metric_val:.2f} (best {self.best.name})",
                          ranks=[0])
-                if plateau >= self.early_stop_plateau and \
-                        self.tuner_type == "gridsearch":
-                    # micro-batch sweeps are monotone until the knee; stop
-                    # this direction after N consecutive regressions
-                    # (reference get_plauteu_mbs, autotuner.py:638)
-                    plateau = 0
+                if self.tuner_type == "gridsearch" and \
+                        plateau[exp.group] >= self.early_stop_plateau:
+                    stopped.add(exp.group)
         self._write_summary()
         return self.best.config if self.best else None
 

@@ -73,7 +73,11 @@ class ElasticityConfig:
         self.chip_multiple = param_dict.get("chip_multiple", 1)
         self.min_time = param_dict.get("min_time", 0)
         self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
-        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        # the reference schema spells this "prefer_larger_batch_size"
+        # (elasticity/constants.py); accept the short form too
+        self.prefer_larger_batch_size = param_dict.get(
+            "prefer_larger_batch_size",
+            param_dict.get("prefer_larger_batch", True))
         self.ignore_non_elastic_batch_info = param_dict.get(
             "ignore_non_elastic_batch_info", False)
 

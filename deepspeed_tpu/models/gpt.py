@@ -270,7 +270,8 @@ class Block(nn.Module):
         return MLP(cfg, name="mlp")(h, deterministic), jnp.zeros((), jnp.float32)
 
     @nn.compact
-    def __call__(self, x, positions, deterministic=True):
+    def __call__(self, x, positions, deterministic=True, layer_frac=None,
+                 pld_theta=None):
         cfg = self.cfg
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_1")
@@ -288,6 +289,18 @@ class Block(nn.Module):
             h = x + attn(ln1(x), positions, deterministic)
             ffn_out, l_aux = self._ffn(cfg, ln2(h), deterministic)
             out = h + ffn_out
+        if pld_theta is not None:
+            # progressive layer drop (runtime/progressive_layer_drop.py):
+            # deeper layers drop more; theta is traced so its decay reuses
+            # the compiled program. A dropped block is the identity and
+            # contributes no MoE aux loss. `deterministic` may itself be
+            # traced (under remat), so eval-mode keep is fused as logical_or
+            # rather than a Python branch.
+            keep_p = 1.0 - layer_frac * (1.0 - pld_theta)
+            keep = jax.random.bernoulli(self.make_rng("pld"), keep_p)
+            keep = jnp.logical_or(keep, deterministic)
+            out = jnp.where(keep, out, x)
+            l_aux = jnp.where(keep, l_aux, 0.0)
         return out, l_aux
 
 
@@ -296,7 +309,8 @@ class GPT(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=True, positions=None):
+    def __call__(self, input_ids, deterministic=True, positions=None,
+                 pld_theta=None):
         cfg = self.cfg
         b, s = input_ids.shape
         if positions is None:
@@ -325,21 +339,34 @@ class GPT(nn.Module):
             raise ValueError("attn_windows (heterogeneous layers) requires "
                              "scan_layers=False")
         if cfg.scan_layers:
+            # pld_theta (when given) rides as a broadcast arg with a scanned
+            # per-layer depth fraction, so the SAME "blocks" params serve
+            # both plain and layer-drop training
+            extra_in = () if pld_theta is None else (
+                (jnp.arange(1, cfg.num_layers + 1, dtype=jnp.float32)
+                 / cfg.num_layers), pld_theta)
+            extra_axes = () if pld_theta is None else (0, nn.broadcast)
             ScannedBlock = nn.scan(
                 block,
                 variable_axes={"params": 0, "cache": 0},
-                split_rngs={"params": True, "dropout": True, "gating": True},
-                in_axes=(nn.broadcast, nn.broadcast),
+                split_rngs={"params": True, "dropout": True, "gating": True,
+                            "pld": True},
+                in_axes=(nn.broadcast, nn.broadcast) + extra_axes,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, aux = ScannedBlock(cfg, name="blocks")(x, positions, deterministic)
+            x, aux = ScannedBlock(cfg, name="blocks")(
+                x, positions, deterministic, *extra_in)
             moe_aux = jnp.sum(aux) if cfg.moe else jnp.zeros((), jnp.float32)
         else:
             moe_aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.num_layers):
+                extra = {} if pld_theta is None else {
+                    "layer_frac": (i + 1) / cfg.num_layers,
+                    "pld_theta": pld_theta}
                 x, aux = block(cfg, layer_idx=i,
-                               name=f"block_{i}")(x, positions, deterministic)
+                               name=f"block_{i}")(x, positions, deterministic,
+                                                  **extra)
                 moe_aux = moe_aux + aux
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,

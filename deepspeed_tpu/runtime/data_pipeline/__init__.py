@@ -1,0 +1,6 @@
+"""Data-pipeline efficiency features (reference:
+deepspeed/runtime/data_pipeline/): curriculum learning."""
+
+from .curriculum_scheduler import CurriculumScheduler
+
+__all__ = ["CurriculumScheduler"]

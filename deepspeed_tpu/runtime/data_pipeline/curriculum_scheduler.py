@@ -1,0 +1,95 @@
+"""Curriculum learning difficulty scheduler.
+
+Reference: ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py:8-134``
+— three schedule families mapping global step -> difficulty (for seqlen
+curricula, the sequence length to train on this step). Host-side control
+flow, so the logic carries over; the schedule config schema is kept
+verbatim.
+
+TPU note: every distinct difficulty is a distinct input shape, hence one
+XLA compilation. ``difficulty_step`` (multiple of 8 in the reference for
+tensor cores; multiples of 128 suit the TPU lane dimension better) bounds
+the number of distinct shapes, and compilations are cached — after the
+ramp, steady state reuses the final program.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+from ...utils.logging import logger
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires '{key}'")
+        self.curriculum_type = config["curriculum_type"]
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.current_difficulty = self.min_difficulty
+        sc = dict(config.get("schedule_config", {}))
+        self.schedule = sc
+        if self.schedule_type == FIXED_DISCRETE:
+            if "difficulty" not in sc or "max_step" not in sc:
+                raise ValueError("fixed_discrete needs schedule_config "
+                                 "{difficulty: [...], max_step: [...]}")
+            if len(sc["difficulty"]) != len(sc["max_step"]) + 1:
+                raise ValueError("difficulty must have one more entry than "
+                                 "max_step (last difficulty is terminal)")
+        elif self.schedule_type in (FIXED_ROOT, FIXED_LINEAR):
+            need = {"total_curriculum_step", "difficulty_step"}
+            if self.schedule_type == FIXED_ROOT:
+                need.add("root_degree")
+            missing = need - set(sc)
+            if missing:
+                raise ValueError(f"{self.schedule_type} needs schedule_config "
+                                 f"keys {sorted(missing)}")
+            if sc["difficulty_step"] % 8:
+                logger.warning(
+                    "difficulty_step not a multiple of 8; TPU-efficient "
+                    "seqlen curricula should step in multiples of the lane "
+                    "tile (128) to keep shapes MXU-friendly")
+        else:
+            raise ValueError(f"unsupported schedule_type {self.schedule_type!r}")
+
+    # -- schedule families (reference :100-134, re-derived) -----------------
+    def _difficulty_at(self, step: int) -> int:
+        sc = self.schedule
+        if self.schedule_type == FIXED_DISCRETE:
+            for limit, diff in zip(sc["max_step"], sc["difficulty"]):
+                if step <= limit:
+                    return diff
+            return sc["difficulty"][-1]
+        degree = sc["root_degree"] if self.schedule_type == FIXED_ROOT else 1
+        frac = (float(step) / sc["total_curriculum_step"]) ** (1.0 / degree)
+        diff = math.floor(
+            frac * (self.max_difficulty - self.min_difficulty)
+            + self.min_difficulty)
+        diff -= diff % sc["difficulty_step"]
+        return max(self.min_difficulty, min(diff, self.max_difficulty))
+
+    def update_difficulty(self, step: int) -> int:
+        self.current_difficulty = self._difficulty_at(step)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.current_difficulty = difficulty
+
+    # checkpointable state (reference get_state/set_state)
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = state["current_difficulty"]

@@ -111,6 +111,59 @@ class DeepSpeedEngine:
         from ..profiling.flops_profiler import FlopsProfiler
         self.flops_profiler = FlopsProfiler(self) if self.config.flops_profiler.enabled else None
 
+        # ---- training-efficiency features ----------------------------------
+        # curriculum learning (reference engine.py:1577-1583 kwargs injection)
+        cc = self.config.curriculum_learning
+        self.curriculum_scheduler = None
+        if cc.enabled:
+            from .data_pipeline import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler({
+                "curriculum_type": cc.curriculum_type,
+                "min_difficulty": cc.min_difficulty,
+                "max_difficulty": cc.max_difficulty,
+                "schedule_type": cc.schedule_type,
+                "schedule_config": cc.schedule_config,
+            })
+        # progressive layer drop (reference engine.py:1571-1572)
+        pld = self.config.progressive_layer_drop
+        self.progressive_layer_drop = None
+        if pld.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld.theta, gamma=pld.gamma)
+        # eigenvalue + MoQ quantization (reference engine.py:1892-1907)
+        ev = self.config.eigenvalue
+        self.eigenvalue = None
+        self.block_eigenvalue = None
+        if ev.enabled:
+            from .eigenvalue import Eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
+                stability=ev.stability,
+                gas_boundary_resolution=ev.gas_boundary_resolution,
+                layer_name=ev.layer_name, layer_num=ev.layer_num)
+        qt = self.config.quantize_training
+        self.quantizer = None
+        if qt.enabled:
+            from .quantize import MoQQuantizer
+            bits = qt.quantize_bits or {}
+            sched = qt.quantize_schedule or {}
+            mixed = qt.fp16_mixed_quantize or {}
+            self.quantizer = MoQQuantizer(
+                q_target_bits=bits.get("target_bits", 8),
+                q_start_bits=bits.get("start_bits", 16),
+                q_period=sched.get("quantize_period", 100),
+                q_offset=sched.get("schedule_offset", 100),
+                q_groups=qt.quantize_groups,
+                q_mixed_fp16=mixed.get("enabled", False),
+                q_change_ratio=mixed.get("quantize_change_ratio", 0.01),
+                q_type=qt.quantize_type,
+                q_rounding=qt.quantize_schedule.get("rounding", "nearest")
+                if qt.quantize_schedule else "nearest",
+                q_verbose=qt.quantize_verbose,
+                q_eigenvalue=bool(qt.eigenvalue.get("enabled", False))
+                if qt.eigenvalue else False)
+
         # ---- precision -----------------------------------------------------
         self.compute_dtype = self.config.compute_dtype
         self.fp16_enabled = self.config.fp16.enabled
@@ -126,6 +179,13 @@ class DeepSpeedEngine:
         self.offload_device = zc.offload_optimizer.device
         self.offload_enabled = self.offload_device in ("cpu", "nvme")
         self._offload_nvme_path = zc.offload_optimizer.nvme_path
+        if self.offload_enabled and (self.progressive_layer_drop is not None
+                                     or self.quantizer is not None):
+            raise ValueError(
+                "progressive_layer_drop / quantize_training are not wired "
+                "into the offload train path; disable offload_optimizer or "
+                "these features (silently ignoring them would train a "
+                "different model than configured)")
 
         # ---- parameters ----------------------------------------------------
         if model_parameters is None:
@@ -153,6 +213,7 @@ class DeepSpeedEngine:
         self._jit_micro = None
         self._jit_apply = None
         self._pending_loss = None
+        self._last_micro = None
 
         log_dist(
             f"engine ready: mesh={shape.as_dict()} zero_stage={self.zero_stage} "
@@ -226,6 +287,12 @@ class DeepSpeedEngine:
             if self.offload_enabled:
                 raise ValueError(f"{oc.type} is incompatible with "
                                  "offload_optimizer (reference parity)")
+            if self.progressive_layer_drop is not None or \
+                    self.quantizer is not None:
+                raise ValueError(
+                    "progressive_layer_drop / quantize_training are not "
+                    "wired into the 1-bit train path; disable them or use a "
+                    "dense optimizer")
             from .fp16.onebit.integration import OnebitRunner
             self._onebit = OnebitRunner(self, otype, dict(oc.params),
                                         model_parameters, rng)
@@ -331,32 +398,60 @@ class DeepSpeedEngine:
         return float(jax.device_get(self.state["scale"].cur_scale))
 
     # ------------------------------------------------------------- model fns
-    def _apply_model(self, params, batch, rng, train=True):
+    @property
+    def _module_params(self):
+        """Parameter names the flax module's __call__ accepts, resolved ONCE
+        by signature inspection (not try/except around the traced apply,
+        which would mask unrelated TypeErrors and silently drop kwargs for
+        **kwargs models)."""
+        cached = getattr(self, "_module_params_cache", None)
+        if cached is None:
+            import inspect
+            names, var_kw = set(), False
+            if hasattr(self.module, "apply"):
+                try:
+                    sig = inspect.signature(type(self.module).__call__)
+                    for p in sig.parameters.values():
+                        if p.kind is inspect.Parameter.VAR_KEYWORD:
+                            var_kw = True
+                        names.add(p.name)
+                except (TypeError, ValueError):
+                    var_kw = True
+            cached = self._module_params_cache = (names, var_kw)
+        return cached
+
+    def _apply_model(self, params, batch, rng, train=True, model_kwargs=None):
         if hasattr(self.module, "apply"):  # flax module
-            rngs = {"dropout": rng, "gating": jax.random.fold_in(rng, 1)}
+            rngs = {"dropout": rng, "gating": jax.random.fold_in(rng, 1),
+                    "pld": jax.random.fold_in(rng, 2)}
             if isinstance(batch, dict):
                 inputs = batch.get("input_ids", batch.get("inputs"))
                 if inputs is None:
                     raise ValueError("flax-module path expects batch['input_ids']")
             else:
                 inputs = batch
-            try:
-                return self.module.apply({"params": params}, inputs,
-                                         deterministic=not train, rngs=rngs)
-            except TypeError:
-                # model without a `deterministic` kwarg
-                return self.module.apply({"params": params}, inputs, rngs=rngs)
+            names, var_kw = self._module_params
+            kwargs = {}
+            if var_kw or "deterministic" in names:
+                kwargs["deterministic"] = not train
+            for k, v in (model_kwargs or {}).items():
+                if var_kw or k in names:
+                    kwargs[k] = v
+            return self.module.apply({"params": params}, inputs, rngs=rngs,
+                                     **kwargs)
         return self.module(params, batch, rng)
 
-    def _loss_of(self, params, batch, rng, train=True):
-        out = self._apply_model(params, batch, rng, train=train)
+    def _loss_of(self, params, batch, rng, train=True, model_kwargs=None):
+        out = self._apply_model(params, batch, rng, train=train,
+                                model_kwargs=model_kwargs)
         if self.loss_fn is not None:
             return self.loss_fn(out, batch)
         if isinstance(out, jnp.ndarray) and out.ndim == 0:
             return out
         raise ValueError("model output is not a scalar loss; pass loss_fn")
 
-    def _micro_grads(self, master, scale, batch, rng, params=None):
+    def _micro_grads(self, master, scale, batch, rng, params=None,
+                     model_kwargs=None):
         if params is None:
             # compute-dtype copy of the master weights; callers that loop over
             # microbatches pass a pre-cast tree so the cast runs once per
@@ -365,7 +460,7 @@ class DeepSpeedEngine:
             params = jax.lax.with_sharding_constraint(params, self.param_shardings)
 
         def scaled_loss(p):
-            loss = self._loss_of(p, batch, rng)
+            loss = self._loss_of(p, batch, rng, model_kwargs=model_kwargs)
             return (loss.astype(jnp.float32) * scale), loss
 
         (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
@@ -418,7 +513,7 @@ class DeepSpeedEngine:
     def _build_train_jit(self):
         gas = self.gradient_accumulation_steps()
 
-        def train_step(state, batches):
+        def train_step(state, batches, extras):
             # fp32->compute cast hoisted out of the micro loop (the scan body
             # would otherwise re-cast the full master tree every micro step)
             params = _cast_tree(state["master"], self.compute_dtype)
@@ -429,7 +524,7 @@ class DeepSpeedEngine:
                 rng, sub = jax.random.split(rng)
                 loss, grads = self._micro_grads(
                     state["master"], state["scale"].cur_scale, batch, sub,
-                    params=params)
+                    params=params, model_kwargs=extras)
                 acc = jax.tree.map(jnp.add, acc, grads)
                 acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
                 return (acc, loss_sum + loss, rng), None
@@ -444,6 +539,50 @@ class DeepSpeedEngine:
 
         return jax.jit(train_step, donate_argnums=(0,),
                        out_shardings=(self._state_shardings, None))
+
+    def _forward_extras(self):
+        """Traced per-step model kwargs (PLD theta etc.) — passed as jit
+        arguments so host-side schedules never trigger recompiles."""
+        extras = {}
+        if self.progressive_layer_drop is not None:
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            extras["pld_theta"] = jnp.asarray(theta, jnp.float32)
+        return extras
+
+    def _apply_curriculum(self, batches, stacked=True):
+        """Truncate the sequence axis to the scheduled difficulty (seqlen
+        curricula; reference injects curriculum_seqlen kwargs, engine.py:1577
+        — here the batch itself is cut so attention/loss shapes shrink with
+        difficulty, which is where the TPU speedup comes from)."""
+        diff = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+        if self.curriculum_scheduler.curriculum_type != "seqlen":
+            return batches
+        axis = 2 if stacked else 1
+
+        def cut(x):
+            if x.ndim > axis and x.shape[axis] > diff:
+                return jax.lax.slice_in_dim(x, 0, diff, axis=axis)
+            return x
+        return jax.tree.map(cut, batches)
+
+    def _apply_moq(self, metrics):
+        """MoQ boundary hook (reference engine.py:1892-1907): optionally
+        refresh block eigenvalues, then quantize-dequantize the master."""
+        overflow = False
+        if self.fp16_enabled:
+            overflow = not bool(jax.device_get(metrics["finite"]))
+        eig_on = (self.eigenvalue is not None and self.quantizer.q_eigenvalue)
+        if eig_on and self.global_steps % \
+                self.eigenvalue.gas_boundary_resolution == 0 and \
+                self._last_micro is not None:
+            loss_fn = lambda p, b, r: self._loss_of(
+                _cast_tree(p, self.compute_dtype), b, r)
+            self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
+                loss_fn, self.state["master"], self._last_micro)
+        self.state["master"] = self.quantizer.quantize(
+            self.state["master"], overflow=overflow,
+            eigenvalue_enabled=eig_on,
+            block_eigenvalue=self.block_eigenvalue)
 
     def _shard_batch(self, batch, stacked: bool = False):
         axes = ("dp",)
@@ -470,7 +609,14 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         micros = [next(data_iter) for _ in range(gas)]
         batches = jax.tree.map(lambda *xs: np.stack(xs), *micros)
+        if self.curriculum_scheduler is not None:
+            batches = self._apply_curriculum(batches, stacked=True)
         batches = self._shard_batch(batches, stacked=True)
+        # only the eigenvalue refresh consumes a sample batch — don't pin one
+        # in HBM for plain MoQ
+        self._last_micro = jax.tree.map(lambda x: x[0], batches) \
+            if (self.quantizer is not None and self.quantizer.q_eigenvalue
+                and self.eigenvalue is not None) else None
 
         if getattr(self, "_onebit", None) is not None:
             self.tput_timer.start()
@@ -499,7 +645,8 @@ class DeepSpeedEngine:
             self._jit_train = self._build_train_jit()
 
         self.tput_timer.start()
-        self.state, metrics = self._jit_train(self.state, batches)
+        self.state, metrics = self._jit_train(self.state, batches,
+                                              self._forward_extras())
         # sync only on report steps: a per-step block_until_ready would
         # serialize dispatch against the device and stall the pipeline
         will_report = (self.global_steps + 1) % self.steps_per_print() == 0
@@ -508,6 +655,8 @@ class DeepSpeedEngine:
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
         self._last_grad_norm = metrics["grad_norm"]
+        if self.quantizer is not None:
+            self._apply_moq(metrics)
         self._after_step(metrics)
         return metrics["loss"]
 
@@ -629,6 +778,10 @@ class DeepSpeedEngine:
             "zero_stage": self.zero_stage,
             "dp_world_size": self.dp_world_size,
             "client_state": client_state or {},
+            "curriculum": (self.curriculum_scheduler.get_state()
+                           if self.curriculum_scheduler else None),
+            "quantizer": (self.quantizer.get_state()
+                          if self.quantizer else None),
         }
         if self.offload_enabled:
             return ckpt_saving.save_checkpoint_dir(
@@ -675,6 +828,10 @@ class DeepSpeedEngine:
                 cur_scale=jnp.asarray(meta["loss_scale"], jnp.float32))
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        if self.curriculum_scheduler is not None and meta.get("curriculum"):
+            self.curriculum_scheduler.set_state(meta["curriculum"])
+        if self.quantizer is not None and meta.get("quantizer"):
+            self.quantizer.set_state(meta["quantizer"])
         if getattr(self, "_onebit", None) is not None:
             # phase selection (warmup vs compressed, 0/1 Adam intervals) is
             # keyed on the device step counter — realign it and the host-side

@@ -1,0 +1,37 @@
+"""Progressive Layer Dropping (reference:
+deepspeed/runtime/progressive_layer_drop.py:5; paper arxiv 2010.13369).
+
+theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar: the global keep
+temperature decays from 1 toward theta_bar. Models consume it as a
+``pld_theta`` forward argument; depth scaling (earlier layers kept more)
+happens inside the model — see models/gpt.py, where the per-layer keep
+probability 1 - l/L * (1 - theta) gates each scanned block with a Bernoulli
+draw, traced so the decaying theta never triggers a recompile.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {theta})",
+                 ranks=[0])
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
