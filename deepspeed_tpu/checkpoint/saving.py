@@ -2,17 +2,24 @@
 load_checkpoint:2438, tag file `latest` :2948, fp32 consolidation
 deepspeed/utils/zero_to_fp32.py).
 
-Format: one directory per tag containing
-  - ``meta.json``         : step counters, tree paths, dtypes, client state
-  - ``model_states.npz``  : master (fp32) params, path-keyed
-  - ``optim_states.npz``  : optimizer state leaves, path-keyed
-plus a top-level ``latest`` file naming the newest tag.
+Two formats, one directory per tag, selected by size/world (or forced via
+the ``sharded_checkpoint`` config key):
 
-Arrays are fully gathered on save and re-sharded on load with the *current*
-mesh's shardings — so checkpoints are elastic across dp/tp/pp resizes by
-construction (the reference needs bespoke elastic-checkpoint merge logic,
-stage_1_and_2 elastic checkpoint + state_dict_factory resharding; here
-``jax.device_put`` with a new NamedSharding is the reshard).
+  * small ("npz"): full-gather on rank 0 —
+      - ``meta.json``         : step counters, client state
+      - ``model_states.npz``  : master (fp32) params, path-keyed
+      - ``optim_states.npz``  : optimizer state leaves, path-keyed
+  * sharded: the reference's per-dp-rank shard files (``zero_pp_rank_*``,
+    engine.py:3076) re-designed as orbax OCDBT directories
+    (``model_states/``, ``optim_states/``): every process writes ONLY its
+    addressable shards in parallel (``ocdbt.process_N`` files), no host
+    ever materializes the full tree. Restore takes the CURRENT shardings
+    and orbax reshards, so checkpoints stay elastic across dp/tp/pp
+    resizes without the reference's bespoke elastic-merge logic.
+
+plus a top-level ``latest`` file naming the newest tag. The npz path keeps
+the same elasticity by construction (full arrays re-device_put with the new
+mesh's shardings on load).
 """
 
 from __future__ import annotations
@@ -79,18 +86,56 @@ def unflatten_tree(arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return root
 
 
+def _abstract_like(template, shardings=None):
+    sh_leaves = (jax.tree.leaves(shardings) if shardings is not None
+                 else [getattr(l, "sharding", None)
+                       for l in jax.tree.leaves(template)])
+    leaves, treedef = jax.tree.flatten(template)
+    out = [jax.ShapeDtypeStruct(np.shape(l), np.asarray(l).dtype
+                                if not hasattr(l, "dtype") else l.dtype,
+                                sharding=s)
+           for l, s in zip(leaves, sh_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def save_sharded_tree(path: str, tree) -> None:
+    """Parallel per-process shard write (orbax OCDBT) — the reference's
+    per-dp-rank shard files (engine.py:3076) without a full gather."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), tree)
+    ckptr.wait_until_finished()
+
+
+def load_sharded_tree(path: str, template, shardings=None):
+    """Restore with the CURRENT shardings (elastic across mesh resizes)."""
+    import orbax.checkpoint as ocp
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path),
+                         _abstract_like(template, shardings))
+
+
 def save_checkpoint_dir(save_dir: str, tag: str, *, master_params, opt_state,
-                        meta: Dict[str, Any]) -> str:
+                        meta: Dict[str, Any], sharded: bool = False) -> str:
     ckpt_dir = os.path.join(save_dir, tag)
     os.makedirs(ckpt_dir, exist_ok=True)
-    if jax.process_index() == 0:
+    if sharded:
+        meta = dict(meta, format="sharded")
+        save_sharded_tree(os.path.join(ckpt_dir, "model_states"),
+                          master_params)
+        if opt_state is not None:
+            save_sharded_tree(os.path.join(ckpt_dir, "optim_states"),
+                              opt_state)
+    elif jax.process_index() == 0:
         save_tree(os.path.join(ckpt_dir, "model_states.npz"), master_params)
         save_tree(os.path.join(ckpt_dir, "optim_states.npz"), opt_state)
+    if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "meta.json"), "w") as fh:
             json.dump(meta, fh, indent=2)
         with open(os.path.join(save_dir, "latest"), "w") as fh:
             fh.write(tag)
-    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+    log_dist(f"saved checkpoint {ckpt_dir}"
+             f"{' (sharded)' if sharded else ''}", ranks=[0])
     return ckpt_dir
 
 
@@ -110,6 +155,15 @@ def load_checkpoint_dir(load_dir: str, tag: Optional[str], *, master_template,
     ckpt_dir = os.path.join(load_dir, tag)
     with open(os.path.join(ckpt_dir, "meta.json")) as fh:
         meta = json.load(fh)
+    if os.path.isdir(os.path.join(ckpt_dir, "model_states")):
+        master = load_sharded_tree(os.path.join(ckpt_dir, "model_states"),
+                                   master_template, master_shardings)
+        opt = opt_template
+        if os.path.isdir(os.path.join(ckpt_dir, "optim_states")):
+            opt = load_sharded_tree(os.path.join(ckpt_dir, "optim_states"),
+                                    opt_template, opt_shardings)
+        return {"tag": tag, "meta": meta, "master_params": master,
+                "opt_state": opt}
     master = _restore_like(master_template,
                            load_tree_arrays(os.path.join(ckpt_dir, "model_states.npz")),
                            master_shardings)

@@ -341,7 +341,7 @@ _SCALAR_KEYS = {k: k for k in (
     "memory_breakdown", "dump_state", "disable_allgather",
     "communication_data_type", "sparse_gradients",
     "zero_allow_untested_optimizer", "checkpoint_tag_validation",
-    "dataloader_drop_last", "amp", "seed",
+    "dataloader_drop_last", "amp", "seed", "sharded_checkpoint",
 )}
 
 
@@ -366,6 +366,10 @@ class DeepSpeedConfig:
     sparse_gradients: bool = False
     zero_allow_untested_optimizer: bool = False
     checkpoint_tag_validation: str = "warn"
+    # "auto": per-rank parallel shard files when the state is big or the job
+    # is multi-host; True/False force. Reference always shards
+    # (zero_pp_rank_* files); npz full-gather is kept as the small-model path
+    sharded_checkpoint: "str | bool" = "auto"
     dataloader_drop_last: bool = False
     amp: Optional[dict] = None
     seed: int = 42

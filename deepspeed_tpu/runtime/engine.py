@@ -784,19 +784,60 @@ class DeepSpeedEngine:
                           if self.quantizer else None),
         }
         if self.offload_enabled:
+            if self._use_sharded_checkpoint(host=True):
+                return self._save_offload_sharded(save_dir, tag, meta)
             return ckpt_saving.save_checkpoint_dir(
                 save_dir, tag,
                 master_params=self.host_optimizer.master_tree(),
                 opt_state=self.host_optimizer.opt_state_tree(), meta=meta)
         return ckpt_saving.save_checkpoint_dir(
             save_dir, tag, master_params=self.state["master"],
-            opt_state=self.state["opt"], meta=meta)
+            opt_state=self.state["opt"], meta=meta,
+            sharded=self._use_sharded_checkpoint())
+
+    # Above this size the npz full-gather (O(model) host DRAM on rank 0)
+    # stops being acceptable and the per-rank parallel shard path kicks in
+    SHARDED_CKPT_AUTO_BYTES = 2_000_000_000
+
+    def _use_sharded_checkpoint(self, host: bool = False) -> bool:
+        mode = self.config.sharded_checkpoint
+        if mode != "auto":
+            return bool(mode)
+        if jax.process_count() > 1:
+            return True
+        if host:
+            return not self.host_optimizer.owns_all()
+        total = sum(int(np.prod(l.shape)) * 4
+                    for l in jax.tree.leaves(self.state["master"]))
+        return total > self.SHARDED_CKPT_AUTO_BYTES
+
+    def _save_offload_sharded(self, save_dir, tag, meta):
+        """Per-host shard files for the host-DRAM/NVMe optimizer tier
+        (reference zero_pp_rank_* per-rank files, engine.py:3076)."""
+        ckpt_dir = os.path.join(save_dir, tag)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self.host_optimizer.save_shard(ckpt_dir)
+        comm.barrier()
+        if jax.process_index() == 0:
+            import json as _json
+            with open(os.path.join(ckpt_dir, "meta.json"), "w") as fh:
+                _json.dump(dict(meta, format="host_sharded"), fh, indent=2)
+            with open(os.path.join(save_dir, "latest"), "w") as fh:
+                fh.write(tag)
+        log_dist(f"saved host-sharded checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True,
                         load_module_only=False):
         if self.offload_enabled:
+            import glob as _glob
+            tag2 = tag or ckpt_saving.read_latest_tag(load_dir)
+            if tag2 and _glob.glob(os.path.join(
+                    load_dir, tag2, "zero_host_shard_p*.json")):
+                return self._load_offload_sharded(
+                    load_dir, tag2, load_optimizer_states, load_module_only)
             res = ckpt_saving.load_checkpoint_dir(
                 load_dir, tag,
                 master_template=self.host_optimizer.master_tree(),
@@ -846,6 +887,26 @@ class DeepSpeedEngine:
         log_dist(f"loaded checkpoint tag={res['tag']} step={self.global_steps}",
                  ranks=[0])
         return os.path.join(load_dir, res["tag"]), meta.get("client_state", {})
+
+    def _load_offload_sharded(self, load_dir, tag, load_optimizer_states,
+                              load_module_only):
+        import json as _json
+        ckpt_dir = os.path.join(load_dir, tag)
+        with open(os.path.join(ckpt_dir, "meta.json")) as fh:
+            meta = _json.load(fh)
+        self.host_optimizer.load_shards(
+            ckpt_dir,
+            load_optimizer_states=load_optimizer_states and not load_module_only)
+        self.state["params"] = self._offload_restore_params()
+        self._host_scale = float(meta["loss_scale"])
+        if self.lr_scheduler and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        self.global_steps = meta["global_steps"]
+        self.global_samples = meta["global_samples"]
+        self.micro_steps = meta["micro_steps"]
+        log_dist(f"loaded host-sharded checkpoint tag={tag} "
+                 f"step={self.global_steps}", ranks=[0])
+        return ckpt_dir, meta.get("client_state", {})
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
         os.makedirs(save_dir, exist_ok=True)

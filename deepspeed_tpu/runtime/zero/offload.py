@@ -295,6 +295,87 @@ class HostOffloadOptimizer:
                 "exp_avg_sq": self._gather("exp_avg_sq"),
                 "step": np.asarray(self.step_count, np.int64)}
 
+    # ------------------------------------------------- per-host shard files
+    def save_shard(self, ckpt_dir: str, shard_id: Optional[int] = None) -> str:
+        """Write THIS host's dp-shard of master+moments (reference
+        zero_pp_rank_X_mp_rank_XX_optim_states.pt, engine.py:3076): no host
+        gathers the full state; files are written in parallel across hosts."""
+        import json as _json
+        pid = jax.process_index() if shard_id is None else shard_id
+        arrays: Dict[str, np.ndarray] = {}
+        meta = {"dp_shard": list(self.dp_shard), "step": self.step_count,
+                "leaves": []}
+        for i, leaf in enumerate(self.leaves):
+            if self.swapper is not None:
+                master, m, v = self.swapper.read_sync(i, leaf.numel)
+            else:
+                master, m, v = leaf.master, leaf.exp_avg, leaf.exp_avg_sq
+            # copy: in swapper mode these are views into the shared staging
+            # slot that the next leaf's read_sync overwrites
+            arrays[f"{i}:master"] = np.array(master[:leaf.numel], copy=True)
+            arrays[f"{i}:exp_avg"] = np.array(m[:leaf.numel], copy=True)
+            arrays[f"{i}:exp_avg_sq"] = np.array(v[:leaf.numel], copy=True)
+            meta["leaves"].append({
+                "path": leaf.path, "offset": int(leaf.offset),
+                "numel": int(leaf.numel), "padded": int(leaf.padded),
+                "global_numel": int(leaf.global_numel)})
+        base = os.path.join(ckpt_dir, f"zero_host_shard_p{pid}")
+        np.savez(base + ".npz", **arrays)
+        with open(base + ".json", "w") as fh:
+            _json.dump(meta, fh)
+        return base + ".npz"
+
+    def load_shards(self, ckpt_dir: str, load_optimizer_states: bool = True):
+        """Fill this host's shard from whatever host-shard files overlap it.
+
+        Works across host-count resizes: offsets index the flat leaf whose
+        zero padding sits past ``global_numel``, so any index below
+        ``global_numel`` means the same element regardless of the padding
+        the writing world used — ranges are clamped there and intersected."""
+        import glob as _glob
+        import json as _json
+        metas = []
+        for jpath in sorted(_glob.glob(
+                os.path.join(ckpt_dir, "zero_host_shard_p*.json"))):
+            with open(jpath) as fh:
+                m = _json.load(fh)
+            m["_npz"] = jpath[:-5] + ".npz"
+            metas.append(m)
+        if not metas:
+            raise FileNotFoundError(
+                f"no zero_host_shard_p*.json files in {ckpt_dir}")
+        if len(metas[0]["leaves"]) != len(self.leaves):
+            raise ValueError(
+                f"checkpoint has {len(metas[0]['leaves'])} leaves, model has "
+                f"{len(self.leaves)}")
+        self.step_count = int(metas[0]["step"])
+        for i, leaf in enumerate(self.leaves):
+            if self.swapper is not None:
+                master, m, v = self.swapper.read_sync(i, leaf.numel)
+            else:
+                master, m, v = leaf.master, leaf.exp_avg, leaf.exp_avg_sq
+            targets = {"master": master}
+            if load_optimizer_states:
+                targets.update(exp_avg=m, exp_avg_sq=v)
+            my_lo = leaf.offset
+            my_hi = min(leaf.offset + leaf.numel, leaf.global_numel)
+            for src_meta in metas:
+                li = src_meta["leaves"][i]
+                src_lo = li["offset"]
+                src_hi = min(src_lo + li["numel"], li["global_numel"])
+                lo, hi = max(my_lo, src_lo), min(my_hi, src_hi)
+                if lo >= hi:
+                    continue
+                with np.load(src_meta["_npz"]) as z:
+                    for key, dst in targets.items():
+                        src = z[f"{i}:{key}"]
+                        dst[lo - my_lo:hi - my_lo] = src[lo - src_lo:hi - src_lo]
+            leaf.sync_mirror(master)
+            if self.swapper is not None:
+                self.swapper.write_sync(i, leaf.numel)
+        log_dist(f"loaded host shard: ranks {self.dp_shard} from "
+                 f"{len(metas)} shard file(s)", ranks=[0])
+
     def load_state(self, master_tree=None, opt_state=None):
         def local_slices(tree):
             """Full leaves -> this host's padded flat shards."""
