@@ -59,6 +59,21 @@ def _cast_tree(tree, dtype):
     return jax.tree.map(lambda x: x.astype(dtype), tree)
 
 
+class _LazyLocalShard:
+    """Defers a dp-sharded flat array's local-shard assembly (the blocking
+    D2H wait) until np.asarray() is called inside the host optimizer's
+    per-leaf step loop — the host hop's double-buffering."""
+
+    __slots__ = ("_f",)
+
+    def __init__(self, f):
+        self._f = f
+
+    def __array__(self, dtype=None, copy=None):
+        arr = DeepSpeedEngine._extract_local_shard(self._f)
+        return arr.astype(dtype) if dtype is not None else arr
+
+
 def _global_norm(tree):
     leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
@@ -1121,7 +1136,11 @@ class DeepSpeedEngine:
             for f in flats:
                 f.copy_to_host_async()
             if jax.process_count() > 1:
-                grads_local = [self._extract_local_shard(f) for f in flats]
+                # lazy: each leaf's shard assembly (the blocking host copy)
+                # happens inside the step loop when THAT leaf is stepped, so
+                # leaf i's CPU-Adam overlaps leaf i+1's D2H stream instead
+                # of waiting for the full gradient volume up front
+                grads_local = [_LazyLocalShard(f) for f in flats]
             else:
                 grads_local = flats  # np.asarray per leaf inside the step
             self.host_optimizer.step(grads_local, lr=lr,
@@ -1136,11 +1155,17 @@ class DeepSpeedEngine:
     @staticmethod
     def _extract_local_shard(f):
         """Assemble this process's contiguous slice of a dp-sharded flat
-        array from its addressable shards (no cross-host gather)."""
-        shards = sorted(f.addressable_shards,
-                        key=lambda s: s.index[0].start or 0)
-        return np.concatenate([np.asarray(s.data).reshape(-1)
-                               for s in shards])
+        array from its addressable shards (no cross-host gather). Shards are
+        deduplicated by global index: with tp/pp/ep axes > 1 the dp slice is
+        replicated across this process's other local devices and would
+        otherwise be concatenated k times."""
+        uniq = {}
+        for s in f.addressable_shards:
+            start = s.index[0].start or 0
+            if start not in uniq:
+                uniq[start] = s
+        return np.concatenate([np.asarray(uniq[k].data).reshape(-1)
+                               for k in sorted(uniq)])
 
     @property
     def _offload_loss_scale(self):

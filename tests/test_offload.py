@@ -219,3 +219,36 @@ def test_offload_grads_are_dp_sharded_on_device():
         assert f.sharding.spec == jax.sharding.PartitionSpec("dp"), f.sharding
         shard_sizes = {s.data.size for s in f.addressable_shards}
         assert max(shard_sizes) == f.size // dp
+
+
+def test_extract_local_shard_dedups_replicated_axes():
+    """With tp>1 the dp slice is replicated across local devices; extraction
+    must not concatenate the duplicates (multi-host offload grad path)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _LazyLocalShard
+
+    shape = mesh_lib.MeshShape.infer(8, tp=2)
+    mesh = mesh_lib.build_mesh(shape)
+    arr = jax.device_put(np.arange(16.0, dtype=np.float32),
+                         NamedSharding(mesh, P("dp")))
+    out = DeepSpeedEngine._extract_local_shard(arr)
+    assert out.shape == (16,)
+    np.testing.assert_array_equal(out, np.arange(16.0, dtype=np.float32))
+    lazy = np.asarray(_LazyLocalShard(arr))
+    np.testing.assert_array_equal(lazy, out)
+
+
+def test_host_work_scales_inverse_dp():
+    """Each host steps only total/dp of the model (reference: per-rank
+    offloaded partitions, stage_1_and_2.py:1014)."""
+    from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+    rng = np.random.default_rng(0)
+    params = {"a": rng.normal(size=(64, 16)).astype(np.float32),
+              "b": rng.normal(size=(128,)).astype(np.float32)}
+    full = HostOffloadOptimizer(params, lr=1e-2, dp_shard=(0, 8, 8))
+    eighth = HostOffloadOptimizer(params, lr=1e-2, dp_shard=(3, 1, 8))
+    assert eighth.numel() * 8 == full.numel()
+    padded_total = sum(l.padded for l in full.leaves)
+    assert eighth.numel() == padded_total // 8
