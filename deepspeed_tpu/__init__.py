@@ -13,6 +13,10 @@ from .version import __version__  # noqa: F401
 
 from . import comm  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401
+# zero.Init analogue: abstract/sharded/streamed large-model construction
+# (reference zero/partition_parameters.py:529) — see
+# runtime/zero/partition_params.py for the three materialization paths
+from .runtime.zero import partition_params as zero  # noqa: F401
 
 
 def initialize(args=None,

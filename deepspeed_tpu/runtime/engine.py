@@ -321,6 +321,15 @@ class DeepSpeedEngine:
         if self.offload_enabled:
             self._init_offload_state(model_parameters, optimizer, rng)
             return
+        from .zero.partition_params import is_abstract_tree
+        if is_abstract_tree(model_parameters):
+            raise ValueError(
+                "model_parameters is a ShapeDtypeStruct tree: for the "
+                "device path materialize it first with "
+                "deepspeed_tpu.zero.sharded_init(model, rng, sample, "
+                "shardings=...) — params then appear directly in their "
+                "ZeRO shards; the abstract tree is accepted as-is only "
+                "with offload_optimizer (host/NVMe streaming init)")
         self._build_base_optimizer(optimizer)
 
         # copy (not alias) the user's params: engine state buffers are donated
@@ -760,15 +769,26 @@ class DeepSpeedEngine:
                 return self._loss_of(params, batch, rng, train=False)
             self._jit_eval = jax.jit(ev)
         batch = self._shard_batch(batch)
-        src = self.state["params"] if self.offload_enabled else self.state["master"]
+        src = (self._offload_params_view() if self.offload_enabled
+               else self.state["master"])
         return self._jit_eval(src, batch, self.state["rng"])
 
+    def _offload_params_view(self):
+        """Device params for eval/export; with offload_param they are
+        rebuilt from the mirrors on demand (and consumed by the next step)."""
+        if self.state["params"] is None:
+            self.state["params"] = self._offload_restore_params()
+        return self.state["params"]
+
     def get_params(self, dtype=None):
-        """Current (compute-dtype) parameters as a pytree."""
-        if self.offload_enabled:
-            return _cast_tree(self.state["params"],
-                              dtype or self.compute_dtype)
-        return _cast_tree(self.state["master"], dtype or self.compute_dtype)
+        """Current (compute-dtype) parameters as a pytree. Always a COPY:
+        engine state buffers are donated into the next train step, and a
+        same-dtype astype would alias them (the caller's tree would read
+        'Array has been deleted' after one more step)."""
+        src = (self._offload_params_view() if self.offload_enabled
+               else self.state["master"])
+        dt = dtype or self.compute_dtype
+        return jax.tree.map(lambda x: jnp.array(x, dtype=dt, copy=True), src)
 
     # ------------------------------------------------------------ dataloader
     def deepspeed_io(self, dataset, batch_size=None, route="train",
@@ -956,6 +976,21 @@ class DeepSpeedEngine:
         nvme = self._offload_nvme_path if self.offload_device == "nvme" else None
         if self.offload_device == "nvme" and not nvme:
             raise ValueError("offload_optimizer.device=nvme requires nvme_path")
+        # ZeRO-Infinity PARAM tier (reference partitioned_param_swapper.py:37
+        # via offload_param config): params are not kept in HBM between
+        # steps — they are rebuilt from the host/NVMe mirrors at each step
+        # start and donated away with the grads program. During compute they
+        # are sharded over the whole mesh (param_shardings), so transient
+        # HBM is model_size/num_chips; between steps it is ~0.
+        op = self.config.zero_config.offload_param
+        self._params_resident = op.device not in ("cpu", "nvme")
+        mirror_nvme = None
+        if op.device == "nvme":
+            mirror_nvme = op.nvme_path or (
+                os.path.join(nvme, "params") if nvme else None)
+            if not mirror_nvme:
+                raise ValueError("offload_param.device=nvme requires "
+                                 "offload_param.nvme_path")
         self.host_optimizer = HostOffloadOptimizer(
             model_parameters,
             lr=self._base_lr,
@@ -966,7 +1001,9 @@ class DeepSpeedEngine:
             mirror_dtype=mirror,
             nvme_path=nvme,
             aio_cfg=getattr(self.config, "aio", None),
-            dp_shard=self._local_dp_shard())
+            dp_shard=self._local_dp_shard(),
+            init_seed=self.config.seed,
+            mirror_nvme_path=mirror_nvme)
         self.optimizer = None
         self._client_optimizer = None
 
@@ -994,9 +1031,10 @@ class DeepSpeedEngine:
             lambda t: jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), t),
             out_shardings=self.grad_shardings)(dev_params)
-        self.state = {"params": dev_params, "acc": zeros, "rng": rng}
+        self.state = {
+            "params": dev_params if self._params_resident else None,
+            "acc": zeros, "rng": rng}
         self._off_state_shardings = {
-            "params": self.param_shardings,
             "acc": self.grad_shardings,
             "rng": NamedSharding(self.mesh, P()),
         }
@@ -1036,9 +1074,12 @@ class DeepSpeedEngine:
         """Updated mirror shards -> device params: each host contributes its
         dp-shard of every flat leaf; the compiled unflatten re-gathers to the
         param sharding (the step-tail all-gather)."""
-        shards = self.host_optimizer.mirror_flat_shards()
+        # leaf-at-a-time: each mirror shard is shipped to device before the
+        # next is read, so with the NVMe param tier host DRAM holds one
+        # leaf's mirror at a time
         flats = [jax.make_array_from_process_local_data(self._flat_sh, s)
-                 for s in shards]
+                 for s in (l.mirror_flat()
+                           for l in self.host_optimizer.leaves)]
         if not hasattr(self, "_jit_unflatten_params"):
             meta, treedef = self._off_meta, self._params_treedef
             def unflat(flats):
@@ -1052,7 +1093,7 @@ class DeepSpeedEngine:
     def _build_offload_jit(self):
         gas = self.gradient_accumulation_steps()
 
-        def train_grads(state, batches, scale):
+        def train_grads(params, state, batches, scale):
             def body(carry, batch):
                 acc, loss_sum, rng = carry
                 rng, sub = jax.random.split(rng)
@@ -1062,7 +1103,7 @@ class DeepSpeedEngine:
                     return loss.astype(jnp.float32) * scale, loss
 
                 (_, loss), grads = jax.value_and_grad(
-                    scaled_loss, has_aux=True)(state["params"])
+                    scaled_loss, has_aux=True)(params)
                 grads = _cast_tree(grads, jnp.float32)
                 acc = jax.tree.map(jnp.add, acc, grads)
                 acc = jax.lax.with_sharding_constraint(acc, self.grad_shardings)
@@ -1084,13 +1125,18 @@ class DeepSpeedEngine:
                     jnp.pad(g.reshape(-1), (0, padded - n)), self._flat_sh)
                 for g, (padded, n, _shape) in zip(
                     jax.tree_util.tree_leaves(grads), self._off_meta)]
+            # params are donated AND returned: XLA aliases them through, so
+            # keeping them (resident mode, overflow-skip steps) costs no
+            # transfer, while dropping the returned tree (param tier) frees
+            # the HBM the moment the host releases the reference
             return new_state, flats, {"loss": loss_sum / gas,
-                                      "grad_norm": gnorm, "finite": finite}
+                                      "grad_norm": gnorm,
+                                      "finite": finite}, params
 
-        return jax.jit(train_grads, donate_argnums=(0,),
+        return jax.jit(train_grads, donate_argnums=(0, 1),
                        out_shardings=(self._off_state_shardings,
                                       [self._flat_sh] * len(self._off_meta),
-                                      None))
+                                      None, self.param_shardings))
 
     def _host_update_scale(self, finite: bool):
         """Host mirror of fp16/loss_scaler.update_scale dynamics — same
@@ -1118,8 +1164,14 @@ class DeepSpeedEngine:
         if self._jit_train is None:
             self._jit_train = self._build_offload_jit()
         scale = jnp.asarray(self._host_scale, jnp.float32)
-        self.state, flats, metrics = self._jit_train(self.state, batches,
-                                                     scale)
+        params = self.state["params"]
+        if params is None:   # offload_param tier: upload from mirrors
+            params = self._offload_restore_params()
+        self.state["params"] = None   # donated below either way
+        sub = {"acc": self.state["acc"], "rng": self.state["rng"]}
+        sub, flats, metrics, params_out = self._jit_train(
+            params, sub, batches, scale)
+        self.state.update(sub)
         finite = bool(jax.device_get(metrics["finite"]))
         gnorm = float(jax.device_get(metrics["grad_norm"]))
         if finite:
@@ -1145,9 +1197,14 @@ class DeepSpeedEngine:
                 grads_local = flats  # np.asarray per leaf inside the step
             self.host_optimizer.step(grads_local, lr=lr,
                                      combined_scale=combined)
-            self.state["params"] = self._offload_restore_params()
+            if self._params_resident:
+                self.state["params"] = self._offload_restore_params()
         else:
             self.skipped_steps += 1
+            if self._params_resident:
+                # mirrors unchanged; the donated params were aliased through
+                # the jit, so keeping them costs nothing
+                self.state["params"] = params_out
         self._host_update_scale(finite)
         self._last_grad_norm = gnorm
         return metrics

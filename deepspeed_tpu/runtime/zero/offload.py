@@ -57,24 +57,41 @@ class _Leaf:
     swap file, staged through shared buffers)."""
 
     def __init__(self, path: str, value, mirror_dtype: str, resident: bool,
-                 shard):
+                 shard, init_seed: Optional[int] = None, init_rules=None):
         self.path = path
-        arr = np.asarray(value)
-        self.shape = arr.shape
-        self.global_numel = int(arr.size)
+        abstract = isinstance(value, jax.ShapeDtypeStruct)
+        self.shape = tuple(value.shape) if abstract else np.asarray(value).shape
+        self.global_numel = int(np.prod(self.shape)) if self.shape else 1
         rank_start, rank_count, world = shard
         self.shard_len = -(-self.global_numel // world)  # ceil
         self.padded = self.shard_len * world
         self.offset = rank_start * self.shard_len
         self.numel = rank_count * self.shard_len          # local numel
         self.mirror_dtype = mirror_dtype
-        # ALWAYS copy: np.asarray on CPU-backend jax arrays can be
-        # zero-copy, and the native optimizer writes through raw pointers —
-        # aliasing the caller's (or another engine's) buffer would mutate it
-        flat = np.zeros(self.padded, np.float32)
-        flat[:self.global_numel] = np.asarray(arr, np.float32).reshape(-1)
-        master = np.ascontiguousarray(flat[self.offset:self.offset + self.numel])
-        del flat
+        if abstract:
+            # zero.Init path (partition_params.py): only THIS host's shard
+            # is ever allocated; values stream from the counter-based init
+            # at the shard's global offset. Peak DRAM = one shard.
+            from .partition_params import (DEFAULT_INIT_RULES,
+                                           fill_abstract_shard)
+            master = np.zeros(self.numel, np.float32)
+            hi = min(self.offset + self.numel, self.global_numel)
+            if hi > self.offset:
+                master[:hi - self.offset] = fill_abstract_shard(
+                    path, self.shape, self.offset, hi,
+                    seed=0 if init_seed is None else init_seed,
+                    rules=init_rules or DEFAULT_INIT_RULES)
+        else:
+            # ALWAYS copy: np.asarray on CPU-backend jax arrays can be
+            # zero-copy, and the native optimizer writes through raw
+            # pointers — aliasing the caller's (or another engine's) buffer
+            # would mutate it
+            flat = np.zeros(self.padded, np.float32)
+            flat[:self.global_numel] = np.asarray(
+                value, np.float32).reshape(-1)
+            master = np.ascontiguousarray(
+                flat[self.offset:self.offset + self.numel])
+            del flat
         if resident:
             self.master: Optional[np.ndarray] = master
             self.exp_avg: Optional[np.ndarray] = np.zeros_like(master)
@@ -88,8 +105,33 @@ class _Leaf:
         else:
             self.mirror_buf = master.copy() if not resident else None
         self._init_master = None if resident else master  # for swap init
+        self.store = None        # MirrorNVMeStore (param tier), see below
+        self.store_idx = None
+
+    @property
+    def _mirror_itemsize(self) -> int:
+        return 2 if self.mirror_dtype in ("bfloat16", "float16") else 4
+
+    def attach_store(self, store, idx: int) -> None:
+        """Move this leaf's mirror into the NVMe param tier: flush the DRAM
+        mirror to its file and free it."""
+        self.store = store
+        self.store_idx = idx
+        buf = self.mirror_buf if self.mirror_buf is not None else self.master
+        store.write(idx, np.ascontiguousarray(buf).view(np.uint8))
+        self.mirror_buf = None
 
     def sync_mirror(self, master: np.ndarray):
+        if self.store is not None:
+            stage = self.store.staging_view(self.numel * self._mirror_itemsize)
+            if self.mirror_dtype == "bfloat16":
+                f32_to_bf16_bits(master, out=stage.view(np.uint16))
+            elif self.mirror_dtype == "float16":
+                stage.view(np.float16)[:] = master.astype(np.float16)
+            else:
+                stage.view(np.float32)[:] = master
+            self.store.write(self.store_idx, stage)
+            return
         if self.mirror_dtype == "bfloat16":
             f32_to_bf16_bits(master, out=self.mirror_buf)
         elif self.mirror_dtype == "float16":
@@ -98,7 +140,18 @@ class _Leaf:
             self.mirror_buf[:] = master
 
     def mirror_flat(self) -> np.ndarray:
-        """This host's flat mirror shard (compute dtype, padded slice)."""
+        """This host's flat mirror shard (compute dtype, padded slice). In
+        the NVMe param tier this is a COPY read back from the leaf's file
+        (the staging buffer is reused by the next read)."""
+        if self.store is not None:
+            raw = self.store.read(self.store_idx,
+                                  self.numel * self._mirror_itemsize)
+            raw = np.array(raw, copy=True)
+            if self.mirror_dtype == "bfloat16":
+                return raw.view(_BF16)
+            if self.mirror_dtype == "float16":
+                return raw.view(np.float16)
+            return raw.view(np.float32)
         if self.mirror_dtype == "bfloat16":
             return self.mirror_buf.view(_BF16)
         if self.mirror_buf is not None:
@@ -113,6 +166,42 @@ class _Leaf:
                 f"leaf {self.path}: host owns {self.numel}/{self.padded} "
                 "elements; full mirror requires whole-leaf ownership")
         return self.mirror_flat()[:self.global_numel].reshape(self.shape)
+
+
+class MirrorNVMeStore:
+    """ZeRO-Infinity's PARAM tier (reference
+    swap_tensor/partitioned_param_swapper.py:37): the compute-dtype param
+    mirrors live in per-leaf NVMe files; DRAM holds ONE staging buffer sized
+    to the largest leaf shard. With offload_optimizer=nvme as well, host
+    DRAM is O(largest leaf), independent of model size."""
+
+    def __init__(self, path: str, leaves, aio_cfg=None):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.itemsize = leaves[0]._mirror_itemsize if leaves else 4
+        kw = {}
+        if aio_cfg is not None:
+            kw = dict(block_size=aio_cfg.block_size,
+                      queue_depth=aio_cfg.queue_depth,
+                      num_threads=aio_cfg.thread_count)
+        self.handle = AsyncIOHandle(**kw)
+        max_numel = max((l.numel for l in leaves), default=1)
+        self._staging = np.zeros(max_numel * self.itemsize, np.uint8)
+
+    def _file(self, idx: int) -> str:
+        return os.path.join(self.path, f"mirror_{idx}.bin")
+
+    def write(self, idx: int, mirror_bytes: np.ndarray) -> None:
+        self.handle.sync_pwrite(mirror_bytes.view(np.uint8).reshape(-1),
+                                self._file(idx))
+
+    def read(self, idx: int, nbytes: int) -> np.ndarray:
+        view = self._staging[:nbytes]
+        self.handle.sync_pread(view, self._file(idx))
+        return view
+
+    def staging_view(self, nbytes: int) -> np.ndarray:
+        return self._staging[:nbytes]
 
 
 class NVMeLeafSwapper:
@@ -172,7 +261,8 @@ class HostOffloadOptimizer:
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  adamw: bool = True, mirror_dtype: str = "bfloat16",
                  nvme_path: Optional[str] = None, aio_cfg=None,
-                 dp_shard=(0, 1, 1)):
+                 dp_shard=(0, 1, 1), init_seed: Optional[int] = None,
+                 mirror_nvme_path: Optional[str] = None, init_rules=None):
         """``dp_shard=(rank_start, rank_count, dp_world)``: this host owns
         the contiguous dp-rank range [rank_start, rank_start+rank_count) of
         every flat-partitioned leaf — host work and DRAM scale ~1/hosts
@@ -187,7 +277,8 @@ class HostOffloadOptimizer:
         flat, _ = jax.tree_util.tree_flatten_with_path(params_tree)
         self.leaves: List[_Leaf] = [
             _Leaf(path_str(p), leaf, mirror_dtype, resident=not self.nvme,
-                  shard=self.dp_shard)
+                  shard=self.dp_shard, init_seed=init_seed,
+                  init_rules=init_rules)
             for p, leaf in flat]
         self.swapper = None
         if self.nvme:
@@ -202,6 +293,19 @@ class HostOffloadOptimizer:
                 f"{12 * self.numel() / 1e9:.2f} GB) swapped to "
                 f"{self.swapper.dir}; DRAM window = 2 x "
                 f"{3 * max_numel * 4 / 1e6:.1f} MB", ranks=[0])
+        self.mirror_store = None
+        if mirror_nvme_path:
+            # the PARAM tier (offload_param.device=nvme): compute-dtype
+            # mirrors move to per-leaf files too; host DRAM becomes
+            # O(largest leaf shard) regardless of model size
+            self.mirror_store = MirrorNVMeStore(mirror_nvme_path,
+                                                self.leaves, aio_cfg)
+            for i, leaf in enumerate(self.leaves):
+                leaf.attach_store(self.mirror_store, i)
+            log_dist(
+                f"NVMe param tier: mirrors for {len(self.leaves)} leaves "
+                f"({self.numel() * self.leaves[0]._mirror_itemsize / 1e9:.2f}"
+                f" GB) in {mirror_nvme_path}", ranks=[0])
 
     @property
     def native(self) -> bool:

@@ -1,0 +1,146 @@
+"""Large-model construction without host materialization — the ``zero.Init``
+analogue.
+
+Reference: ``deepspeed/runtime/zero/partition_parameters.py:529`` — a
+context manager that intercepts ``nn.Module`` construction so every
+parameter is partitioned across dp ranks (or pushed to cpu/nvme) the moment
+it is created; a 175B model never exists whole anywhere.
+
+JAX needs no construction-time interception: flax modules are parameter-less
+until ``init``, and ``jax.eval_shape`` traces ``init`` into a tree of
+``ShapeDtypeStruct`` with ZERO memory. From that abstract tree the two
+materialization paths are:
+
+  * ``sharded_init`` — device path: ``jit(model.init, out_shardings=...)``
+    materializes every leaf DIRECTLY into its ZeRO-3 dp-shard (each device
+    allocates 1/dp of each param; no host copy, no full-device copy). This
+    is bit-identical to a plain init.
+  * ``HostOffloadOptimizer(abstract_tree, ...)`` — Infinity path: each host
+    allocates only its dp-rank shard of master (DRAM or NVMe) and fills it
+    from a counter-based RNG streamed at the right offset
+    (``fill_abstract_shard``), so peak DRAM is one leaf-shard regardless of
+    model size. Fills follow flax's default initializer FAMILY (fan-in
+    scaled normal for kernels, zeros for biases, ones for scales, 0.02
+    normal for embeddings) — the right distribution for a fresh run, not a
+    bit-exact replay of a specific PRNGKey (exact replay would require
+    tracing the whole init on one host, which is what this path exists to
+    avoid).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist
+
+
+def abstract_init(model, rng, *sample_args, **sample_kwargs):
+    """Shape-only trace of ``model.init`` (zero memory, any model size).
+    Returns the ``params`` tree of ``jax.ShapeDtypeStruct``."""
+    out = jax.eval_shape(lambda r, *a, **k: model.init(r, *a, **k),
+                         rng, *sample_args, **sample_kwargs)
+    return out["params"] if isinstance(out, dict) and "params" in out else out
+
+
+def sharded_init(model, rng, *sample_args, shardings, dtype=None,
+                 **sample_kwargs):
+    """Materialize params directly into ``shardings`` (ZeRO-3 construction:
+    each device only ever allocates its shard)."""
+
+    def init_fn(r, *a, **k):
+        out = model.init(r, *a, **k)
+        params = out["params"] if isinstance(out, dict) and "params" in out \
+            else out
+        if dtype is not None:
+            params = jax.tree.map(lambda x: x.astype(dtype), params)
+        return params
+
+    return jax.jit(init_fn, out_shardings=shardings)(
+        rng, *sample_args, **sample_kwargs)
+
+
+def is_abstract_tree(tree) -> bool:
+    leaves = jax.tree.leaves(tree)
+    return bool(leaves) and all(
+        isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+
+
+def num_params(tree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+# -- streamed host-shard fills ------------------------------------------------
+
+# (path regex, fill kind): first match wins. Mirrors flax defaults:
+# Dense/attention kernels lecun_normal-family, embeddings normal(0.02),
+# biases zeros, LayerNorm scale ones.
+DEFAULT_INIT_RULES: Tuple[Tuple[str, str], ...] = (
+    (r"(^|/)(wte|wpe|embed|embedding)(/|$)", "embed_normal"),
+    (r"(/|^)(bias|b)$", "zeros"),
+    (r"(/|^)(scale|gamma)$", "ones"),
+    (r"(/|^)beta$", "zeros"),
+    (r"kernel$|w$|weight$|proj$", "fan_in_normal"),
+)
+
+
+def _fill_kind(path: str, shape, rules) -> str:
+    for pat, kind in rules:
+        if re.search(pat, path):
+            return kind
+    # no rule matched: matrices get the fan-in normal (a silently
+    # zero-initialized weight would train dead), vectors get zeros
+    return "fan_in_normal" if len(shape) >= 2 else "zeros"
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Counter-based 64-bit mix (SplitMix64): uint64[n] -> uint64[n]."""
+    with np.errstate(over="ignore"):
+        x = (x + np.uint64(0x9E3779B97F4A7C15))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+def _path_seed(path: str, seed: int) -> np.uint64:
+    h = np.uint64(2166136261)
+    with np.errstate(over="ignore"):
+        for ch in path.encode():  # FNV-1a: stable across processes
+            h = (h ^ np.uint64(ch)) * np.uint64(16777619)
+        return _splitmix64(np.asarray([h ^ np.uint64(seed)]))[0]
+
+
+def fill_abstract_shard(path: str, shape, lo: int, hi: int, *, seed: int,
+                        rules=DEFAULT_INIT_RULES,
+                        init_std: float = 0.02) -> np.ndarray:
+    """Values [lo, hi) of the flattened leaf `path`, generated WITHOUT the
+    rest of the leaf. Each element is a pure function of
+    (seed, path, element index) — counter-based SplitMix64 uniforms fed
+    through Box-Muller — so every host produces a consistent global stream
+    and any re-partitioning (dp resize) reproduces identical values.
+    (numpy's Generator.standard_normal is NOT slice-stable: ziggurat
+    consumes a data-dependent number of draws.)"""
+    n = hi - lo
+    kind = _fill_kind(path, shape, rules)
+    if kind == "zeros":
+        return np.zeros(n, np.float32)
+    if kind == "ones":
+        return np.ones(n, np.float32)
+    if kind == "embed_normal":
+        std = init_std
+    else:  # fan_in_normal: flax lecun_normal family, fan_in = prod(shape[:-1])
+        fan_in = int(np.prod(shape[:-1])) if len(shape) > 1 else int(shape[0])
+        std = float(np.sqrt(1.0 / max(fan_in, 1)))
+    base = _path_seed(path, seed)
+    idx = np.arange(lo, hi, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        u1 = _splitmix64(idx * np.uint64(2) + base)
+        u2 = _splitmix64(idx * np.uint64(2) + np.uint64(1) + base)
+    # 53-bit mantissa uniforms in (0, 1]; u1 flipped away from 0 for the log
+    f1 = ((u1 >> np.uint64(11)).astype(np.float64) + 1.0) / (2.0 ** 53)
+    f2 = (u2 >> np.uint64(11)).astype(np.float64) / (2.0 ** 53)
+    z = np.sqrt(-2.0 * np.log(f1)) * np.cos(2.0 * np.pi * f2)
+    return (z * std).astype(np.float32)

@@ -212,7 +212,12 @@ def test_offload_grads_are_dp_sharded_on_device():
     scale = jnp.asarray(1.0, jnp.float32)
     batches = engine._shard_batch(
         {"input_ids": np.stack([ids[:2], ids[2:]])}, stacked=True)
-    state, flats, _ = engine._jit_train(dict(engine.state), batches, scale)
+    params = engine._offload_params_view()
+    engine.state["params"] = None   # will be donated into the jit
+    sub = {"acc": engine.state["acc"], "rng": engine.state["rng"]}
+    sub, flats, _, params_out = engine._jit_train(params, sub, batches, scale)
+    engine.state.update(sub)
+    engine.state["params"] = params_out
     dp = engine.dp_world_size
     for f in flats:
         # leading (only) dim sharded over dp
