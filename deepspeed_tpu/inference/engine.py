@@ -43,7 +43,8 @@ class InferenceEngine:
                  checkpoint: Optional[str] = None,
                  replace_with_kernel_inject: bool = False,
                  injection_policy=None, quantize_bits: Optional[int] = None,
-                 max_tokens: Optional[int] = None):
+                 max_tokens: Optional[int] = None,
+                 replace_method: Optional[str] = None):
         comm.init_distributed()
         n_dev = len(jax.devices())
         shape = mesh_lib.MeshShape.infer(n_dev, tp=mp_size)
@@ -68,14 +69,26 @@ class InferenceEngine:
             if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else
             jnp.asarray(x), model_parameters)
 
-        self.param_shardings = self.rules.shardings(
-            self.rules.param_specs(params))
+        if replace_method == "auto":
+            # policy-free auto-TP (reference replace_wo_policy,
+            # replace_module.py:502): classify every kernel column/row by
+            # name+shape and let GSPMD insert the allreduces
+            from ..module_inject.auto_tp import auto_tp_shardings
+            self.param_shardings = auto_tp_shardings(params, self.mesh)
+        else:
+            self.param_shardings = self.rules.shardings(
+                self.rules.param_specs(params))
         if quantize_bits == 8:
-            from ..ops.quantizer import quantize_tree
+            from ..ops.quantizer import quantize_shardings, quantize_tree
             # int8 weights live in HBM; dequant happens INSIDE the jitted
             # programs so XLA fuses the scale-multiply into the matmuls and
-            # the TP sharding constraint applies to the dequantized tree
-            self.params = jax.device_put(quantize_tree(params))
+            # the TP sharding constraint applies to the dequantized tree.
+            # The int8 tree itself is placed TP-sharded at rest (q8 leaves
+            # inherit the fp leaf's spec, per-group scales follow), so
+            # mp_size>1 actually divides the HBM footprint
+            q = quantize_tree(params)
+            self.params = jax.device_put(
+                q, quantize_shardings(q, self.param_shardings, self.mesh))
             self.quantized = True
         else:
             self.quantized = False
@@ -102,14 +115,16 @@ class InferenceEngine:
 
     def forward(self, input_ids, **kwargs):
         """Plain (non-incremental) forward — jit-cached per shape, the
-        CUDA-graph replay analogue."""
+        CUDA-graph replay analogue. Extra model inputs (attention_mask,
+        token_type_ids, ...) ride as traced kwargs."""
         if self._jit_forward is None:
-            def f(params, ids):
-                out = self.module.apply({"params": self._materialize(params)},
-                                        ids)
-                return out[0] if isinstance(out, tuple) else out
+            def f(params, ids, kw):
+                return self.module.apply(
+                    {"params": self._materialize(params)}, ids, **kw)
             self._jit_forward = jax.jit(f)
-        return self._jit_forward(self.params, jnp.asarray(input_ids))
+        kw = {k: jnp.asarray(v) for k, v in kwargs.items()
+              if v is not None}
+        return self._jit_forward(self.params, jnp.asarray(input_ids), kw)
 
     __call__ = forward
 

@@ -59,6 +59,8 @@ class GPTConfig:
     # XLA einsum elsewhere (partition-friendly on the virtual CPU mesh)
     attention_impl: str = "auto"     # auto | xla | pallas | sparse
     sparse_attention: Any = None     # SparsityConfig when attention_impl=sparse
+    decode_impl: str = "xla"         # xla | pallas (fused prefix-only kernel;
+                                     # see ops/pallas/decode_attention.py)
     layer_norm_eps: float = 1e-5
     # attention-score scale; None -> 1/sqrt(head_dim). GPT-Neo uses 1.0.
     qk_scale: Any = None
@@ -217,6 +219,12 @@ class SelfAttention(nn.Module):
         idx.value = cur + s
         scale = (cfg.qk_scale if cfg.qk_scale is not None
                  else 1.0 / math.sqrt(d))
+        if s == 1 and self.window is None and cfg.decode_impl == "pallas":
+            # fused prefix-only decode (reference softmax_context kernel):
+            # O(cache_len) work instead of O(max_seq_len) per token
+            from ..ops.pallas.decode_attention import decode_attention
+            return decode_attention(q, ck.value, cv.value, cur + s,
+                                    scale=scale)
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value
                             ).astype(jnp.float32) * scale
         key_pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]

@@ -1,0 +1,111 @@
+"""Policy-free automatic tensor parallelism for arbitrary flax param trees.
+
+Reference: ``replace_wo_policy`` (``module_inject/replace_module.py:502``)
+— for architectures without a hand-written policy, every Linear is split
+column-wise (``LinearLayer``) except the ones that write the residual
+stream, which become ``LinearAllreduce`` (row-split + allreduce).
+
+TPU redesign: "replacing modules" is unnecessary — assigning a
+PartitionSpec to each kernel IS the replacement, and GSPMD inserts the
+psum after row-split matmuls automatically (the LinearAllreduce). What
+remains of the reference's job is the CLASSIFICATION: which matrices split
+which way. Two signals, name first then shape:
+
+  * name patterns (the sharding-rule vocabulary + common HF spellings);
+  * shape: an expanding kernel [d, k*d] is column-parallel, a contracting
+    kernel [k*d, d] is row-parallel (the Linear that contracts back to the
+    hidden size is the residual writer the reference row-splits);
+    square kernels with no name signal stay replicated (safe default —
+    sharding a square matmul wrongly changes numerics under psum).
+
+Embeddings split on the vocab/feature axis like the reference's embedding
+patch (replace_module.py:575); 1-D params (biases, LN) follow their
+matrix: column-split kernels get column-split biases, row-split kernels
+keep replicated biases (the psum already sums the partial products; a
+sharded bias would be added tp times).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils.logging import logger
+
+COLUMN_PAT = re.compile(
+    r"(qkv|query|key|value|q_proj|k_proj|v_proj|up_proj|gate_proj|fc_in|"
+    r"wi|w1|w3|lm_head|intermediate)")
+ROW_PAT = re.compile(r"(out_proj|o_proj|down_proj|dense_4h_to_h|fc_out|"
+                     r"wo|w2|output)")
+EMBED_PAT = re.compile(r"(wte|wpe|wtt|embed|embedding)")
+
+
+def classify(path: str, shape: Tuple[int, ...]) -> Optional[str]:
+    """-> 'column' | 'row' | 'embed' | None (replicate)."""
+    if EMBED_PAT.search(path):
+        return "embed"
+    if len(shape) < 2:
+        return None  # 1-D handled relative to its parent kernel
+    if COLUMN_PAT.search(path):
+        return "column"
+    if ROW_PAT.search(path):
+        return "row"
+    d_in, d_out = shape[-2], shape[-1]
+    if d_out >= 2 * d_in:
+        return "column"
+    if d_in >= 2 * d_out:
+        return "row"
+    return None
+
+
+def infer_tp_specs(params, report: bool = False) -> Dict[str, Any]:
+    """PartitionSpec tree for a generic params tree (the auto-TP walk).
+
+    Kernels: column -> shard last dim on 'tp'; row -> shard second-to-last.
+    Biases: sharded only when their sibling kernel is column-split.
+    Works on scan-stacked trees (leading layer axes are untouched)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    # sibling kernel classification for bias decisions
+    kinds = {}
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        kinds[key] = classify(key, np.shape(leaf))
+
+    specs = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        shape = np.shape(leaf)
+        kind = kinds[key]
+        nd = len(shape)
+        if kind == "embed" and nd >= 2:
+            spec = [None] * nd
+            spec[-1] = "tp"              # feature axis; gather is free
+        elif kind == "column" and nd >= 2:
+            spec = [None] * nd
+            spec[-1] = "tp"
+        elif kind == "row" and nd >= 2:
+            spec = [None] * nd
+            spec[-2] = "tp"
+        elif nd >= 1 and (getattr(path[-1], "key", None) or
+                          getattr(path[-1], "name", "")) == "bias":
+            parent = key.rsplit("['bias']", 1)[0] + "['kernel']"
+            spec = [None] * nd
+            if kinds.get(parent) == "column":
+                spec[-1] = "tp"
+        else:
+            spec = [None] * nd
+        specs.append(P(*spec))
+        if report:
+            logger.info(f"auto-TP: {key} {shape} -> {kind or 'replicate'} "
+                        f"{specs[-1]}")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def auto_tp_shardings(params, mesh) -> Dict[str, Any]:
+    specs = infer_tp_specs(params)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))

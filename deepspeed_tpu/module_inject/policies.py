@@ -50,7 +50,7 @@ class HFGPT2Policy:
             num_layers=hf_config.n_layer,
             num_heads=hf_config.n_head,
             d_model=hf_config.n_embd,
-            d_ff=4 * hf_config.n_embd,
+            d_ff=hf_config.n_inner or 4 * hf_config.n_embd,
             rotary=False, parallel_residual=False, tie_embeddings=True,
             dtype=jnp.float32, param_dtype=jnp.float32,
             scan_layers=True, remat=False)
@@ -159,9 +159,80 @@ class HFGPTNeoPolicy:
         return out
 
 
+class HFBertPolicy:
+    """BERT (reference HFBertLayerPolicy, replace_policy.py:50): torch
+    Linear [out, in] -> transpose; q/k/v concatenated into the fused qkv;
+    encoder layers stacked on a leading layer axis for the scan."""
+
+    @staticmethod
+    def config_from_hf(hf_config):
+        import jax.numpy as jnp
+        from ..models.bert import BertConfig
+        return BertConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            type_vocab_size=hf_config.type_vocab_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            d_model=hf_config.hidden_size,
+            d_ff=hf_config.intermediate_size,
+            layer_norm_eps=hf_config.layer_norm_eps,
+            hidden_dropout=0.0,
+            dtype=jnp.float32, param_dtype=jnp.float32, scan_layers=True)
+
+    @staticmethod
+    def convert(state_dict: Dict[str, Any], n_layer: int) -> Dict[str, Any]:
+        sd = {k.removeprefix("bert."): v for k, v in state_dict.items()}
+        pre = "encoder.layer.{}."
+
+        def lin(fmt):
+            return (_stack(sd, fmt + ".weight", n_layer,
+                           transform=lambda m: m.T),
+                    _stack(sd, fmt + ".bias", n_layer))
+
+        def ln(fmt):
+            return {"scale": _stack(sd, fmt + ".weight", n_layer),
+                    "bias": _stack(sd, fmt + ".bias", n_layer)}
+
+        qk = [np.concatenate(
+            [_np(sd[pre.format(i) + f"attention.self.{n}.weight"]).T
+             for n in ("query", "key", "value")], axis=1)
+            for i in range(n_layer)]
+        qb = [np.concatenate(
+            [_np(sd[pre.format(i) + f"attention.self.{n}.bias"])
+             for n in ("query", "key", "value")])
+            for i in range(n_layer)]
+        ok, ob = lin(pre + "attention.output.dense")
+        uk, ub = lin(pre + "intermediate.dense")
+        dk, db = lin(pre + "output.dense")
+        out = {
+            "wte": {"embedding": _np(sd["embeddings.word_embeddings.weight"])},
+            "wpe": _np(sd["embeddings.position_embeddings.weight"]),
+            "wtt": {"embedding":
+                    _np(sd["embeddings.token_type_embeddings.weight"])},
+            "ln_emb": {"scale": _np(sd["embeddings.LayerNorm.weight"]),
+                       "bias": _np(sd["embeddings.LayerNorm.bias"])},
+            "blocks": {
+                "attn": {
+                    "qkv": {"kernel": np.stack(qk), "bias": np.stack(qb)},
+                    "out_proj": {"kernel": ok, "bias": ob},
+                },
+                "ln_attn": ln(pre + "attention.output.LayerNorm"),
+                "up_proj": {"kernel": uk, "bias": ub},
+                "down_proj": {"kernel": dk, "bias": db},
+                "ln_ffn": ln(pre + "output.LayerNorm"),
+            },
+        }
+        if "pooler.dense.weight" in sd:
+            out["pooler"] = {"kernel": _np(sd["pooler.dense.weight"]).T,
+                             "bias": _np(sd["pooler.dense.bias"])}
+        return out
+
+
 _POLICIES = {
     "gpt2": HFGPT2Policy,
     "gpt_neo": HFGPTNeoPolicy,
+    "bert": HFBertPolicy,
 }
 
 

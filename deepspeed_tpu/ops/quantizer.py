@@ -10,10 +10,16 @@ accuracy (MoQ-style) while weights sit in HBM at 1/4 the fp32 size.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# embedding tables are excluded from weight quantization (reference
+# WeightQuantization skips them; int8 embeddings measurably hurt quality)
+_EMBED_PAT = re.compile(r"\b(wte|wpe|wtt|embed|embedding)\b")
 
 
 def quantize(x: jnp.ndarray, num_groups: int = 1
@@ -39,20 +45,48 @@ def _is_qleaf(x) -> bool:
 
 
 def quantize_tree(params) -> Any:
-    """Quantize every floating >=2-D leaf of a param tree to
-    ``{"q8": int8 [out, ...in], "scale": f32 [out]}`` (one scale group per
-    output column — matmul-friendly); biases/norms stay as-is (reference
-    WeightQuantization quantizes only the GEMM weights)."""
-    def q(leaf):
+    """Quantize GEMM weights of a param tree to ``{"q8": int8 [out, ...in],
+    "scale": f32 [out]}`` (one scale group per output column —
+    matmul-friendly). Biases/norms stay as-is, and so do embedding tables
+    — the predicate is path-based, not rank-based (reference
+    WeightQuantization quantizes only the GEMM weights and skips
+    embeddings)."""
+    def q(path, leaf):
         leaf = jnp.asarray(leaf)
-        if leaf.ndim >= 2 and jnp.issubdtype(leaf.dtype, jnp.floating):
+        key = jax.tree_util.keystr(path)
+        last = (getattr(path[-1], "key", None) or
+                getattr(path[-1], "name", "")) if path else ""
+        is_gemm = last in ("kernel", "w", "weight")
+        if is_gemm and leaf.ndim >= 2 \
+                and jnp.issubdtype(leaf.dtype, jnp.floating) \
+                and not _EMBED_PAT.search(key):
             moved = jnp.moveaxis(leaf, -1, 0)        # (out, ...)
             g = moved.shape[0]
             vals, scales = quantize(moved.reshape(g, -1), num_groups=g)
             return {"q8": vals.reshape(moved.shape), "scale": scales}
         return leaf
 
-    return jax.tree.map(q, params)
+    return jax.tree_util.tree_map_with_path(q, params)
+
+
+def quantize_shardings(qtree, fp_shardings, mesh) -> Any:
+    """Shardings for a quantized tree so int8 weights rest TP-sharded: the
+    q8 leaf takes the fp leaf's spec with the moved-axis permutation (last
+    axis became axis 0), the per-output-column scales take the output-dim
+    entry of that spec."""
+    def sh(qleaf, fp_sh):
+        if not _is_qleaf(qleaf):
+            return fp_sh
+        spec = list(fp_sh.spec) if isinstance(fp_sh, NamedSharding) else []
+        nd = qleaf["q8"].ndim
+        spec = spec + [None] * (nd - len(spec))
+        moved = [spec[-1]] + spec[:-1]               # moveaxis(-1, 0)
+        return {
+            "q8": NamedSharding(mesh, P(*moved)),
+            "scale": NamedSharding(mesh, P(moved[0])),
+        }
+
+    return jax.tree.map(sh, qtree, fp_shardings, is_leaf=_is_qleaf)
 
 
 def dequantize_tree(qtree, dtype=jnp.bfloat16):

@@ -103,6 +103,13 @@ class ShardingRules:
         for plain expert banks [E, ...] and 1 under scan-over-layers
         [L, E, ...] (see _expert_axis)."""
         spec = tp_spec(path, len(shape)) if self.tp > 1 else P(*([None] * len(shape)))
+        if self.tp > 1:
+            # drop tp from dims the axis doesn't divide (e.g. a 2-row
+            # token-type embedding under tp=8): stay replicated there
+            parts = [None if (a == "tp" and shape[i] % self.tp != 0) else a
+                     for i, a in enumerate(list(spec) +
+                                           [None] * (len(shape) - len(spec)))]
+            spec = P(*parts)
         if self.ep > 1 and _EXPERT_PAT.search(path) \
                 and len(shape) > expert_dim and shape[expert_dim] % self.ep == 0:
             parts = list(spec) + [None] * (len(shape) - len(spec))
