@@ -1,0 +1,1 @@
+"""Benchmarks (reference: benchmarks/communication + bin/ds_bench)."""

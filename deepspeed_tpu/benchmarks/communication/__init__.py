@@ -1,0 +1,7 @@
+"""Collective bandwidth/latency sweeps (reference:
+benchmarks/communication/{all_reduce,all_gather,all_to_all,pt2pt,run_all}.py,
+driven by bin/ds_bench). Run: python -m deepspeed_tpu.benchmarks.communication"""
+
+from .run_all import main, run_collective, COLLECTIVES
+
+__all__ = ["main", "run_collective", "COLLECTIVES"]

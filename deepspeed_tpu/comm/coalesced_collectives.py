@@ -1,0 +1,69 @@
+"""Coalesced collectives: one fused exchange for many unevenly-sized
+tensors.
+
+Reference: ``runtime/comm/coalesced_collectives.py:26-99``
+(``reduce_scatter_coalesced``) — ZeRO-3 reduces whole buckets of
+mixed-shape grads in a single reduce-scatter by flattening every tensor
+into per-rank partitions with tail padding, launching ONE collective, and
+handing each rank views of its slices.
+
+TPU note: inside a jitted train step XLA already coalesces collectives it
+can prove adjacent, so the hot ZeRO paths don't call this. It exists for
+the eager surface — host-driven loops (offload, 1-bit host phases,
+checkpoint-time reductions) and tests — where each call would otherwise be
+its own dispatch. Same stacked-view convention as ``comm.py``: a
+"per-rank tensor" is one global array with a leading group axis.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import comm as dist
+
+
+def reduce_scatter_coalesced(tensors, group=None, op: str = "sum"):
+    """tensors: list of stacked [G, ...] per-rank arrays (mixed shapes).
+    Returns a list of [G, padded_i/G] arrays: out[i][r] = rank r's reduced
+    slice of tensor i — ONE fused reduce-scatter for the whole list.
+
+    Layout is rank-major (the reference's per-rank partition assembly,
+    coalesced_collectives.py:52-76): every tensor is padded to a multiple
+    of world and split into world slices; the wire buffer is
+    [rank0's slices of all tensors | rank1's slices | ...], so the single
+    reduce-scatter hands each rank exactly its partition."""
+    group = group if group is not None else dist.new_group("dp")
+    world = group.size
+    numels = [int(np.prod(t.shape[1:])) for t in tensors]
+    pers = [-(-n // world) for n in numels]          # per-rank width each
+
+    parts = []
+    for t, n, per in zip(tensors, numels, pers):
+        flat = jnp.pad(jnp.asarray(t).reshape(world, -1).astype(jnp.float32),
+                       ((0, 0), (0, per * world - n)))
+        parts.append(flat.reshape(world, world, per))  # [src, owner, per]
+    wire = jnp.concatenate(parts, axis=2).reshape(world, -1)
+    out = dist.reduce_scatter_base(wire, op=op, group=group)  # [G, sum pers]
+    views, off = [], 0
+    for per in pers:
+        views.append(out[:, off:off + per])
+        off += per
+    return views
+
+
+def all_gather_coalesced(tensors, group=None):
+    """Inverse-shaped helper: list of stacked [G, n_i] owner slices ->
+    list of [G * n_i] replicated full tensors, one fused all-gather."""
+    group = group if group is not None else dist.new_group("dp")
+    widths = [t.shape[1] for t in tensors]
+    flat = jnp.concatenate([jnp.asarray(t) for t in tensors], axis=1)
+    gathered = dist.all_gather(flat, group=group)     # [G, sum widths]
+    outs, off = [], 0
+    for w in widths:
+        outs.append(gathered[:, off:off + w].reshape(-1))
+        off += w
+    return outs

@@ -287,6 +287,29 @@ def ppermute(x, perm, group: Optional[CommGroup] = None):
     return shard_map(f, mesh=group.mesh, in_specs=(spec,), out_specs=spec)(x)
 
 
+def send(x, dst: int, src: Optional[int] = None,
+         group: Optional[CommGroup] = None):
+    """Stacked p2p send (reference comm.py send / pipe p2p.py:48): moves
+    x[src] to rank dst; other rows are zeros in the result. ``src``
+    defaults to every rank sending to ``dst``'s left neighbor semantics —
+    pass it explicitly for a single directed edge. Composes with ``recv``
+    as one ppermute under the hood (on TPU a directed pair IS a permute)."""
+    if src is None:
+        src = (dst - 1) % _default_group(group).size if group else 0
+    return ppermute(x, [(src, dst)], group=group)
+
+
+def recv(x, src: int, dst: Optional[int] = None,
+         group: Optional[CommGroup] = None):
+    """Stacked p2p receive: returns the stack where row dst holds rank
+    src's tensor (zeros elsewhere). With ``dst=None`` receives into
+    ``src+1`` (pipeline neighbor order)."""
+    group_ = _default_group(group)
+    if dst is None:
+        dst = (src + 1) % group_.size
+    return ppermute(x, [(src, dst)], group=group)
+
+
 # Capability shims kept for API parity with the reference (comm.py:165-216).
 allgather_fn = all_gather_base
 reduce_scatter_fn = reduce_scatter_base
