@@ -1,0 +1,152 @@
+"""State-dict loaders with model-parallel resharding — load a checkpoint
+written at one TP degree into a different one.
+
+Reference: ``runtime/state_dict_factory.py`` (``SDLoaderFactory``:17,
+``MegatronSDLoader``:195) — per-mp-rank checkpoint files are merged (2->1)
+or split (1->N) with category-aware axis math: fused QKV interleaves per
+rank, column-parallel weights concat/split on the output axis,
+row-parallel on the input axis, replicated tensors pass through.
+
+TPU note: OUR OWN checkpoints never need this (global arrays re-shard by
+``device_put``/orbax restore with the new mesh). This module exists for
+FOREIGN checkpoints — torch/Megatron state dicts that exist only as N
+per-rank shard files — so they can be imported at any TP degree and fed
+to the injection policies (module_inject/policies.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+
+# category patterns over foreign (torch/Megatron/HF) key names
+QKV_PAT = re.compile(r"(query_key_value|qkv|c_attn)\.(weight|bias)$")
+COLUMN_PAT = re.compile(
+    r"(dense_h_to_4h|fc_in|up_proj|gate_proj|intermediate\.dense|"
+    r"lm_head|word_embeddings|wte|embed_tokens)\.(weight|bias)$")
+ROW_PAT = re.compile(
+    r"(dense_4h_to_h|fc_out|down_proj|attention\.dense|out_proj|"
+    r"output\.dense|c_proj)\.weight$")
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def classify(key: str) -> str:
+    """-> qkv | column | row | replicate. Row-parallel BIASES replicate
+    (added once after the reduction), which the row pattern encodes by
+    matching .weight only."""
+    if QKV_PAT.search(key):
+        return "qkv"
+    if COLUMN_PAT.search(key):
+        return "column"
+    if ROW_PAT.search(key):
+        return "row"
+    return "replicate"
+
+
+def merge_qkv(params: Sequence[np.ndarray], ckpt_ver: float = 2.0
+              ) -> np.ndarray:
+    """Merge per-rank fused-QKV shards (reference merge_query_key_value,
+    state_dict_factory.py:224). Version 0 stores [3*np*hn, h] per rank
+    (q|k|v blocks each holding that rank's heads) — merging regroups all-q
+    then all-k then all-v; versions 1.0/2.0 interleave per head, so a
+    plain concat is correct."""
+    params = [_np(p) for p in params]
+    if ckpt_ver == 0:
+        thirds = [np.split(p, 3, axis=0) for p in params]
+        return np.concatenate(
+            [np.concatenate([t[i] for t in thirds], axis=0)
+             for i in range(3)], axis=0)
+    return np.concatenate(params, axis=0)
+
+
+def split_qkv(param: np.ndarray, num_to_split: int, offset: int,
+              ckpt_ver: float = 2.0) -> np.ndarray:
+    """Inverse of merge_qkv (reference split_query_key_value:262)."""
+    param = _np(param)
+    if ckpt_ver == 0:
+        thirds = np.split(param, 3, axis=0)
+        return np.concatenate(
+            [np.split(t, num_to_split, axis=0)[offset] for t in thirds],
+            axis=0)
+    return np.split(param, num_to_split, axis=0)[offset]
+
+
+def merge_state_dicts(state_dicts: Sequence[Dict[str, Any]],
+                      ckpt_ver: float = 2.0) -> Dict[str, Any]:
+    """N per-mp-rank state dicts -> one full state dict (reference
+    merge_state_dict:171)."""
+    out: Dict[str, Any] = {}
+    for key in state_dicts[0]:
+        parts = [sd[key] for sd in state_dicts]
+        kind = classify(key)
+        if kind == "qkv":
+            out[key] = merge_qkv(parts, ckpt_ver)
+        elif kind == "column":
+            out[key] = np.concatenate([_np(p) for p in parts], axis=0)
+        elif kind == "row":
+            out[key] = np.concatenate([_np(p) for p in parts], axis=1)
+        else:
+            out[key] = _np(parts[0])
+    return out
+
+
+def split_state_dict(state_dict: Dict[str, Any], mp_world: int, rank: int,
+                     ckpt_ver: float = 2.0) -> Dict[str, Any]:
+    """One full state dict -> rank's shard at mp degree mp_world
+    (reference split_state_dict:181)."""
+    out: Dict[str, Any] = {}
+    for key, value in state_dict.items():
+        kind = classify(key)
+        v = _np(value)
+        if kind == "qkv":
+            out[key] = split_qkv(v, mp_world, rank, ckpt_ver)
+        elif kind == "column":
+            out[key] = np.split(v, mp_world, axis=0)[rank]
+        elif kind == "row":
+            out[key] = np.split(v, mp_world, axis=1)[rank]
+        else:
+            out[key] = v
+    return out
+
+
+class SDLoaderFactory:
+    """Reference SDLoaderFactory:17 — resolve a checkpoint list to a loader
+    that produces the state dict at the CURRENT mp degree."""
+
+    @staticmethod
+    def get_sd_loader(ckpt_list: List[str], version: float = 2.0):
+        return MegatronSDLoader(ckpt_list, version)
+
+
+class MegatronSDLoader:
+    def __init__(self, ckpt_list: List[str], version: float = 2.0):
+        self.ckpt_list = list(ckpt_list)
+        self.version = version
+
+    def _load_all(self):
+        import torch
+        return [torch.load(p, map_location="cpu") for p in self.ckpt_list]
+
+    def load(self, mp_world_size: int, mp_rank: int) -> Dict[str, Any]:
+        """Produce mp_rank's state dict at the requested degree, merging or
+        splitting the source shards as needed (reference load:101)."""
+        sds = self._load_all()
+        sds = [sd.get("model", sd) if isinstance(sd, dict) else sd
+               for sd in sds]
+        src = len(sds)
+        if src == mp_world_size:
+            return {k: _np(v) for k, v in sds[mp_rank].items()}
+        full = merge_state_dicts(sds, self.version)
+        if mp_world_size == 1:
+            return full
+        logger.info(f"resharding checkpoint: mp {src} -> {mp_world_size}")
+        return split_state_dict(full, mp_world_size, mp_rank, self.version)

@@ -161,55 +161,62 @@ class InferenceEngine:
                 return logits[:, -1], cache["cache"]
             self._jit_prefill = jax.jit(prefill)
 
-        # decode program is specialized per sampling config (the reference
-        # re-captures its CUDA graph per config the same way)
-        key = (float(temperature), top_k)
+        def sample(logits, rng):
+            logits = logits.astype(jnp.float32)
+            if temperature not in (0.0, 1.0):
+                logits = logits / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits < kth, -1e10, logits)
+            rng, sub = jax.random.split(rng)
+            if temperature == 0.0:
+                nxt = jnp.argmax(logits, axis=-1)
+            else:
+                nxt = jax.random.categorical(sub, logits, axis=-1)
+            return nxt.astype(jnp.int32), rng
+
+        # whole decode loop as ONE jitted scan — no per-token dispatch and
+        # no per-token host sync on eos (the reference's generate breaks the
+        # host loop on eos, engine weak-point #9: under the TPU relay every
+        # such sync costs a round trip). Rows that hit eos keep emitting
+        # eos; the loop is static-length and the padding is what HF-style
+        # generate produces anyway.
+        key = (float(temperature), top_k, eos_token_id, max_new_tokens)
         if key not in self._jit_decode:
-            def decode(params, cache, token, pos, rng):
-                positions = pos[:, None]
-                logits, new_vars = self.module.apply(
-                    {"params": self._materialize(params),
-                     "cache": cache}, token[:, None],
-                    positions=positions, mutable=["cache"])
-                if isinstance(logits, tuple):
-                    logits = logits[0]
-                logits = logits[:, -1].astype(jnp.float32)
-                if temperature not in (0.0, 1.0):
-                    logits = logits / temperature
-                if top_k is not None:
-                    kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-                    logits = jnp.where(logits < kth, -1e10, logits)
-                rng, sub = jax.random.split(rng)
-                if temperature == 0.0:
-                    nxt = jnp.argmax(logits, axis=-1)
-                else:
-                    nxt = jax.random.categorical(sub, logits, axis=-1)
-                return nxt.astype(jnp.int32), new_vars["cache"], rng
-            # donate the cache: XLA updates the KV arena in place instead
-            # of copying it every token
-            self._jit_decode[key] = jax.jit(decode, donate_argnums=(1,))
-        decode_fn = self._jit_decode[key]
+            def gen(params, cache, token, pos, rng):
+                pm = self._materialize(params)
+
+                def body(carry, _):
+                    token, cache, pos, rng, done = carry
+                    logits, new_vars = self.module.apply(
+                        {"params": pm, "cache": cache}, token[:, None],
+                        positions=pos[:, None], mutable=["cache"])
+                    if isinstance(logits, tuple):
+                        logits = logits[0]
+                    nxt, rng = sample(logits[:, -1], rng)
+                    if eos_token_id is not None:
+                        nxt = jnp.where(done, eos_token_id, nxt)
+                        done = done | (nxt == eos_token_id)
+                    return (nxt, new_vars["cache"], pos + 1, rng, done), nxt
+
+                done = (jnp.full(token.shape, False) if eos_token_id is None
+                        else token == eos_token_id)
+                (_, cache, _, _, _), toks = jax.lax.scan(
+                    body, (token, cache, pos, rng, done),
+                    None, length=max_new_tokens - 1)
+                return jnp.moveaxis(toks, 0, 1)        # [b, steps]
+            # donate the cache: XLA updates the KV arena in place
+            self._jit_decode[key] = jax.jit(gen, donate_argnums=(1,))
+        gen_fn = self._jit_decode[key]
 
         last_logits, cache = self._jit_prefill(self.params, ids)
-        logits0 = last_logits.astype(jnp.float32)
-        if temperature == 0.0:
-            token = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
-        else:
-            rng, sub = jax.random.split(rng)
-            token = jax.random.categorical(
-                sub, logits0 / max(temperature, 1e-6), axis=-1
-            ).astype(jnp.int32)
-        out = [token]
+        rng, sub = jax.random.split(rng)
+        token, _ = sample(last_logits, sub)
         pos = jnp.full((b,), s, jnp.int32)
-        for _ in range(max_new_tokens - 1):
-            token, cache, rng = decode_fn(self.params, cache, token, pos,
-                                          rng)
-            out.append(token)
-            pos = pos + 1
-            if eos_token_id is not None and bool(
-                    jnp.all(token == eos_token_id)):
-                break
-        return jnp.concatenate([ids, jnp.stack(out, axis=1)], axis=1)
+        if max_new_tokens == 1:
+            return jnp.concatenate([ids, token[:, None]], axis=1)
+        rest = gen_fn(self.params, cache, token, pos, rng)
+        return jnp.concatenate([ids, token[:, None], rest], axis=1)
 
     # --------------------------------------------------------- checkpoint
     def _load_checkpoint(self, checkpoint: str):

@@ -11,10 +11,14 @@ def interpret_mode() -> bool:
 
 
 def rows_block(n_rows: int, max_block: int = 256) -> int:
-    """Largest power-of-two row-block <= max_block dividing n_rows."""
+    """Largest power-of-two row-block <= max_block dividing n_rows.
+    Returns 0 when no block >= 8 divides (TPU Mosaic needs the
+    second-to-last block dim to be a multiple of the 8-row sublane tile or
+    equal to the array dim) — callers fall back to the XLA implementation,
+    like flash_attention does for unsupported shapes."""
     cand = max_block
-    while cand > 1:
+    while cand >= 8:
         if n_rows % cand == 0:
             return cand
         cand //= 2
-    return 1
+    return n_rows if n_rows < 8 else 0

@@ -63,8 +63,7 @@ def _run_rowwise(kernel, inputs, d, out_dtype):
 
 
 @jax.custom_vjp
-def bias_gelu(x, bias):
-    """gelu(x + bias) fused. x: [..., D]; bias: [D]."""
+def _bias_gelu_pallas(x, bias):
     orig = x.shape
     d = x.shape[-1]
     y = _run_rowwise(_fwd_kernel, (x.reshape(-1, d), bias), d, x.dtype)
@@ -72,7 +71,7 @@ def bias_gelu(x, bias):
 
 
 def _bias_gelu_fwd(x, bias):
-    return bias_gelu(x, bias), (x, bias)
+    return _bias_gelu_pallas(x, bias), (x, bias)
 
 
 def _bias_gelu_bwd(res, g):
@@ -87,7 +86,16 @@ def _bias_gelu_bwd(res, g):
     return dx, dbias
 
 
-bias_gelu.defvjp(_bias_gelu_fwd, _bias_gelu_bwd)
+_bias_gelu_pallas.defvjp(_bias_gelu_fwd, _bias_gelu_bwd)
+
+
+def bias_gelu(x, bias):
+    """gelu(x + bias) fused. x: [..., D]; bias: [D]. Row counts TPU can't
+    tile fall back to XLA (which fuses this fine anyway)."""
+    import numpy as _n
+    if rows_block(int(_n.prod(x.shape[:-1])), 256) == 0:
+        return jax.nn.gelu(x + bias, approximate=True)
+    return _bias_gelu_pallas(x, bias)
 
 
 def gelu(x):

@@ -106,8 +106,7 @@ def _ln_bwd(eps, res, g):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def layer_norm(x, gamma, beta, eps: float = 1e-5):
-    """Fused layer norm over the last dim. x: [..., D]; gamma/beta: [D]."""
+def _layer_norm_pallas(x, gamma, beta, eps: float = 1e-5):
     y, _ = _ln_fwd(x, gamma, beta, eps)
     return y
 
@@ -116,4 +115,18 @@ def _layer_norm_fwd(x, gamma, beta, eps):
     return _ln_fwd(x, gamma, beta, eps)
 
 
-layer_norm.defvjp(_layer_norm_fwd, _ln_bwd)
+_layer_norm_pallas.defvjp(_layer_norm_fwd, _ln_bwd)
+
+
+def layer_norm(x, gamma, beta, eps: float = 1e-5):
+    """Fused layer norm over the last dim. x: [..., D]; gamma/beta: [D].
+    Row counts TPU can't tile (no block >= 8 divides) fall back to XLA."""
+    import numpy as _n
+    if rows_block(int(_n.prod(x.shape[:-1])), 256) == 0:
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps)
+        return (y * gamma.astype(jnp.float32)
+                + beta.astype(jnp.float32)).astype(x.dtype)
+    return _layer_norm_pallas(x, gamma, beta, eps)

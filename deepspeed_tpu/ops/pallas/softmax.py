@@ -84,14 +84,27 @@ def _softmax_bwd(causal, res, g):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def fused_softmax(x, causal: bool = False):
-    """Softmax over the last dim with optional causal (triangular) masking.
-    For causal masking x must be [..., S, S] score matrices."""
+def _fused_softmax_pallas(x, causal: bool = False):
     y, _ = _softmax_fwd(x, causal)
     return y
 
 
-fused_softmax.defvjp(lambda x, causal: _softmax_fwd(x, causal), _softmax_bwd)
+_fused_softmax_pallas.defvjp(lambda x, causal: _softmax_fwd(x, causal),
+                             _softmax_bwd)
+
+
+def fused_softmax(x, causal: bool = False):
+    """Softmax over the last dim with optional causal (triangular) masking.
+    For causal masking x must be [..., S, S] score matrices. Row counts TPU
+    can't tile fall back to XLA."""
+    import numpy as _n
+    if rows_block(int(_n.prod(x.shape[:-1])), 128) == 0:
+        if causal:
+            s_len = x.shape[-1]
+            tri = jnp.tril(jnp.ones((s_len, s_len), bool))
+            x = jnp.where(tri, x, -jnp.inf)
+        return jax.nn.softmax(x.astype(jnp.float32), axis=-1).astype(x.dtype)
+    return _fused_softmax_pallas(x, causal)
 
 
 def masked_softmax(x, mask: Optional[jnp.ndarray] = None,

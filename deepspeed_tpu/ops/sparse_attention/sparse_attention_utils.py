@@ -1,0 +1,70 @@
+"""Model-integration helpers for block-sparse attention.
+
+Reference: ``deepspeed/ops/sparse_attention/sparse_attention_utils.py:225``
+(``SparseAttentionUtils``) — pad inputs to the sparsity block size, patch
+HF BERT/RoBERTa self-attention with ``BertSparseSelfAttention``, extend
+position embeddings for longer sequences, unpad outputs.
+
+TPU shape: "patching a module" is a config choice here — the GPT family
+takes ``attention_impl="sparse"`` + a SparsityConfig directly — so what
+remains are the input-geometry helpers (sequences must be whole blocks for
+the LUT kernels) and the embedding extension for beyond-pretraining
+lengths."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseAttentionUtils:
+    @staticmethod
+    def pad_to_block_size(block: int, input_ids, *, attention_mask=None,
+                          token_type_ids=None, pad_token_id: int = 0):
+        """Right-pad [B, S] inputs so S is a whole number of sparsity
+        blocks (reference pad_to_block_size:225). Returns
+        (pad_len, input_ids, attention_mask, token_type_ids); the mask
+        zeros the padding so attention ignores it."""
+        b, s = input_ids.shape
+        pad_len = (-s) % block
+        if pad_len == 0:
+            if attention_mask is None:
+                attention_mask = jnp.ones((b, s), jnp.int32)
+            return 0, input_ids, attention_mask, token_type_ids
+        input_ids = jnp.pad(input_ids, ((0, 0), (0, pad_len)),
+                            constant_values=pad_token_id)
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.int32)
+        attention_mask = jnp.pad(attention_mask, ((0, 0), (0, pad_len)))
+        if token_type_ids is not None:
+            token_type_ids = jnp.pad(token_type_ids, ((0, 0), (0, pad_len)))
+        return pad_len, input_ids, attention_mask, token_type_ids
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        """Drop the padding rows again (reference unpad_sequence_output)."""
+        if pad_len == 0:
+            return sequence_output
+        return sequence_output[:, :-pad_len]
+
+    @staticmethod
+    def extend_position_embedding(wpe: jnp.ndarray, max_position: int):
+        """Tile the pretrained position table out to ``max_position``
+        (reference extend_position_embedding: repeats the learned table so
+        a 512-pos BERT can serve 2048-token sparse attention)."""
+        cur = wpe.shape[0]
+        if max_position <= cur:
+            return wpe[:max_position]
+        reps = -(-max_position // cur)
+        return jnp.tile(wpe, (reps, 1))[:max_position]
+
+    @staticmethod
+    def sparse_gpt_config(cfg, sparsity_config) -> Any:
+        """The module-patch analogue (reference replace_model_self_attention
+        + update_config): the same model runs block-sparse by config — no
+        module surgery needed in a functional framework."""
+        import dataclasses
+        return dataclasses.replace(cfg, attention_impl="sparse",
+                                   sparse_attention=sparsity_config)

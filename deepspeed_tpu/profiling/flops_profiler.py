@@ -69,3 +69,93 @@ def profile_model_flops(apply_fn, *example_args) -> Dict[str, Any]:
     """Standalone: flops + param bytes of a model apply function."""
     flops = compiled_flops(apply_fn, *example_args)
     return {"flops": flops}
+
+
+# ---------------------------------------------------------------------------
+# Per-module tree (reference profiler.py's printed module hierarchy with
+# params/MACs/latency per module, profiler.py:330-430)
+# ---------------------------------------------------------------------------
+
+def module_profile_tree(model, params, *example_args, depth: int = -1,
+                        top: int = 0, **example_kwargs):
+    """Per-module profile rows for a flax model: (path, #params, MACs).
+
+    The reference hooks torch modules at runtime; under jit that's
+    impossible, so this walks the captured per-module INTERMEDIATES from an
+    ``eval_shape`` apply (zero memory, any size): each module's parameter
+    count comes from its params subtree and its MACs from the Dense/Embed
+    kernels it owns times the tokens that flowed through it (output shapes
+    from the capture)."""
+    import numpy as np
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    _, state = jax.eval_shape(
+        lambda p, *a, **k: model.apply(
+            {"params": p}, *a, capture_intermediates=True, mutable=["intermediates"],
+            **k),
+        params, *example_args, **example_kwargs)
+    inter = state["intermediates"]
+
+    rows = []
+
+    def walk(ptree, itree, path):
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree.leaves(ptree))
+        out_shape = None
+        if isinstance(itree, dict) and "__call__" in itree:
+            outs = itree["__call__"]
+            leaf = jax.tree.leaves(outs)
+            if leaf:
+                out_shape = tuple(leaf[0].shape)
+        macs = _module_macs(ptree, out_shape)
+        rows.append({"module": "/".join(path) or "<root>",
+                     "params": n_params, "macs": macs,
+                     "output_shape": out_shape,
+                     "depth": len(path)})
+        if isinstance(ptree, dict):
+            for key in sorted(ptree):
+                sub_i = itree.get(key, {}) if isinstance(itree, dict) else {}
+                if isinstance(ptree[key], dict):
+                    walk(ptree[key], sub_i, path + [key])
+
+    walk(params, inter, [])
+    if depth >= 0:
+        rows = [r for r in rows if r["depth"] <= depth]
+    if top:
+        body = sorted([r for r in rows if r["depth"] == 1],
+                      key=lambda r: -(r["macs"] or 0))[:top]
+        rows = [rows[0]] + body
+    return rows
+
+
+def _module_macs(ptree, out_shape):
+    """MACs for the GEMMs this module owns: kernel [..., in, out] applied
+    to `tokens` rows (from the module's output shape)."""
+    import numpy as np
+    if out_shape is None or len(out_shape) < 2:
+        return None
+    tokens = int(np.prod(out_shape[:-1]))
+    macs = 0
+    leaves = jax.tree_util.tree_flatten_with_path(ptree)[0]
+    for path, leaf in leaves:
+        last = getattr(path[-1], "key", "")
+        if last in ("kernel", "w") and len(leaf.shape) >= 2:
+            macs += tokens * int(np.prod(leaf.shape[-2:])) * (
+                int(np.prod(leaf.shape[:-2])) or 1)
+    return macs
+
+
+def print_module_profile(model, params, *example_args, depth: int = -1,
+                         **example_kwargs):
+    """Reference-style tree printout."""
+    rows = module_profile_tree(model, params, *example_args, depth=depth,
+                               **example_kwargs)
+    log_dist(f"{'module':<40} {'params':>12} {'MACs':>14} output", ranks=[0])
+    for r in rows:
+        indent = "  " * r["depth"]
+        macs = f"{r['macs']:,}" if r["macs"] else "-"
+        log_dist(f"{indent + r['module'].split('/')[-1]:<40} "
+                 f"{r['params']:>12,} {macs:>14} "
+                 f"{r['output_shape'] or ''}", ranks=[0])
+    return rows

@@ -1,0 +1,76 @@
+"""Row-sparse gradients for embedding tables.
+
+Reference: ``runtime/sparse_tensor.py:11`` (``SparseTensor`` wrapper) and
+the sparse-allreduce path for Embedding layers (``engine.py:2199-2277``) —
+a batch touches only a few vocabulary rows, so exchanging (indices, values)
+instead of the dense [V, D] gradient cuts comm volume by V/unique_tokens.
+
+TPU shape: inside the jitted train step XLA's gather-grad is already an
+efficient scatter-add and the dp reduction rides ICI, so the hot path
+doesn't need this. It serves the eager/host surfaces (offload grad hops,
+comm experiments, multi-host DCN reductions where volume is the
+bottleneck) with static-shape-friendly semantics: ``nnz`` is a static
+capacity (top-k touched rows), not a data-dependent count — the XLA
+discipline for "sparse" on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class SparseTensor:
+    """Row-sparse view of a [V, D] matrix: values[i] belongs to row
+    indices[i]; rows listed more than once sum (COO semantics)."""
+    indices: jnp.ndarray     # [nnz] int32
+    values: jnp.ndarray      # [nnz, D]
+    dense_shape: tuple
+
+    @staticmethod
+    def from_dense(x, nnz: Optional[int] = None) -> "SparseTensor":
+        """Capture the nnz largest-norm rows (static capacity; rows beyond
+        it are dropped — callers pick nnz >= max touched rows)."""
+        v, d = x.shape
+        norms = jnp.sum(jnp.abs(x), axis=1)
+        k = min(nnz or v, v)
+        _, idx = jax.lax.top_k(norms, k)
+        idx = idx.astype(jnp.int32)
+        return SparseTensor(indices=idx, values=x[idx, :],
+                            dense_shape=(v, d))
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    def wire_bytes(self) -> int:
+        return (self.indices.size * 4
+                + self.values.size * self.values.dtype.itemsize)
+
+    def dense_bytes(self) -> int:
+        return int(np.prod(self.dense_shape)) * self.values.dtype.itemsize
+
+
+def sparse_all_reduce(stacked: "list[SparseTensor]", group=None):
+    """Allreduce of per-rank row-sparse grads (reference
+    sparse_allreduce_bucket, engine.py:2236): exchange (indices, values)
+    stacks, scatter-add into the dense result. Returns the dense [V, D]
+    sum, replicated."""
+    from ..comm import comm as dist
+    group = group if group is not None else dist.new_group("dp")
+    idx = jnp.stack([s.indices for s in stacked])     # [G, nnz]
+    val = jnp.stack([s.values for s in stacked])      # [G, nnz, D]
+    idx_g = dist.all_gather(idx, group=group)
+    val_g = dist.all_gather(val, group=group)
+    dense = jnp.zeros(stacked[0].dense_shape, val.dtype)
+    return dense.at[idx_g.reshape(-1)].add(
+        val_g.reshape(-1, val.shape[-1]))

@@ -1,0 +1,71 @@
+"""Tiled linear layers: bound the ZeRO-3 working set of huge matmuls.
+
+Reference: ``zero/tiling.py:27`` (``TiledLinear``) — a Linear too big to
+gather whole under ZeRO-3 is split into row/column tiles that are gathered,
+used, and released one at a time.
+
+TPU shape: tiles are a leading param axis consumed by ``lax.scan``, the
+same structure that gives the GPT blocks per-layer gather/release — XLA
+materializes ONE tile's gathered copy at a time and the dp-sharded master
+stays put. ``TiledDense(in_splits=p, out_splits=q)`` is numerically
+identical to ``nn.Dense`` (tile summation over input splits, concatenation
+over output splits, bias added once)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TiledDense(nn.Module):
+    """y = x @ W + b with W stored as [in_splits * out_splits, d_in/p,
+    d_out/q] tiles scanned one at a time."""
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        p, q = self.in_splits, self.out_splits
+        d_in, d_out = x.shape[-1], self.features
+        if d_in % p or d_out % q:
+            raise ValueError(f"({d_in}, {d_out}) not divisible by splits "
+                             f"({p}, {q})")
+        ti, to = d_in // p, d_out // q
+        kernel = self.param(
+            "kernel",
+            nn.initializers.variance_scaling(  # fan_in of the FULL matmul
+                1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1),
+            (p * q, ti, to), self.param_dtype)
+        dtype = self.dtype or x.dtype
+        xs = x.astype(dtype).reshape(x.shape[:-1] + (p, ti))
+
+        def tile_step(carry, wt):
+            acc, idx = carry
+            i = idx // q          # input split
+            j = idx % q           # output split
+            xa = jax.lax.dynamic_index_in_dim(xs, i, axis=-2, keepdims=False)
+            part = xa @ wt.astype(dtype)                    # [..., to]
+            acc = jax.lax.dynamic_update_slice_in_dim(
+                acc, jax.lax.dynamic_slice_in_dim(
+                    acc, j * to, to, axis=-1) + part, j * to, axis=-1)
+            return (acc, idx + 1), None
+
+        acc = jnp.zeros(x.shape[:-1] + (d_out,), dtype)
+        (acc, _), _ = jax.lax.scan(tile_step, (acc, jnp.int32(0)), kernel)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (d_out,), self.param_dtype)
+            acc = acc + bias.astype(dtype)
+        return acc
+
+
+# reference-name alias
+TiledLinear = TiledDense
