@@ -341,7 +341,11 @@ class GPT(nn.Module):
                 "dots_no_batch":
                     jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             }[cfg.remat_policy]
-            block = nn.remat(Block, prevent_cse=False, policy=policy)
+            # deterministic stays STATIC through remat: MoE gating and
+            # dropout branch on it in Python (tracing it breaks, and a
+            # traced train/eval flag would bake both branches anyway)
+            block = nn.remat(Block, prevent_cse=False, policy=policy,
+                             static_argnums=(3,))   # arg 0 is the module
 
         if cfg.attn_windows is not None and cfg.scan_layers:
             raise ValueError("attn_windows (heterogeneous layers) requires "
