@@ -30,6 +30,33 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _sp_constraint(x, spec_parts):
+    """Ulysses sharding constraint against the global mesh (no-op when the
+    mesh's sp axis is 1). Axes the shape doesn't divide are dropped —
+    e.g. the size-1 sample batch used for init."""
+    from ..parallel import mesh as mesh_lib
+    mesh = mesh_lib.get_global_mesh()
+    shape = dict(mesh.shape)
+    if shape.get("sp", 1) == 1:
+        return x
+    parts = [a if (a is None or x.shape[i] % shape.get(a, 1) == 0) else None
+             for i, a in enumerate(spec_parts)]
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*parts)))
+
+
+def sp_shard_sequence(x):
+    """[B, S, D] activations sequence-sharded over sp."""
+    return _sp_constraint(x, ("dp", "sp", None))
+
+
+def sp_shard_heads(x):
+    """[B, S, H, d] attention tensors head-sharded over sp (full sequence
+    per chip for its head subset — the all-to-all happens here)."""
+    return _sp_constraint(x, ("dp", None, "sp", None))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +88,16 @@ class GPTConfig:
     sparse_attention: Any = None     # SparsityConfig when attention_impl=sparse
     decode_impl: str = "xla"         # xla | pallas (fused prefix-only kernel;
                                      # see ops/pallas/decode_attention.py)
+    # Ulysses-style sequence parallelism over the mesh's `sp` axis (the
+    # long-context strategy beyond the reference's block-sparse attention;
+    # DeepSpeed-Ulysses all-to-all design, here expressed as sharding
+    # constraints): activations ride sequence-sharded [B, S/sp, D] through
+    # embeddings/LN/MLP, and attention constrains q/k/v to HEAD-sharded
+    # [B, S, H/sp, d] — GSPMD inserts the two all-to-alls per layer. Each
+    # chip's attention sees the FULL sequence for its head subset, so
+    # context length scales with the sp degree at O(S/sp) activation
+    # memory per chip. Requires num_heads % sp == 0.
+    sequence_parallel: bool = False
     layer_norm_eps: float = 1e-5
     # attention-score scale; None -> 1/sqrt(head_dim). GPT-Neo uses 1.0.
     qk_scale: Any = None
@@ -191,11 +228,25 @@ class SelfAttention(nn.Module):
         if decode:
             out = self._decode_attention(q, k, v, positions)
         else:
+            impl = cfg.attention_impl
+            if cfg.sequence_parallel:
+                # Ulysses: seq-sharded -> head-sharded (all-to-all); each
+                # chip attends over the FULL sequence for H/sp heads. The
+                # einsum path partitions over heads under GSPMD; the pallas
+                # custom call does not auto-partition, so force xla here
+                q, k, v = map(sp_shard_heads, (q, k, v))
+                if impl in ("auto", "pallas"):
+                    impl = "xla"
             out = causal_attention(q, k, v, dtype=cfg.dtype,
-                                   impl=cfg.attention_impl,
+                                   impl=impl,
                                    sparse_config=cfg.sparse_attention,
                                    scale=cfg.qk_scale, window=self.window)
+            if cfg.sequence_parallel:
+                out = sp_shard_heads(out)
         out = out.reshape(b, s, cfg.d_model)
+        if cfg.sequence_parallel and not decode:
+            # back to sequence sharding for the projection/MLP/LN
+            out = sp_shard_sequence(out)
         return nn.Dense(cfg.d_model, use_bias=True, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="out_proj")(out)
 
@@ -332,6 +383,8 @@ class GPT(nn.Module):
                 "wpe", nn.initializers.normal(0.02),
                 (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
             x = x + pos_emb[positions].astype(cfg.dtype)
+        if cfg.sequence_parallel:
+            x = sp_shard_sequence(x)
 
         block = Block
         if cfg.remat:

@@ -609,7 +609,7 @@ class DeepSpeedEngine:
             block_eigenvalue=self.block_eigenvalue)
 
     def _shard_batch(self, batch, stacked: bool = False):
-        axes = ("dp",)
+        sp = dict(self.mesh.shape).get("sp", 1)
 
         def put(x):
             x = jnp.asarray(x)
@@ -617,6 +617,10 @@ class DeepSpeedEngine:
             spec = [None] * x.ndim
             if x.ndim > dim and x.shape[dim] % self.dp_world_size == 0:
                 spec[dim] = "dp"
+            # sequence parallelism: the seq axis lands pre-sharded over sp
+            # (models constrain activations the same way — Ulysses)
+            if sp > 1 and x.ndim > dim + 1 and x.shape[dim + 1] % sp == 0:
+                spec[dim + 1] = "sp"
             return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
 
         return jax.tree.map(put, batch)

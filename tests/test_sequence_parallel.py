@@ -1,0 +1,88 @@
+"""Ulysses-style sequence parallelism over the sp mesh axis (all-to-all
+context parallelism — the long-context strategy the task brief makes
+first-class; DeepSpeed-Ulysses design expressed as GSPMD shardings)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt import GPT, GPTConfig, lm_loss_fn
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def _cfg(sp: bool):
+    return GPTConfig(vocab_size=256, max_seq_len=64, num_layers=2,
+                     num_heads=4, d_model=64, d_ff=128, dtype=jnp.float32,
+                     param_dtype=jnp.float32, attention_impl="xla",
+                     sequence_parallel=sp)
+
+
+def _train(sp_degree: int, steps=4):
+    mesh_cfg = {"sp": sp_degree} if sp_degree > 1 else {}
+    cfg = _cfg(sp=sp_degree > 1)
+    model = GPT(cfg)
+    ids = np.random.default_rng(0).integers(0, 256, (8, 64)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=lm_loss_fn,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": mesh_cfg, "steps_per_print": 10000})
+    losses = []
+    for i in range(steps):
+        batch = {"input_ids": np.random.default_rng(100 + i).integers(
+            0, 256, (8, 64)).astype(np.int32)}
+        losses.append(float(jax.device_get(engine.train_batch(iter([batch])))))
+    return engine, losses
+
+
+def test_sp_matches_dp_numerics():
+    """dp4 x sp2 training must reproduce dp8 losses: sequence parallelism
+    is a layout, not a different computation."""
+    _, ref = _train(1)
+    _, sp = _train(2)
+    np.testing.assert_allclose(sp, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_sp_inserts_all_to_all():
+    """The compiled forward actually exchanges sequence<->head shards."""
+    shape = mesh_lib.MeshShape.infer(8, sp=2)
+    mesh_lib.set_global_mesh(mesh_lib.build_mesh(shape), shape)
+    cfg = _cfg(sp=True)
+    model = GPT(cfg)
+    ids = jnp.zeros((4, 64), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+
+    def fwd(p, x):
+        return lm_loss_fn(model.apply({"params": p}, x), {"input_ids": x})
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = mesh_lib.get_global_mesh()
+    xs = jax.device_put(ids, NamedSharding(mesh, P("dp", "sp")))
+    hlo = jax.jit(fwd).lower(params, xs).compile().as_text()
+    assert "all-to-all" in hlo, "Ulysses a2a missing from compiled program"
+
+
+def test_sp_activation_memory_is_sharded():
+    """Per-chip activation slices carry S/sp of the sequence."""
+    shape = mesh_lib.MeshShape.infer(8, sp=2)
+    mesh_lib.set_global_mesh(mesh_lib.build_mesh(shape), shape)
+    from deepspeed_tpu.models.gpt import sp_shard_sequence
+    mesh = mesh_lib.get_global_mesh()
+    x = jnp.zeros((4, 64, 32))
+    out = jax.jit(sp_shard_sequence)(x)
+    assert max(s.data.shape[1] for s in out.addressable_shards) == 32  # 64/2
+
+
+def test_sp_requires_divisible_heads():
+    # 4 heads / sp=2 = 2 heads per chip: fine. The constraint machinery
+    # itself no-ops on sp=1 meshes.
+    shape = mesh_lib.MeshShape.infer(8)
+    mesh_lib.set_global_mesh(mesh_lib.build_mesh(shape), shape)
+    from deepspeed_tpu.models.gpt import sp_shard_heads
+    x = jnp.zeros((2, 8, 4, 16))
+    out = sp_shard_heads(x)   # sp=1: unchanged, no constraint
+    assert out.shape == x.shape
