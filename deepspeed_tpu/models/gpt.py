@@ -75,6 +75,10 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     dropout: float = 0.0
     scan_layers: bool = True
+    # layers inlined per scan step: 1 = pure while-loop (smallest program,
+    # per-step loop overhead); num_layers = fully inlined (XLA schedules
+    # across layer boundaries). Param layout is unchanged either way.
+    scan_unroll: int = 1
     remat: bool = True
     # what remat may keep: "nothing" recomputes the whole block (max memory
     # savings, ~+33% compute); "dots_no_batch" keeps non-batch matmul outputs
@@ -419,6 +423,7 @@ class GPT(nn.Module):
                 in_axes=(nn.broadcast, nn.broadcast) + extra_axes,
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
+                unroll=cfg.scan_unroll,
             )
             x, aux = ScannedBlock(cfg, name="blocks")(
                 x, positions, deterministic, *extra_in)
