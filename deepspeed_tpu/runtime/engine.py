@@ -610,9 +610,10 @@ class DeepSpeedEngine:
 
     def _shard_batch(self, batch, stacked: bool = False):
         sp = dict(self.mesh.shape).get("sp", 1)
+        multiproc = jax.process_count() > 1
 
         def put(x):
-            x = jnp.asarray(x)
+            x = np.asarray(x) if multiproc else jnp.asarray(x)
             dim = 1 if stacked else 0
             spec = [None] * x.ndim
             if x.ndim > dim and x.shape[dim] % self.dp_world_size == 0:
@@ -621,7 +622,14 @@ class DeepSpeedEngine:
             # (models constrain activations the same way — Ulysses)
             if sp > 1 and x.ndim > dim + 1 and x.shape[dim + 1] % sp == 0:
                 spec[dim + 1] = "sp"
-            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+            sh = NamedSharding(self.mesh, P(*spec))
+            if multiproc:
+                # every process holds the SAME global batch (seeded loader);
+                # device_put of non-addressable shards is illegal multi-host,
+                # so each process contributes its addressable slices
+                return jax.make_array_from_process_local_data(
+                    sh, x, global_shape=x.shape)
+            return jax.device_put(x, sh)
 
         return jax.tree.map(put, batch)
 

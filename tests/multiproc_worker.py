@@ -1,0 +1,68 @@
+"""Worker body for the 2-process distributed test (reference
+tests/unit/common.py:67 distributed_test decorator: N forked processes
+stand in for a cluster). Launched by test_multiprocess.py with the
+LAUNCHER env contract (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID /
+LOCAL_RANK) — the same variables launcher/launch.py writes — so this also
+exercises comm.init_distributed's multi-process discovery path."""
+
+import json
+import os
+import sys
+
+
+def main():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.comm import comm as dist
+
+    # multi-process identity comes from the launcher env contract
+    dist.init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8
+
+    report = {"process": jax.process_index()}
+
+    # ---- eager facade collective across processes -----------------------
+    g = dist.new_group("dp")
+    x = jax.make_array_from_process_local_data(
+        jax.sharding.NamedSharding(g.mesh,
+                                   jax.sharding.PartitionSpec("dp")),
+        np.arange(8.0, dtype=np.float32).reshape(-1),
+        global_shape=(8,))
+    total = dist.all_reduce(x.reshape(8, 1), op="sum", group=g)
+    report["allreduce"] = float(jax.device_get(total.reshape(())))
+
+    # ---- engine training across 2 processes ------------------------------
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from simple_model import SimpleModel, mse_loss
+
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16)))["params"]
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=mse_loss,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 1,
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 10000})
+    losses = []
+    W = np.random.default_rng(0).normal(size=(16, 16)).astype(np.float32)
+    for i in range(4):
+        xb = np.random.default_rng(100 + i).normal(
+            size=(64, 16)).astype(np.float32)
+        batch = {"input_ids": xb, "labels": xb @ W}
+        losses.append(float(jax.device_get(
+            engine.train_batch(iter([batch])))))
+    report["losses"] = losses
+    print("REPORT " + json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()
