@@ -102,6 +102,10 @@ class GPTConfig:
     # context length scales with the sp degree at O(S/sp) activation
     # memory per chip. Requires num_heads % sp == 0.
     sequence_parallel: bool = False
+    # context-parallel attention flavor when sequence_parallel is on:
+    # "ulysses" (head-sharded all-to-all) or "ring" (KV shards rotate via
+    # ppermute — no head-count constraint; ops/ring_attention.py)
+    cp_impl: str = "ulysses"
     layer_norm_eps: float = 1e-5
     # attention-score scale; None -> 1/sqrt(head_dim). GPT-Neo uses 1.0.
     qk_scale: Any = None
@@ -233,20 +237,30 @@ class SelfAttention(nn.Module):
             out = self._decode_attention(q, k, v, positions)
         else:
             impl = cfg.attention_impl
-            if cfg.sequence_parallel:
-                # Ulysses: seq-sharded -> head-sharded (all-to-all); each
-                # chip attends over the FULL sequence for H/sp heads. The
-                # einsum path partitions over heads under GSPMD; the pallas
-                # custom call does not auto-partition, so force xla here
-                q, k, v = map(sp_shard_heads, (q, k, v))
-                if impl in ("auto", "pallas"):
-                    impl = "xla"
-            out = causal_attention(q, k, v, dtype=cfg.dtype,
-                                   impl=impl,
-                                   sparse_config=cfg.sparse_attention,
-                                   scale=cfg.qk_scale, window=self.window)
-            if cfg.sequence_parallel:
-                out = sp_shard_heads(out)
+            if cfg.sequence_parallel and cfg.cp_impl == "ring":
+                # KV shards rotate the sp ring; q stays sequence-sharded
+                from ..ops.ring_attention import ring_attention
+                from ..parallel import mesh as mesh_lib
+                scale = (cfg.qk_scale if cfg.qk_scale is not None
+                         else 1.0 / math.sqrt(cfg.head_dim))
+                out = ring_attention(q, k, v, mesh_lib.get_global_mesh(),
+                                     scale=scale, causal=True)
+            else:
+                if cfg.sequence_parallel:
+                    # Ulysses: seq-sharded -> head-sharded (all-to-all);
+                    # each chip attends over the FULL sequence for H/sp
+                    # heads. The einsum path partitions over heads under
+                    # GSPMD; the pallas custom call does not
+                    # auto-partition, so force xla here
+                    q, k, v = map(sp_shard_heads, (q, k, v))
+                    if impl in ("auto", "pallas"):
+                        impl = "xla"
+                out = causal_attention(q, k, v, dtype=cfg.dtype,
+                                       impl=impl,
+                                       sparse_config=cfg.sparse_attention,
+                                       scale=cfg.qk_scale, window=self.window)
+                if cfg.sequence_parallel:
+                    out = sp_shard_heads(out)
         out = out.reshape(b, s, cfg.d_model)
         if cfg.sequence_parallel and not decode:
             # back to sequence sharding for the projection/MLP/LN

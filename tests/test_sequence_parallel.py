@@ -86,3 +86,80 @@ def test_sp_requires_divisible_heads():
     x = jnp.zeros((2, 8, 4, 16))
     out = sp_shard_heads(x)   # sp=1: unchanged, no constraint
     assert out.shape == x.shape
+
+
+# ---------------------------------------------------------------- ring
+
+def test_ring_attention_matches_dense():
+    """Ring attention over sp=2 equals full causal attention exactly."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.ops.ring_attention import ring_attention
+    shape = mesh_lib.MeshShape.infer(8, sp=2)
+    mesh_lib.set_global_mesh(mesh_lib.build_mesh(shape), shape)
+    mesh = mesh_lib.get_global_mesh()
+    rng = np.random.default_rng(0)
+    b, s, h, d = 4, 32, 3, 16        # 3 heads: indivisible by sp -> ring only
+    q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+               for _ in range(3))
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+
+    # dense reference
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    mask = np.tril(np.ones((s, s), bool))
+    scores = np.where(mask[None, None], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_ring_attention_grads_flow():
+    from deepspeed_tpu.ops.ring_attention import ring_attention
+    shape = mesh_lib.MeshShape.infer(8, sp=2)
+    mesh_lib.set_global_mesh(mesh_lib.build_mesh(shape), shape)
+    mesh = mesh_lib.get_global_mesh()
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 16, 2, 8)), jnp.float32)
+               for _ in range(3))
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh) ** 2)
+
+    gq, gk, gv = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    # numerics vs the dense formulation's gradient
+    def dense_loss(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+        mask = jnp.tril(jnp.ones((16, 16), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bkhd->bqhd", p, v) ** 2)
+    rq, rk, rv = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=3e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=3e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=3e-4)
+
+
+def test_ring_gpt_matches_dp_numerics():
+    """GPT with cp_impl='ring' at dp4 x sp2 reproduces the dp8 run — even
+    with a head count (4) it shares with ulysses, ring needs no
+    divisibility; use 2 layers to cross residuals/LN."""
+    _, ref = _train(1)
+    import dataclasses
+    # monkey-free: build engine manually with ring config
+    mesh_cfg = {"sp": 2}
+    cfg = dataclasses.replace(_cfg(sp=True), cp_impl="ring")
+    model = GPT(cfg)
+    ids = np.random.default_rng(0).integers(0, 256, (8, 64)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=lm_loss_fn,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": mesh_cfg, "steps_per_print": 10000})
+    losses = []
+    for i in range(4):
+        batch = {"input_ids": np.random.default_rng(100 + i).integers(
+            0, 256, (8, 64)).astype(np.int32)}
+        losses.append(float(jax.device_get(engine.train_batch(iter([batch])))))
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
