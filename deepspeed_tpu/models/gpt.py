@@ -123,6 +123,15 @@ class GPTConfig:
     moe_aux_loss_coef: float = 0.01
     moe_use_residual: bool = False   # PR-MoE residual experts
 
+    def __post_init__(self):
+        if self.cp_impl not in ("ulysses", "ring"):
+            raise ValueError(
+                f"cp_impl must be 'ulysses' or 'ring', got {self.cp_impl!r}")
+        if self.attention_impl not in ("auto", "xla", "pallas", "sparse"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.decode_impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown decode_impl {self.decode_impl!r}")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.num_heads
@@ -238,6 +247,11 @@ class SelfAttention(nn.Module):
         else:
             impl = cfg.attention_impl
             if cfg.sequence_parallel and cfg.cp_impl == "ring":
+                if self.window is not None or cfg.sparse_attention is not None:
+                    raise NotImplementedError(
+                        "cp_impl='ring' computes full causal attention; "
+                        "local windows / sparse layouts are not ring-aware "
+                        "yet — use cp_impl='ulysses' for those configs")
                 # KV shards rotate the sp ring; q stays sequence-sharded
                 from ..ops.ring_attention import ring_attention
                 from ..parallel import mesh as mesh_lib

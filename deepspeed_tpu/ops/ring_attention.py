@@ -52,9 +52,8 @@ def _ring_local(q, k, v, *, axis_name, ring_size, scale, causal):
     q_pos = r * chunk + base
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
 
-    def step(carry, t):
-        kv, m, l, acc = carry
-        k_t, v_t = kv
+    def combine(state, t, k_t, v_t):
+        m, l, acc = state
         src = (r - t) % ring_size          # origin rank of the current kv
         k_pos = src * chunk + base
         bm, bl, bacc = _block_attend(q, k_t, v_t, q_pos, k_pos, scale,
@@ -65,17 +64,25 @@ def _ring_local(q, k, v, *, axis_name, ring_size, scale, causal):
         l = l * c_old + bl * c_new
         acc = acc * jnp.moveaxis(c_old, 1, -1)[..., None] \
             + bacc * jnp.moveaxis(c_new, 1, -1)[..., None]
-        # rotate kv to the next rank; compute above overlaps this transfer
+        return m_new, l, acc
+
+    def step(carry, t):
+        # rotate FIRST (steps 1..ring-1): the local block was consumed
+        # before the scan, and this layout never pays for a final rotation
+        # whose result would be discarded
+        kv, state = carry
         kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm),
-                          (k_t, v_t))
-        return (kv, m_new, l, acc), None
+                          kv)
+        state = combine(state, t, *kv)
+        return (kv, state), None
 
     b, sq, h, d = q.shape
-    m0 = jnp.full((b, h, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
-    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
-    (kv, m, l, acc), _ = jax.lax.scan(
-        step, ((k, v), m0, l0, acc0), jnp.arange(ring_size))
+    state0 = (jnp.full((b, h, sq), NEG_INF, jnp.float32),
+              jnp.zeros((b, h, sq), jnp.float32),
+              jnp.zeros((b, sq, h, d), jnp.float32))
+    state0 = combine(state0, 0, k, v)      # local block, no transfer
+    (_, (m, l, acc)), _ = jax.lax.scan(
+        step, ((k, v), state0), jnp.arange(1, ring_size))
     l_safe = jnp.where(l == 0, 1.0, l)
     out = acc / jnp.moveaxis(l_safe, 1, -1)[..., None]
     return out.astype(q.dtype)

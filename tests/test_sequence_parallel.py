@@ -19,9 +19,10 @@ def _cfg(sp: bool):
                      sequence_parallel=sp)
 
 
-def _train(sp_degree: int, steps=4):
+def _train(sp_degree: int, steps=4, cp_impl="ulysses"):
+    import dataclasses
     mesh_cfg = {"sp": sp_degree} if sp_degree > 1 else {}
-    cfg = _cfg(sp=sp_degree > 1)
+    cfg = dataclasses.replace(_cfg(sp=sp_degree > 1), cp_impl=cp_impl)
     model = GPT(cfg)
     ids = np.random.default_rng(0).integers(0, 256, (8, 64)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
@@ -92,7 +93,6 @@ def test_sp_requires_divisible_heads():
 
 def test_ring_attention_matches_dense():
     """Ring attention over sp=2 equals full causal attention exactly."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
     from deepspeed_tpu.ops.ring_attention import ring_attention
     shape = mesh_lib.MeshShape.infer(8, sp=2)
     mesh_lib.set_global_mesh(mesh_lib.build_mesh(shape), shape)
@@ -140,26 +140,23 @@ def test_ring_attention_grads_flow():
 
 
 def test_ring_gpt_matches_dp_numerics():
-    """GPT with cp_impl='ring' at dp4 x sp2 reproduces the dp8 run — even
-    with a head count (4) it shares with ulysses, ring needs no
-    divisibility; use 2 layers to cross residuals/LN."""
+    """GPT with cp_impl='ring' at dp4 x sp2 reproduces the dp8 run."""
     _, ref = _train(1)
-    import dataclasses
-    # monkey-free: build engine manually with ring config
-    mesh_cfg = {"sp": 2}
-    cfg = dataclasses.replace(_cfg(sp=True), cp_impl="ring")
-    model = GPT(cfg)
-    ids = np.random.default_rng(0).integers(0, 256, (8, 64)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
-    engine, *_ = ds.initialize(
-        model=model, model_parameters=params, loss_fn=lm_loss_fn,
-        config={"train_micro_batch_size_per_gpu": 8,
-                "gradient_accumulation_steps": 1,
-                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-                "mesh": mesh_cfg, "steps_per_print": 10000})
-    losses = []
-    for i in range(4):
-        batch = {"input_ids": np.random.default_rng(100 + i).integers(
-            0, 256, (8, 64)).astype(np.int32)}
-        losses.append(float(jax.device_get(engine.train_batch(iter([batch])))))
-    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-5)
+    _, ring = _train(2, cp_impl="ring")
+    np.testing.assert_allclose(ring, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_cp_impl_validated():
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="cp_impl"):
+        _cfg(sp=True).__class__(cp_impl="Ring")
+    from deepspeed_tpu.models.gpt import GPTConfig
+    with _pytest.raises(NotImplementedError, match="ring-aware"):
+        cfg = GPTConfig(vocab_size=64, max_seq_len=32, num_layers=2,
+                        num_heads=2, d_model=32, d_ff=64,
+                        dtype=jnp.float32, param_dtype=jnp.float32,
+                        sequence_parallel=True, cp_impl="ring",
+                        scan_layers=False,
+                        attn_windows=(8, None))
+        model = GPT(cfg)
+        model.init(jax.random.PRNGKey(0), jnp.zeros((2, 32), jnp.int32))
