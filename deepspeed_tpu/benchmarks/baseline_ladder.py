@@ -186,11 +186,42 @@ def rung_bert(quick: bool):
             "samples_per_sec": round(b / dt)}
 
 
+def rung_long_context(quick: bool):
+    """Sequence-length scaling on one chip: flash attention keeps memory
+    O(S) (no S^2 score matrix); with the sp mesh axis the same config
+    scales context by the ring/ulysses degree (tests/test_sequence_parallel)."""
+    import numpy as np
+    import jax, jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig, lm_loss_fn
+    seq = 4096 if quick else 16384
+    cfg = GPTConfig(vocab_size=8192, max_seq_len=seq, num_layers=4,
+                    num_heads=8, d_model=512, d_ff=2048,
+                    dtype=jnp.bfloat16, sequence_parallel=False)
+    model = GPT(cfg)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, seq)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:, :8])["params"]
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=lm_loss_fn,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 1},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "steps_per_print": 10_000})
+    toks, dt = _train_tput(engine, lambda: iter([{"input_ids": ids}]),
+                           seq, steps=3, warmup=2)
+    return {"config": f"long_context_seq{seq}", "tokens_per_sec": round(toks),
+            "step_ms": round(dt * 1e3, 1)}
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="baseline_ladder")
     parser.add_argument("--full", action="store_true")
     parser.add_argument("--rungs", nargs="+",
-                        default=["125m", "1.3b", "175b", "moe", "bert"])
+                        default=["125m", "1.3b", "175b", "moe", "bert",
+                                 "longctx"])
     args = parser.parse_args(argv)
     quick = not args.full
     rungs = {
@@ -199,6 +230,7 @@ def main(argv=None):
         "175b": rung_175b_fits,
         "moe": lambda: rung_moe(quick),
         "bert": lambda: rung_bert(quick),
+        "longctx": lambda: rung_long_context(quick),
     }
     results = []
     for name in args.rungs:
