@@ -229,6 +229,95 @@ class HFBertPolicy:
         return out
 
 
+def _export_gpt2(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of HFGPT2Policy.convert (the ``revert_transformer_layer``
+    analogue, replace_module.py:635): flax tree -> HF GPT-2 state dict
+    (Conv1D layout, so kernels pass through untransposed)."""
+    p = lambda x: np.asarray(x)
+    out = {"transformer.wte.weight": p(params["wte"]["embedding"]),
+           "transformer.wpe.weight": p(params["wpe"]),
+           "transformer.ln_f.weight": p(params["ln_f"]["scale"]),
+           "transformer.ln_f.bias": p(params["ln_f"]["bias"])}
+    b = params["blocks"]
+    n_layer = p(b["ln_1"]["scale"]).shape[0]
+    for i in range(n_layer):
+        pre = f"transformer.h.{i}."
+        out[pre + "ln_1.weight"] = p(b["ln_1"]["scale"])[i]
+        out[pre + "ln_1.bias"] = p(b["ln_1"]["bias"])[i]
+        out[pre + "ln_2.weight"] = p(b["ln_2"]["scale"])[i]
+        out[pre + "ln_2.bias"] = p(b["ln_2"]["bias"])[i]
+        out[pre + "attn.c_attn.weight"] = p(b["attn"]["qkv"]["kernel"])[i]
+        out[pre + "attn.c_attn.bias"] = p(b["attn"]["qkv"]["bias"])[i]
+        out[pre + "attn.c_proj.weight"] = p(b["attn"]["out_proj"]["kernel"])[i]
+        out[pre + "attn.c_proj.bias"] = p(b["attn"]["out_proj"]["bias"])[i]
+        out[pre + "mlp.c_fc.weight"] = p(b["mlp"]["up_proj"]["kernel"])[i]
+        out[pre + "mlp.c_fc.bias"] = p(b["mlp"]["up_proj"]["bias"])[i]
+        out[pre + "mlp.c_proj.weight"] = p(b["mlp"]["down_proj"]["kernel"])[i]
+        out[pre + "mlp.c_proj.bias"] = p(b["mlp"]["down_proj"]["bias"])[i]
+    out["lm_head.weight"] = out["transformer.wte.weight"]  # tied
+    return out
+
+
+def _export_bert(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of HFBertPolicy.convert: flax tree -> HF BERT state dict
+    (torch Linear [out, in] layout, fused qkv split back to q/k/v)."""
+    # standalone-BertModel key convention (no "bert." prefix — convert
+    # strips it either way)
+    p = lambda x: np.asarray(x)
+    out = {
+        "embeddings.word_embeddings.weight": p(params["wte"]["embedding"]),
+        "embeddings.position_embeddings.weight": p(params["wpe"]),
+        "embeddings.token_type_embeddings.weight":
+            p(params["wtt"]["embedding"]),
+        "embeddings.LayerNorm.weight": p(params["ln_emb"]["scale"]),
+        "embeddings.LayerNorm.bias": p(params["ln_emb"]["bias"]),
+    }
+    if "pooler" in params:
+        out["pooler.dense.weight"] = p(params["pooler"]["kernel"]).T
+        out["pooler.dense.bias"] = p(params["pooler"]["bias"])
+    b = params["blocks"]
+    n_layer = p(b["ln_attn"]["scale"]).shape[0]
+    d = p(b["attn"]["qkv"]["kernel"]).shape[1]
+    for i in range(n_layer):
+        pre = f"encoder.layer.{i}."
+        qkv_k = p(b["attn"]["qkv"]["kernel"])[i]        # [d, 3d]
+        qkv_b = p(b["attn"]["qkv"]["bias"])[i]
+        for j, name in enumerate(("query", "key", "value")):
+            out[pre + f"attention.self.{name}.weight"] = \
+                qkv_k[:, j * d:(j + 1) * d].T
+            out[pre + f"attention.self.{name}.bias"] = \
+                qkv_b[j * d:(j + 1) * d]
+        out[pre + "attention.output.dense.weight"] = \
+            p(b["attn"]["out_proj"]["kernel"])[i].T
+        out[pre + "attention.output.dense.bias"] = \
+            p(b["attn"]["out_proj"]["bias"])[i]
+        out[pre + "attention.output.LayerNorm.weight"] = \
+            p(b["ln_attn"]["scale"])[i]
+        out[pre + "attention.output.LayerNorm.bias"] = \
+            p(b["ln_attn"]["bias"])[i]
+        out[pre + "intermediate.dense.weight"] = p(b["up_proj"]["kernel"])[i].T
+        out[pre + "intermediate.dense.bias"] = p(b["up_proj"]["bias"])[i]
+        out[pre + "output.dense.weight"] = p(b["down_proj"]["kernel"])[i].T
+        out[pre + "output.dense.bias"] = p(b["down_proj"]["bias"])[i]
+        out[pre + "output.LayerNorm.weight"] = p(b["ln_ffn"]["scale"])[i]
+        out[pre + "output.LayerNorm.bias"] = p(b["ln_ffn"]["bias"])[i]
+    return out
+
+
+HFGPT2Policy.export = staticmethod(_export_gpt2)
+HFBertPolicy.export = staticmethod(_export_bert)
+
+
+def export_hf_state_dict(model_type: str, params: Dict[str, Any]
+                         ) -> Dict[str, Any]:
+    """Inverse injection: our param tree back to an HF state dict (numpy),
+    usable to hand a trained/tuned model back to the torch ecosystem."""
+    pol = policy_for(model_type)
+    if not hasattr(pol, "export"):
+        raise ValueError(f"no export path for {model_type!r}")
+    return pol.export(params)
+
+
 _POLICIES = {
     "gpt2": HFGPT2Policy,
     "gpt_neo": HFGPTNeoPolicy,

@@ -59,3 +59,46 @@ def should_log_le(max_log_level_str: str) -> bool:
     if target is None:
         raise ValueError(f"Invalid log level: {max_log_level_str}")
     return logger.getEffectiveLevel() <= target
+
+
+def see_memory_usage(message: str, force: bool = False, ranks=(0,)) -> dict:
+    """Device + host memory telemetry (reference runtime/utils.py
+    ``see_memory_usage``: CUDA allocated/reserved + psutil RSS; here per-
+    device HBM stats from the backend + host RSS/available). Returns the
+    numbers and logs them rank-filtered."""
+    import jax
+    report = {"devices": [], "host": {}}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        report["devices"].append({
+            "device": str(d),
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+        })
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        p = psutil.Process()
+        report["host"] = {"rss": p.memory_info().rss,
+                          "available": vm.available, "percent": vm.percent}
+    except ImportError:
+        try:
+            with open("/proc/self/status") as fh:
+                for line in fh:
+                    if line.startswith("VmRSS"):
+                        report["host"]["rss"] = \
+                            int(line.split()[1]) * 1024
+        except OSError:
+            pass
+    dev = report["devices"][0] if report["devices"] else {}
+    log_dist(
+        f"{message} | HBM {dev.get('bytes_in_use', 0)/2**30:.2f}/"
+        f"{dev.get('bytes_limit', 0)/2**30:.2f} GB "
+        f"(peak {dev.get('peak_bytes_in_use', 0)/2**30:.2f}) | host RSS "
+        f"{report['host'].get('rss', 0)/2**30:.2f} GB",
+        ranks=list(ranks))
+    return report

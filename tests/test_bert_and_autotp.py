@@ -212,3 +212,33 @@ def test_generate_with_fused_decode():
         outs[impl] = np.asarray(engine.generate(
             ids, max_new_tokens=6, temperature=0.0))
     np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+
+
+def test_export_roundtrip_bert():
+    """convert -> export reproduces the HF state dict exactly (the
+    revert_transformer_layer analogue)."""
+    import torch
+    from deepspeed_tpu.module_inject.policies import export_hf_state_dict
+    hf, hf_cfg = _tiny_hf_bert()
+    cfg, params = _convert(hf, hf_cfg)
+    back = export_hf_state_dict("bert", params)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k], err_msg=k)
+
+
+def test_export_roundtrip_gpt2():
+    import torch
+    from transformers import GPT2Config, GPT2LMHeadModel
+    from deepspeed_tpu.module_inject.policies import (HFGPT2Policy,
+                                                      export_hf_state_dict)
+    torch.manual_seed(0)
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)).eval()
+    params = HFGPT2Policy.convert(dict(hf.state_dict()), 2)
+    back = export_hf_state_dict("gpt2", params)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()
+          if "attn.bias" not in k and "masked_bias" not in k}
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k], err_msg=k)
