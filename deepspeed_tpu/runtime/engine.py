@@ -680,9 +680,21 @@ class DeepSpeedEngine:
         if self._jit_train is None:
             self._jit_train = self._build_train_jit()
 
+        # wall-clock breakdown (reference EngineTimers, engine.py:135-173):
+        # one jitted program means fwd/bwd/step aren't host-separable —
+        # the honest phases are host batch prep, async dispatch, and
+        # device execution (dispatch->sync)
+        wcb = self.config.wall_clock_breakdown
         self.tput_timer.start()
+        if wcb:
+            self.timers("train_batch_dispatch").start()
         self.state, metrics = self._jit_train(self.state, batches,
                                               self._forward_extras())
+        if wcb:
+            self.timers("train_batch_dispatch").stop()
+            self.timers("train_batch_device").start()
+            float(jax.device_get(metrics["loss"]))  # device_get IS the sync
+            self.timers("train_batch_device").stop()
         # sync only on report steps: a per-step block_until_ready would
         # serialize dispatch against the device and stall the pipeline
         will_report = (self.global_steps + 1) % self.steps_per_print() == 0
@@ -755,6 +767,9 @@ class DeepSpeedEngine:
     def _after_step(self, metrics):
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
+        if self.config.wall_clock_breakdown and \
+                self.global_steps % self.steps_per_print() == 0:
+            self.timers.log(["train_batch_dispatch", "train_batch_device"])
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps, metrics)
         if self.monitor.enabled and jax.process_index() == 0:

@@ -154,3 +154,18 @@ def test_missing_params_rejected():
     import deepspeed_tpu as ds
     with pytest.raises(ValueError):
         ds.initialize(model=SimpleModel(), config={"train_batch_size": 8})
+
+
+def test_wall_clock_breakdown():
+    """wall_clock_breakdown times the honest TPU phases (dispatch vs device
+    execution) — the reference EngineTimers analogue for a one-jit engine."""
+    from simple_model import make_engine, random_batch
+    engine = make_engine({"train_micro_batch_size_per_gpu": 8,
+                          "gradient_accumulation_steps": 1,
+                          "optimizer": {"type": "Adam",
+                                        "params": {"lr": 1e-3}},
+                          "wall_clock_breakdown": True,
+                          "steps_per_print": 1})
+    engine.train_batch(iter([random_batch(64)]))
+    assert engine.timers.has_timer("train_batch_dispatch")
+    assert engine.timers.has_timer("train_batch_device")
