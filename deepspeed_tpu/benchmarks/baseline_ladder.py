@@ -216,12 +216,46 @@ def rung_long_context(quick: bool):
             "step_ms": round(dt * 1e3, 1)}
 
 
+def rung_decode(quick: bool):
+    """Autoregressive decode throughput (reference weak-point: decode
+    tokens/s measured on chip): whole decode loop is one scan-jit."""
+    import numpy as np
+    import jax, jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig, gpt2_125m
+    if quick:
+        cfg = GPTConfig(vocab_size=8192, max_seq_len=512, num_layers=4,
+                        num_heads=8, d_model=512, d_ff=2048,
+                        dtype=jnp.bfloat16)
+    else:
+        cfg = gpt2_125m(max_seq_len=1024, dtype=jnp.bfloat16)
+    model = GPT(cfg)
+    b, prompt, new = 8, 32, 128
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (b, prompt)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(ids[:1, :8]))["params"]
+    engine = ds.init_inference(model, mp_size=1, dtype=jnp.bfloat16,
+                               model_parameters=params)
+    out = engine.generate(ids, max_new_tokens=new, temperature=0.0)
+    _sync(out)
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        out = engine.generate(ids, max_new_tokens=new, temperature=0.0)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    return {"config": "decode_throughput", "batch": b, "new_tokens": new,
+            "decode_tokens_per_sec": round(b * new / dt),
+            "ms_per_token": round(dt / new * 1e3, 2)}
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="baseline_ladder")
     parser.add_argument("--full", action="store_true")
     parser.add_argument("--rungs", nargs="+",
                         default=["125m", "1.3b", "175b", "moe", "bert",
-                                 "longctx"])
+                                 "longctx", "decode"])
     args = parser.parse_args(argv)
     quick = not args.full
     rungs = {
@@ -231,6 +265,7 @@ def main(argv=None):
         "moe": lambda: rung_moe(quick),
         "bert": lambda: rung_bert(quick),
         "longctx": lambda: rung_long_context(quick),
+        "decode": lambda: rung_decode(quick),
     }
     results = []
     for name in args.rungs:

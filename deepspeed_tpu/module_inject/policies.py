@@ -159,6 +159,80 @@ class HFGPTNeoPolicy:
         return out
 
 
+class HFGPTJPolicy:
+    """GPT-J (reference HFGPTJLayerPolicy, replace_policy.py:158): parallel
+    residual with ONE shared LayerNorm (mapped onto both ln_1/ln_2 — same
+    math), separate bias-free q/k/v Linears fused into qkv, GPT-J-style
+    interleaved rotary over ``rotary_dim`` (our rotary_embedding's native
+    convention), untied lm_head."""
+
+    @staticmethod
+    def config_from_hf(hf_config) -> GPTConfig:
+        import jax.numpy as jnp
+        head_dim = hf_config.n_embd // hf_config.n_head
+        return GPTConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.n_positions,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            d_model=hf_config.n_embd,
+            d_ff=hf_config.n_inner or 4 * hf_config.n_embd,
+            rotary=True, rotary_pct=hf_config.rotary_dim / head_dim,
+            parallel_residual=True, tie_embeddings=False,
+            dtype=jnp.float32, param_dtype=jnp.float32,
+            scan_layers=True, remat=False)
+
+    @staticmethod
+    def convert(state_dict: Dict[str, Any], n_layer: int) -> Dict[str, Any]:
+        sd = {k.removeprefix("transformer."): v
+              for k, v in state_dict.items()}
+        d = _np(sd["h.0.attn.q_proj.weight"]).shape[1]
+
+        def qkv_kernel(i):
+            return np.concatenate(
+                [_np(sd[f"h.{i}.attn.{n}_proj.weight"]).T
+                 for n in ("q", "k", "v")], axis=1)
+
+        shared_ln = {"scale": _stack(sd, "h.{}.ln_1.weight", n_layer),
+                     "bias": _stack(sd, "h.{}.ln_1.bias", n_layer)}
+        blocks = {
+            "ln_1": shared_ln,
+            "ln_2": {k: v.copy() for k, v in shared_ln.items()},
+            "attn": {
+                "qkv": {"kernel": np.stack([qkv_kernel(i)
+                                            for i in range(n_layer)]),
+                        "bias": np.zeros((n_layer, 3 * d), np.float32)},
+                "out_proj": {
+                    "kernel": _stack(sd, "h.{}.attn.out_proj.weight",
+                                     n_layer, transform=lambda m: m.T),
+                    "bias": np.zeros((n_layer, d), np.float32)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": _stack(sd, "h.{}.mlp.fc_in.weight",
+                                             n_layer,
+                                             transform=lambda m: m.T),
+                            "bias": _stack(sd, "h.{}.mlp.fc_in.bias",
+                                           n_layer)},
+                "down_proj": {"kernel": _stack(sd, "h.{}.mlp.fc_out.weight",
+                                               n_layer,
+                                               transform=lambda m: m.T),
+                              "bias": _stack(sd, "h.{}.mlp.fc_out.bias",
+                                             n_layer)},
+            },
+        }
+        out = {
+            "wte": {"embedding": _np(sd["wte.weight"])},
+            "blocks": blocks,
+            "ln_f": {"scale": _np(sd["ln_f.weight"]),
+                     "bias": _np(sd["ln_f.bias"])},
+        }
+        if "lm_head.weight" in sd:
+            out["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+        else:  # headless GPTJModel: fall back to the embedding (tied)
+            out["lm_head"] = {"kernel": _np(sd["wte.weight"]).T}
+        return out
+
+
 class HFBertPolicy:
     """BERT (reference HFBertLayerPolicy, replace_policy.py:50): torch
     Linear [out, in] -> transpose; q/k/v concatenated into the fused qkv;
@@ -321,6 +395,7 @@ def export_hf_state_dict(model_type: str, params: Dict[str, Any]
 _POLICIES = {
     "gpt2": HFGPT2Policy,
     "gpt_neo": HFGPTNeoPolicy,
+    "gptj": HFGPTJPolicy,
     "bert": HFBertPolicy,
 }
 

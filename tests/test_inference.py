@@ -276,3 +276,29 @@ def test_hf_gpt2_generate_through_engine():
             torch.tensor(ids.astype(np.int64)), max_new_tokens=5,
             do_sample=False, pad_token_id=0).numpy()
     np.testing.assert_array_equal(ours, theirs)
+
+
+def test_gptj_logit_parity():
+    """GPT-J policy (reference HFGPTJLayerPolicy, replace_policy.py:158):
+    shared-LN parallel residual + interleaved partial rotary convert to
+    exact logit parity."""
+    import torch
+    from transformers import GPTJConfig, GPTJForCausalLM
+    from deepspeed_tpu.models.gpt import GPT
+    from deepspeed_tpu.module_inject.policies import HFGPTJPolicy
+
+    hf_cfg = GPTJConfig(vocab_size=128, n_positions=64, n_embd=64,
+                        n_layer=2, n_head=4, rotary_dim=16,
+                        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = GPTJForCausalLM(hf_cfg).eval()
+    with torch.no_grad():
+        hf.lm_head.bias.zero_()   # our untied head is bias-free
+    cfg = HFGPTJPolicy.config_from_hf(hf_cfg)
+    params = HFGPTJPolicy.convert(dict(hf.state_dict()), cfg.num_layers)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = GPT(cfg).apply({"params": jax.tree.map(jnp.asarray, params)},
+                          jnp.asarray(ids))
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids.astype(np.int64))).logits.numpy()
+    assert np.abs(np.asarray(ours) - ref).max() < 2e-5
