@@ -233,6 +233,73 @@ class HFGPTJPolicy:
         return out
 
 
+class MegatronGPTPolicy:
+    """Megatron-LM GPT checkpoints (reference MegatronLayerPolicy,
+    replace_policy.py:203 + MegatronSDLoader key vocabulary,
+    state_dict_factory.py:195): input/post_attention layernorms map to
+    ln_1/ln_2 of the sequential-residual block; the fused
+    ``query_key_value`` is PER-HEAD interleaved [np, 3, hn] in checkpoint
+    version >= 1.0 and block-ordered [3, np*hn] in version 0 — both are
+    regrouped to our [Q | K | V] column order. Per-mp-rank checkpoint sets
+    go through checkpoint/state_dict_factory.py first."""
+
+    @staticmethod
+    def _regroup_qkv(w: np.ndarray, num_heads: int, version: float):
+        """[3h(, h)] megatron row order -> [3h(, h)] with q|k|v blocks."""
+        three_h = w.shape[0]
+        hn = three_h // 3 // num_heads
+        if version == 0:
+            return w                        # already [q|k|v] blocks
+        parts = w.reshape(num_heads, 3, hn, *w.shape[1:])
+        return np.concatenate(
+            [parts[:, j].reshape(num_heads * hn, *w.shape[1:])
+             for j in range(3)], axis=0)
+
+    @staticmethod
+    def convert(state_dict: Dict[str, Any], n_layer: int, *,
+                num_heads: int, version: float = 2.0) -> Dict[str, Any]:
+        sd = {k.removeprefix("model.").removeprefix("language_model."): v
+              for k, v in state_dict.items()}
+        pre = "transformer.layers.{}."
+        rq = MegatronGPTPolicy._regroup_qkv
+
+        def lin(fmt):
+            return (_stack(sd, fmt + ".weight", n_layer,
+                           transform=lambda m: m.T),
+                    _stack(sd, fmt + ".bias", n_layer))
+
+        qk = np.stack([rq(_np(sd[pre.format(i) +
+                                 "attention.query_key_value.weight"]),
+                          num_heads, version).T for i in range(n_layer)])
+        qb = np.stack([rq(_np(sd[pre.format(i) +
+                                 "attention.query_key_value.bias"]),
+                          num_heads, version) for i in range(n_layer)])
+        ok, ob = lin(pre + "attention.dense")
+        uk, ub = lin(pre + "mlp.dense_h_to_4h")
+        dk, db = lin(pre + "mlp.dense_4h_to_h")
+        blocks = {
+            "ln_1": {"scale": _stack(sd, pre + "input_layernorm.weight",
+                                     n_layer),
+                     "bias": _stack(sd, pre + "input_layernorm.bias",
+                                    n_layer)},
+            "ln_2": {"scale": _stack(
+                sd, pre + "post_attention_layernorm.weight", n_layer),
+                "bias": _stack(
+                    sd, pre + "post_attention_layernorm.bias", n_layer)},
+            "attn": {"qkv": {"kernel": qk, "bias": qb},
+                     "out_proj": {"kernel": ok, "bias": ob}},
+            "mlp": {"up_proj": {"kernel": uk, "bias": ub},
+                    "down_proj": {"kernel": dk, "bias": db}},
+        }
+        return {
+            "wte": {"embedding": _np(sd["word_embeddings.weight"])},
+            "wpe": _np(sd["position_embeddings.weight"]),
+            "blocks": blocks,
+            "ln_f": {"scale": _np(sd["transformer.final_layernorm.weight"]),
+                     "bias": _np(sd["transformer.final_layernorm.bias"])},
+        }
+
+
 class HFBertPolicy:
     """BERT (reference HFBertLayerPolicy, replace_policy.py:50): torch
     Linear [out, in] -> transpose; q/k/v concatenated into the fused qkv;
@@ -397,6 +464,7 @@ _POLICIES = {
     "gpt_neo": HFGPTNeoPolicy,
     "gptj": HFGPTJPolicy,
     "bert": HFBertPolicy,
+    "megatron": MegatronGPTPolicy,
 }
 
 
