@@ -196,6 +196,7 @@ class Autotuner:
         log_dist(f"autotuner: {len(exps)} experiments", ranks=[0])
         os.makedirs(self.results_dir, exist_ok=True)
         plateau: Dict[str, int] = {}
+        best_in_group: Dict[str, float] = {}
         stopped: set = set()
         for exp in exps:
             if exp.group in stopped:
@@ -218,6 +219,12 @@ class Autotuner:
                 if self.best is None or self._better(exp.metric_val,
                                                      self.best.metric_val):
                     self.best = exp
+                # plateau is judged against this (stage, mesh) group's OWN
+                # best — a family whose first points trail another group's
+                # global best may still be climbing toward its knee
+                gb = best_in_group.get(exp.group)
+                if gb is None or self._better(exp.metric_val, gb):
+                    best_in_group[exp.group] = exp.metric_val
                     plateau[exp.group] = 0
                 else:
                     plateau[exp.group] = plateau.get(exp.group, 0) + 1

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
@@ -103,8 +104,26 @@ def save_sharded_tree(path: str, tree) -> None:
     per-dp-rank shard files (engine.py:3076) without a full gather."""
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), tree)
-    ckptr.wait_until_finished()
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        # Re-save under an existing tag (npz-path overwrite semantics), but
+        # crash-safe: the new checkpoint is fully written BEFORE the old one
+        # is touched, so a preemption mid-save never leaves the tag empty.
+        staging = path + ".staging"
+        if os.path.exists(staging):
+            shutil.rmtree(staging)
+        ckptr.save(staging, tree)
+        ckptr.wait_until_finished()
+        if jax.process_index() == 0:    # one process swaps the directories
+            retired = path + ".retired"
+            if os.path.exists(retired):
+                shutil.rmtree(retired)
+            os.rename(path, retired)
+            os.rename(staging, path)
+            shutil.rmtree(retired)
+    else:
+        ckptr.save(path, tree)
+        ckptr.wait_until_finished()
 
 
 def load_sharded_tree(path: str, template, shardings=None):

@@ -295,7 +295,7 @@ def send(x, dst: int, src: Optional[int] = None,
     pass it explicitly for a single directed edge. Composes with ``recv``
     as one ppermute under the hood (on TPU a directed pair IS a permute)."""
     if src is None:
-        src = (dst - 1) % _default_group(group).size if group else 0
+        src = (dst - 1) % _default_group(group).size
     return ppermute(x, [(src, dst)], group=group)
 
 

@@ -116,11 +116,20 @@ class InferenceEngine:
     def forward(self, input_ids, **kwargs):
         """Plain (non-incremental) forward — jit-cached per shape, the
         CUDA-graph replay analogue. Extra model inputs (attention_mask,
-        token_type_ids, ...) ride as traced kwargs."""
+        token_type_ids, ...) ride as traced kwargs.
+
+        Output contract: a `(logits, scalar)` pair (MoE aux loss) is
+        unwrapped to bare logits — inference callers never consume the
+        training-only aux loss. Genuine multi-head outputs (e.g. BERT's
+        sequence + pooled pair, both non-scalar) pass through as tuples."""
         if self._jit_forward is None:
             def f(params, ids, kw):
-                return self.module.apply(
+                out = self.module.apply(
                     {"params": self._materialize(params)}, ids, **kw)
+                if (isinstance(out, tuple) and len(out) == 2
+                        and jnp.ndim(out[1]) == 0):
+                    out = out[0]
+                return out
             self._jit_forward = jax.jit(f)
         kw = {k: jnp.asarray(v) for k, v in kwargs.items()
               if v is not None}

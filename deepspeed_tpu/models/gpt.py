@@ -32,18 +32,39 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..utils.logging import logger
+
+
+_sp_drop_warned = set()
+
 
 def _sp_constraint(x, spec_parts):
     """Ulysses sharding constraint against the global mesh (no-op when the
     mesh's sp axis is 1). Axes the shape doesn't divide are dropped —
-    e.g. the size-1 sample batch used for init."""
+    silently for the size-1 sample batch used at init (the sp axis on dim 0),
+    with a warning otherwise, because a dropped sp axis means attention
+    quietly degrades to seq-sharded GSPMD (no all-to-all — a different
+    comm/memory profile than true Ulysses)."""
     from ..parallel import mesh as mesh_lib
     mesh = mesh_lib.get_global_mesh()
     shape = dict(mesh.shape)
     if shape.get("sp", 1) == 1:
         return x
-    parts = [a if (a is None or x.shape[i] % shape.get(a, 1) == 0) else None
-             for i, a in enumerate(spec_parts)]
+    parts = []
+    for i, a in enumerate(spec_parts):
+        if a is not None and x.shape[i] % shape.get(a, 1) != 0:
+            key = (i, a, x.shape[i], shape.get(a, 1))
+            if a == "sp" and x.shape[0] > 1 and key not in _sp_drop_warned:
+                _sp_drop_warned.add(key)
+                logger.warning(
+                    f"sequence-parallel sharding dropped: dim {i} of a "
+                    f"{x.shape} tensor is not divisible by sp="
+                    f"{shape.get(a, 1)} — Ulysses needs num_heads % sp == 0 "
+                    f"(and seq % sp == 0); falling back to a replicated "
+                    f"axis for this tensor")
+            parts.append(None)
+        else:
+            parts.append(a)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, P(*parts)))
 

@@ -29,6 +29,29 @@ import numpy as np
 LAYOUT_SEED = 0x5EED
 
 
+def _check_attention_mode(attention: str) -> None:
+    if attention not in ("unidirectional", "bidirectional"):
+        raise ValueError(
+            f"attention must be 'unidirectional' or 'bidirectional', "
+            f"got {attention!r}")
+
+
+def _check_global_ranges(starts: List[int], ends: Optional[List[int]]) -> None:
+    """Validate paired [start, end) global-block ranges."""
+    if ends is None:
+        return
+    if len(starts) != len(ends):
+        raise ValueError(
+            f"global_block_indices has {len(starts)} entries but "
+            f"global_block_end_indices has {len(ends)} — they pair up "
+            f"as [start, end) ranges")
+    for s, e in zip(starts, ends):
+        if s >= e:
+            raise ValueError(
+                f"empty global range: start {s} >= end {e}")
+
+
+
 class SparsityConfig:
     """Base: block size + per-head layout bookkeeping (reference :9-61)."""
 
@@ -42,8 +65,9 @@ class SparsityConfig:
     def setup_layout(self, seq_len: int) -> np.ndarray:
         if seq_len % self.block != 0:
             raise ValueError(
-                f"Sequence Length, {seq_len}, needs to be dividable by "
-                f"Block size {self.block}!")
+                f"seq_len={seq_len} is not a multiple of the layout block "
+                f"size ({self.block}); pad the sequence first "
+                f"(SparseAttentionUtils.pad_to_block_size)")
         num_blocks = seq_len // self.block
         return np.zeros((self.num_heads, num_blocks, num_blocks), np.int64)
 
@@ -62,9 +86,8 @@ class SparsityConfig:
         num_blocks = layout.shape[1]
         if num_blocks < num_window_blocks:
             raise ValueError(
-                f"Number of sliding window blocks, {num_window_blocks}, "
-                f"must be smaller than overall number of blocks in a row, "
-                f"{num_blocks}!")
+                f"sliding window spans {num_window_blocks} blocks but the "
+                f"sequence only has {num_blocks} blocks per row")
         w = num_window_blocks // 2
         for row in range(num_blocks):
             layout[h, row, max(0, row - w):min(row + w + 1, num_blocks)] = 1
@@ -75,9 +98,8 @@ class SparsityConfig:
         num_blocks = layout.shape[1]
         if num_blocks < num_random_blocks:
             raise ValueError(
-                f"Number of random blocks, {num_random_blocks}, must be "
-                f"smaller than overall number of blocks in a row, "
-                f"{num_blocks}!")
+                f"cannot place {num_random_blocks} random blocks in a row "
+                f"of only {num_blocks} blocks")
         rng = np.random.default_rng(LAYOUT_SEED + h)
         for row in range(num_blocks):
             hi = row + 1 if unidirectional else num_blocks
@@ -109,30 +131,28 @@ class FixedSparsityConfig(SparsityConfig):
         self.num_local_blocks = num_local_blocks
         if num_local_blocks % num_global_blocks != 0:
             raise ValueError(
-                f"Number of blocks in a local window, {num_local_blocks}, "
-                f"must be dividable by number of global blocks, "
-                f"{num_global_blocks}!")
+                f"num_local_blocks ({num_local_blocks}) must be a multiple "
+                f"of num_global_blocks ({num_global_blocks}) so global "
+                f"stripes tile the local windows evenly")
         self.num_global_blocks = num_global_blocks
-        if attention not in ("unidirectional", "bidirectional"):
-            raise NotImplementedError(
-                'only "uni/bi-directional" attentions are supported for now!')
+        _check_attention_mode(attention)
         self.attention = attention
         if attention != "bidirectional" and horizontal_global_attention:
             raise ValueError(
-                'only "bi-directional" attentions can support horizontal '
-                'global attention!')
+                "horizontal_global_attention writes full rows and is only "
+                "meaningful for attention='bidirectional'")
         self.horizontal_global_attention = horizontal_global_attention
         if num_different_global_patterns > 1 and not different_layout_per_head:
             raise ValueError(
-                "Number of different layouts cannot be more than one when "
-                "you have set a single layout for all heads! Set "
-                "different_layout_per_head to True.")
+                "num_different_global_patterns > 1 requires "
+                "different_layout_per_head=True (otherwise every head "
+                "shares one layout and the variants are unreachable)")
         if num_different_global_patterns > num_local_blocks // num_global_blocks:
             raise ValueError(
-                f"Number of layout versions (num_different_global_patterns), "
-                f"{num_different_global_patterns}, cannot be larger than "
-                f"number of local window blocks divided by number of global "
-                f"blocks, {num_local_blocks // num_global_blocks}!")
+                f"num_different_global_patterns="
+                f"{num_different_global_patterns} exceeds the distinct "
+                f"global-stripe offsets available per local window "
+                f"({num_local_blocks // num_global_blocks})")
         self.num_different_global_patterns = num_different_global_patterns
 
     def set_local_layout(self, h: int, layout: np.ndarray):
@@ -189,27 +209,15 @@ class VariableSparsityConfig(SparsityConfig):
         self.global_block_indices = (global_block_indices
                                      if global_block_indices is not None
                                      else [0])
-        if global_block_end_indices is not None:
-            if len(self.global_block_indices) != len(global_block_end_indices):
-                raise ValueError(
-                    f"Global block start indices length, "
-                    f"{len(self.global_block_indices)}, must be same as "
-                    f"global block end indices length, "
-                    f"{len(global_block_end_indices)}!")
-            for s, e in zip(self.global_block_indices, global_block_end_indices):
-                if s >= e:
-                    raise ValueError(
-                        f"Global block start index, {s}, must be smaller "
-                        f"than global block end index, {e}!")
+        _check_global_ranges(self.global_block_indices,
+                             global_block_end_indices)
         self.global_block_end_indices = global_block_end_indices
-        if attention not in ("unidirectional", "bidirectional"):
-            raise NotImplementedError(
-                'only "uni/bi-directional" attentions are supported for now!')
+        _check_attention_mode(attention)
         self.attention = attention
         if attention != "bidirectional" and horizontal_global_attention:
             raise ValueError(
-                'only "bi-directional" attentions can support horizontal '
-                'global attention!')
+                "horizontal_global_attention writes full rows and is only "
+                "meaningful for attention='bidirectional'")
         self.horizontal_global_attention = horizontal_global_attention
 
     def set_random_layout(self, h: int, layout: np.ndarray):
@@ -276,9 +284,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_random_blocks = num_random_blocks
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
-        if attention not in ("unidirectional", "bidirectional"):
-            raise NotImplementedError(
-                'only "uni/bi-directional" attentions are supported for now!')
+        _check_attention_mode(attention)
         self.attention = attention
 
     def set_random_layout(self, h: int, layout: np.ndarray):
@@ -294,9 +300,8 @@ class BigBirdSparsityConfig(SparsityConfig):
         num_blocks = layout.shape[1]
         if num_blocks < self.num_global_blocks:
             raise ValueError(
-                f"Number of global blocks, {self.num_global_blocks}, must "
-                f"be smaller than overall number of blocks in a row, "
-                f"{num_blocks}!")
+                f"num_global_blocks ({self.num_global_blocks}) exceeds the "
+                f"{num_blocks} blocks in a row")
         layout[h, 0:self.num_global_blocks, :] = 1
         layout[h, :, 0:self.num_global_blocks] = 1
         if self.attention == "unidirectional":
@@ -327,19 +332,10 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.global_block_indices = (global_block_indices
                                      if global_block_indices is not None
                                      else [0])
+        _check_attention_mode(attention)
         self.attention = attention
-        if global_block_end_indices is not None:
-            if len(self.global_block_indices) != len(global_block_end_indices):
-                raise ValueError(
-                    f"Global block start indices length, "
-                    f"{len(self.global_block_indices)}, must be same as "
-                    f"global block end indices length, "
-                    f"{len(global_block_end_indices)}!")
-            for s, e in zip(self.global_block_indices, global_block_end_indices):
-                if s >= e:
-                    raise ValueError(
-                        f"Global block start index, {s}, must be smaller "
-                        f"than global block end index, {e}!")
+        _check_global_ranges(self.global_block_indices,
+                             global_block_end_indices)
         self.global_block_end_indices = global_block_end_indices
 
     def set_sliding_window_layout(self, h: int, layout: np.ndarray):

@@ -1,0 +1,12 @@
+"""Namespace parity with the reference's ``deepspeed/ops/transformer``
+kernel package — on TPU the fused transformer building blocks are the
+Pallas kernels plus the fused cross-entropy; XLA fuses the rest of the
+block body, so there is no monolithic "DeepSpeedTransformerLayer" here.
+"""
+
+from ..pallas import (bias_gelu, flash_attention, fused_softmax, gelu,
+                      layer_norm, masked_softmax)
+from ..pallas.decode_attention import decode_attention
+
+__all__ = ["flash_attention", "decode_attention", "layer_norm",
+           "fused_softmax", "masked_softmax", "bias_gelu", "gelu"]
