@@ -80,6 +80,41 @@ def sp_shard_heads(x):
     return _sp_constraint(x, ("dp", None, "sp", None))
 
 
+_pa_drop_warned = set()
+
+
+def tp_shard_sequence(x):
+    """Megatron-style partitioned activations: the residual stream is
+    sequence-sharded over ``tp`` (in addition to dp/sp) at block boundaries,
+    so remat-saved activations cost 1/tp the HBM per chip and LN/residual
+    math runs sequence-parallel — GSPMD turns the out-projection's psum into
+    a reduce-scatter and inserts the all-gather before qkv (the declarative
+    form of reference activation partitioning,
+    runtime/activation_checkpointing/checkpointing.py:493). No-op when the
+    mesh has no tp axis (nothing to partition across, as in the reference
+    with mp=1)."""
+    from ..parallel import mesh as mesh_lib
+    mesh = mesh_lib.get_global_mesh()
+    shape = dict(mesh.shape)
+    tp = shape.get("tp", 1)
+    if tp <= 1 or x.ndim < 3:
+        return x
+    sp = shape.get("sp", 1)
+    seq_axes = ("sp", "tp") if sp > 1 else ("tp",)
+    div = tp * sp
+    if x.shape[1] % div != 0:
+        key = (x.shape, div)
+        if x.shape[1] > 1 and key not in _pa_drop_warned:
+            _pa_drop_warned.add(key)
+            logger.warning(
+                f"partition_activations dropped: seq dim {x.shape[1]} of a "
+                f"{x.shape} tensor is not divisible by tp*sp={div}; "
+                f"activations stay replicated over tp for this shape")
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("dp", seq_axes, None)))
+
+
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
     vocab_size: int = 50304          # pad to a multiple of 128 for the MXU
@@ -106,6 +141,16 @@ class GPTConfig:
     # (skips recomputing GEMMs — the XLA analogue of the reference's
     # checkpointing trade, runtime/activation_checkpointing/checkpointing.py)
     remat_policy: str = "dots_no_batch"   # nothing | dots | dots_no_batch
+    # Partitioned activations (reference activation_checkpointing config
+    # "partition_activations", checkpointing.py:493): shard the residual
+    # stream's sequence dim over tp at block boundaries, cutting remat-saved
+    # activation HBM per chip by 1/tp. See tp_shard_sequence.
+    partition_activations: bool = False
+    # CPU checkpointing (reference checkpointing.py:122): remat saves only
+    # the per-layer block inputs and offloads them to host memory
+    # (pinned_host); everything else recomputes in backward. Activation HBM
+    # becomes O(one layer) regardless of depth. Requires remat=True.
+    cpu_checkpointing: bool = False
     # "auto" resolves to the Pallas flash kernel on TPU (measured ~1.6x
     # train-step speedup over the einsum path at seq 1024 on v5e) and to the
     # XLA einsum elsewhere (partition-friendly on the virtual CPU mesh)
@@ -145,6 +190,10 @@ class GPTConfig:
     moe_use_residual: bool = False   # PR-MoE residual experts
 
     def __post_init__(self):
+        if self.cpu_checkpointing and not self.remat:
+            raise ValueError(
+                "cpu_checkpointing offloads remat-saved block inputs to "
+                "host memory, so it requires remat=True")
         if self.cp_impl not in ("ulysses", "ring"):
             raise ValueError(
                 f"cp_impl must be 'ulysses' or 'ring', got {self.cp_impl!r}")
@@ -385,6 +434,11 @@ class Block(nn.Module):
     def __call__(self, x, positions, deterministic=True, layer_frac=None,
                  pld_theta=None):
         cfg = self.cfg
+        if cfg.partition_activations and x.ndim == 3:
+            x = tp_shard_sequence(x)
+        if cfg.cpu_checkpointing and x.ndim == 3:
+            from jax.ad_checkpoint import checkpoint_name
+            x = checkpoint_name(x, "ds_block_carry")
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                            param_dtype=cfg.param_dtype, name="ln_1")
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
@@ -441,12 +495,20 @@ class GPT(nn.Module):
 
         block = Block
         if cfg.remat:
-            policy = {
-                "nothing": jax.checkpoint_policies.nothing_saveable,
-                "dots": jax.checkpoint_policies.dots_saveable,
-                "dots_no_batch":
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }[cfg.remat_policy]
+            if cfg.cpu_checkpointing:
+                # save nothing on device; the named block inputs offload to
+                # pinned host memory and stream back for backward
+                policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                    names_which_can_be_saved=[],
+                    names_which_can_be_offloaded=["ds_block_carry"],
+                    offload_src="device", offload_dst="pinned_host")
+            else:
+                policy = {
+                    "nothing": jax.checkpoint_policies.nothing_saveable,
+                    "dots": jax.checkpoint_policies.dots_saveable,
+                    "dots_no_batch":
+                        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                }[cfg.remat_policy]
             # deterministic stays STATIC through remat: MoE gating and
             # dropout branch on it in Python (tracing it breaks, and a
             # traced train/eval flag would bake both branches anyway)

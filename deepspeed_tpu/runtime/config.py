@@ -37,6 +37,10 @@ def _take(d: Dict[str, Any], cls, aliases: Dict[str, str] = None):
             raise DeepSpeedConfigError(
                 f"{cls.__name__}: unknown config key {k!r} "
                 f"(valid: {sorted(names)})")
+        if k2 in kwargs:
+            raise DeepSpeedConfigError(
+                f"{cls.__name__}: {k!r} duplicates a key already given "
+                f"under another spelling ({k2!r}); set it once")
         kwargs[k2] = v
     return cls(**kwargs)
 
@@ -308,6 +312,17 @@ class SchedulerConfig:
     params: Dict[str, Any] = field(default_factory=dict)
 
 
+# Reference JSON spells the stage-3 working-set knobs with a "stage3_"
+# prefix (zero/config.py:14-197); accept both spellings.
+_ZERO_KEY_ALIASES = {
+    "stage3_prefetch_bucket_size": "prefetch_bucket_size",
+    "stage3_param_persistence_threshold": "param_persistence_threshold",
+    "stage3_max_live_parameters": "max_live_parameters",
+    "stage3_max_reuse_distance": "max_reuse_distance",
+    "stage3_gather_16bit_weights_on_model_save":
+        "gather_16bit_weights_on_model_save",
+}
+
 _SUBCONFIG_KEYS = {
     "fp16": ("fp16", FP16Config),
     "bf16": ("bf16", BF16Config),
@@ -429,7 +444,8 @@ class DeepSpeedConfig:
                 attr, cls = _SUBCONFIG_KEYS[key]
                 if not isinstance(value, dict):
                     raise DeepSpeedConfigError(f"{key} must be an object")
-                setattr(self, attr, _take(value, cls))
+                aliases = _ZERO_KEY_ALIASES if key == "zero_optimization" else None
+                setattr(self, attr, _take(value, cls, aliases))
             elif key in _SCALAR_KEYS:
                 setattr(self, _SCALAR_KEYS[key], value)
             elif key.startswith("#") or key.startswith("_comment"):

@@ -104,7 +104,7 @@ class DeepSpeedEngine:
             dp_world_size=self.dp_world_size)
         self._config = self.config  # reference-name parity
 
-        self.module = model
+        self.module = self._apply_activation_checkpointing_config(model)
         self.loss_fn = loss_fn
         self.collate_fn = collate_fn
         self.mpu = mpu
@@ -187,7 +187,11 @@ class DeepSpeedEngine:
 
         # ---- ZeRO sharding rules ------------------------------------------
         self.zero_stage = self.config.zero_optimization_stage
-        self.rules = ShardingRules(self.mesh, self.zero_stage)
+        self.rules = ShardingRules(
+            self.mesh, self.zero_stage,
+            param_persistence_threshold=(
+                self.config.zero_config.param_persistence_threshold
+                if self.zero_stage >= 3 else 0))
 
         # ---- ZeRO-Offload / Infinity --------------------------------------
         zc = self.config.zero_config
@@ -238,6 +242,62 @@ class DeepSpeedEngine:
             ranks=[0])
 
     # ------------------------------------------------------------------ init
+    def _apply_activation_checkpointing_config(self, module):
+        """Wire the ``activation_checkpointing`` block (reference
+        activation_checkpointing/config.py) into the model, or reject knobs
+        this design cannot honor — a parsed knob must change the compiled
+        program or error, never silently no-op.
+
+          * partition_activations / cpu_checkpointing: flipped on the model
+            config (models gate the sharding constraint / host-offload remat
+            policy on them; see models/gpt.py tp_shard_sequence and the
+            ``ds_block_carry`` offload policy).
+          * contiguous_memory_optimization / synchronize_checkpoint_boundary:
+            rejected — XLA owns the activation arena and there are no host
+            sync points inside a jitted step to align to.
+        """
+        ac = self.config.activation_checkpointing
+        if ac.contiguous_memory_optimization:
+            raise ValueError(
+                "activation_checkpointing.contiguous_memory_optimization "
+                "has no analogue here: XLA's allocator already lays remat "
+                "buffers contiguously; remove the knob")
+        if ac.synchronize_checkpoint_boundary:
+            raise ValueError(
+                "activation_checkpointing.synchronize_checkpoint_boundary "
+                "cannot be honored: the whole step is one jitted program "
+                "with no host sync points; remove the knob")
+        model_cfg_ckpt = bool(getattr(getattr(module, "cfg", None),
+                                      "cpu_checkpointing", False))
+        if (ac.cpu_checkpointing or model_cfg_ckpt) and self.mesh.size > 1:
+            raise ValueError(
+                "cpu_checkpointing on a multi-chip mesh: this XLA version's "
+                "SPMD partitioner rejects the memory-placement annotations "
+                "the host-offload remat policy emits on replicated "
+                "residuals (spmd_partitioner RET_CHECK on "
+                "annotate_device_placement). Use it single-chip, or use "
+                "partition_activations / remat_policy='nothing' to cut "
+                "activation HBM under SPMD")
+        if not (ac.partition_activations or ac.cpu_checkpointing):
+            return module
+        import dataclasses as _dc
+        cfg = getattr(module, "cfg", None)
+        if cfg is None or not _dc.is_dataclass(cfg) or not all(
+                hasattr(cfg, f) for f in ("partition_activations",
+                                          "cpu_checkpointing")):
+            raise ValueError(
+                "activation_checkpointing.partition_activations / "
+                "cpu_checkpointing need a model config that supports them "
+                f"(models.GPT does); got module {type(module).__name__}")
+        new_cfg = _dc.replace(
+            cfg,
+            partition_activations=bool(ac.partition_activations
+                                       or cfg.partition_activations),
+            cpu_checkpointing=bool(ac.cpu_checkpointing
+                                   or cfg.cpu_checkpointing))
+        # clone() keeps any other constructor fields the module declares
+        return module.clone(cfg=new_cfg) if new_cfg != cfg else module
+
     def _build_base_optimizer(self, optimizer):
         if optimizer is not None and not isinstance(optimizer, optax.GradientTransformation):
             raise TypeError("optimizer must be an optax.GradientTransformation")
@@ -339,6 +399,7 @@ class DeepSpeedEngine:
         self.master_shardings = self.rules.shardings(self.rules.master_specs(master))
         self.param_shardings = self.rules.shardings(self.rules.param_specs(master))
         self.grad_shardings = self.rules.shardings(self.rules.grad_specs(master))
+        self._check_zero3_working_set(master)
         master = jax.device_put(master, self.master_shardings)
 
         scale_state = make_loss_scale_state(
@@ -359,6 +420,79 @@ class DeepSpeedEngine:
             "skipped": jnp.zeros((), jnp.int32),
         }
         self._init_opt_state()
+
+    def _check_zero3_working_set(self, params):
+        """Honor ``stage3_max_live_parameters`` (reference zero/config.py:
+        max live params the coordinator may keep gathered,
+        partitioned_param_coordinator.py:240-356). In this design the live
+        set is bounded structurally — scan-over-layers gathers one layer
+        slice at a time and releases it — so compliance is automatic
+        whenever it is achievable at all. What CAN violate the cap is its
+        floor: persisted (sub-threshold, replicated) params plus the largest
+        single tensor that must be fully materialized for its matmul. If the
+        user explicitly set a cap below that floor, no schedule could honor
+        it; reject loudly rather than nod (an unwired knob must not no-op)."""
+        if self.zero_stage < 3:
+            return
+        zraw = self.config._raw.get("zero_optimization", {})
+        explicitly_set = ("max_live_parameters" in zraw
+                          or "stage3_max_live_parameters" in zraw)
+        if not explicitly_set:
+            return
+        cap = self.config.zero_config.max_live_parameters
+        specs = self.rules.param_specs(params)
+
+        def axes_of(spec):
+            out = []
+            for entry in spec:
+                out.extend((entry,) if isinstance(entry, str)
+                           else (entry or ()))
+            return out
+
+        # per-chip live elements when the leaf is in use: the dp gather is
+        # undone, but tp/ep sharding remains; a scan-stacked [L, ...] leaf
+        # materializes one layer slice per scan step, not the whole stack
+        mcfg = getattr(self.module, "cfg", None)
+        scan_len = getattr(mcfg, "num_layers", None) \
+            if getattr(mcfg, "scan_layers", False) else None
+        mesh_sizes = dict(self.mesh.shape)
+
+        def live_numel(path, spec, p):
+            n = int(p.size)
+            shards = 1
+            for a in axes_of(spec):
+                if a != "dp":
+                    shards *= mesh_sizes.get(a, 1)
+            n = -(-n // shards)
+            # only dp-sharded stacked leaves gather one slice per scan step;
+            # persisted (replicated) stacks are fully resident at all times
+            if scan_len and "dp" in axes_of(spec) and "blocks" in path \
+                    and p.shape[0] == scan_len:
+                n = -(-n // scan_len)
+            return n
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        spec_leaves = jax.tree.leaves(specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+        from .sharding import path_str
+        rows = [(path_str(pth), spec, p)
+                for (pth, p), spec in zip(flat, spec_leaves)]
+        persistent = sum(live_numel(pth, spec, p) for pth, spec, p in rows
+                         if "dp" not in axes_of(spec))
+        largest = max((live_numel(pth, spec, p) for pth, spec, p in rows
+                       if "dp" in axes_of(spec)), default=0)
+        floor = persistent + largest
+        if cap < floor:
+            raise ValueError(
+                f"stage3_max_live_parameters={cap:,} is below the working-"
+                f"set floor of this model: {persistent:,} persisted params "
+                f"(under param_persistence_threshold="
+                f"{self.rules.param_persistence_threshold:,}) + "
+                f"{largest:,} for the largest single tensor. The scan-over-"
+                f"layers program already keeps the live set at its "
+                f"structural minimum; raise the cap to at least {floor:,}, "
+                f"lower param_persistence_threshold, or shard the model "
+                f"further (tp/pp)")
 
     def _init_opt_state(self):
         # Build a throwaway transformation just for init (lr constant — state
@@ -1031,7 +1165,15 @@ class DeepSpeedEngine:
             aio_cfg=getattr(self.config, "aio", None),
             dp_shard=self._local_dp_shard(),
             init_seed=self.config.seed,
-            mirror_nvme_path=mirror_nvme)
+            mirror_nvme_path=mirror_nvme,
+            # widen the swap window past the documented 2-buffer bound only
+            # when the user explicitly asked for a prefetch budget (the
+            # default would otherwise silently 4x host DRAM for big leaves)
+            prefetch_numel=(
+                self.config.zero_config.prefetch_bucket_size
+                if any(k in self.config._raw.get("zero_optimization", {})
+                       for k in ("prefetch_bucket_size",
+                                 "stage3_prefetch_bucket_size")) else 0))
         self.optimizer = None
         self._client_optimizer = None
 

@@ -87,12 +87,21 @@ class ShardingRules:
     """Computes the sharding trees for params / grads / optimizer state given
     a ZeRO stage and mesh."""
 
-    def __init__(self, mesh: Mesh, zero_stage: int = 0, use_tp: bool = True):
+    def __init__(self, mesh: Mesh, zero_stage: int = 0, use_tp: bool = True,
+                 param_persistence_threshold: int = 0):
+        """``param_persistence_threshold``: stage-3 leaves at or below this
+        many elements stay replicated over ``dp`` ("persisted") instead of
+        being sharded + re-gathered every layer — the declarative form of the
+        reference's persistence set (zero/config.py
+        stage3_param_persistence_threshold, kept live by the coordinator,
+        partitioned_param_coordinator.py:240-356). Biases/LN scales are tiny;
+        gathering them per layer costs a collective for ~KBs of savings."""
         self.mesh = mesh
         self.stage = zero_stage
         self.dp = mesh.shape.get("dp", 1)
         self.tp = mesh.shape.get("tp", 1) if use_tp else 1
         self.ep = mesh.shape.get("ep", 1)
+        self.param_persistence_threshold = int(param_persistence_threshold)
 
     def _base_spec(self, path: str, shape: Tuple[int, ...],
                    expert_dim: int = 0) -> P:
@@ -122,7 +131,15 @@ class ShardingRules:
                    expert_dim: int = 0) -> P:
         spec = self._base_spec(path, shape, expert_dim)
         if self.stage >= 3:
-            spec = _add_axis(spec, shape, "dp", self.dp)
+            numel = 1
+            for d in shape:
+                numel *= d
+            if numel > self.param_persistence_threshold:
+                spec = _add_axis(spec, shape, "dp", self.dp)
+            # else: persisted — replicated over dp, no per-layer gather.
+            # (Stacked [L, ...] leaves compare their full stacked size, the
+            # conservative direction: a leaf persists only when the whole
+            # stack is small. Master/opt state stays dp-sharded either way.)
         return spec
 
     def master_spec(self, path: str, shape: Tuple[int, ...],

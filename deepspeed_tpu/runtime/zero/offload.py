@@ -20,10 +20,12 @@ device_put ships the mirror back as the next step's working params.
 Memory model per parameter:
   * device=cpu : master (4B) + moments (8B) + mirror (<=4B) in DRAM.
   * device=nvme: master+moments (12B) live in per-leaf files; DRAM holds
-    only the compute-dtype mirror (2B for bf16) plus TWO bounded swap
-    buffers sized by the largest leaf — leaf i+1's read overlaps leaf i's
-    step through the aio engine (csrc/aio.cpp). This is the capacity tier
-    that fits 175B-class optimizer state on a host.
+    only the compute-dtype mirror (2B for bf16) plus a bounded window of
+    swap buffers sized by the largest leaf (2 by default; widened when
+    ``stage3_prefetch_bucket_size`` is set explicitly) — reads of upcoming
+    leaves overlap the current leaf's step through the aio engine
+    (csrc/aio.cpp). This is the capacity tier that fits 175B-class
+    optimizer state on a host.
 """
 
 from __future__ import annotations
@@ -205,18 +207,34 @@ class MirrorNVMeStore:
 
 
 class NVMeLeafSwapper:
-    """Per-leaf [master | exp_avg | exp_avg_sq] files with double-buffered
-    async swap (reference PipelinedOptimizerSwapper:61). DRAM footprint is
-    exactly two buffers of 3x the largest leaf."""
+    """Per-leaf [master | exp_avg | exp_avg_sq] files with windowed async
+    swap (reference PipelinedOptimizerSwapper:61). DRAM footprint is
+    ``num_slots`` buffers of 3x the largest leaf: slot count = 1 (the leaf
+    being stepped) + the prefetch depth derived from
+    ``stage3_prefetch_bucket_size`` (reference zero/config.py — how far
+    ahead, in elements, the coordinator may stage). Each slot owns its own
+    read/write aio handle so waiting for leaf i's data never blocks on the
+    deeper prefetches still in flight."""
 
-    def __init__(self, nvme_path: str, max_numel: int, aio_cfg=None):
+    def __init__(self, nvme_path: str, max_numel: int, aio_cfg=None,
+                 prefetch_numel: int = 0):
         self.dir = os.path.join(nvme_path, "zero_offload_swap")
         os.makedirs(self.dir, exist_ok=True)
         bs = getattr(aio_cfg, "block_size", 1 << 20)
         qd = getattr(aio_cfg, "queue_depth", 8)
-        self.read_handle = AsyncIOHandle(block_size=bs, queue_depth=qd)
-        self.write_handle = AsyncIOHandle(block_size=bs, queue_depth=qd)
-        self.slots = [np.empty(3 * max_numel, np.float32) for _ in range(2)]
+        depth = max(1, min(int(prefetch_numel) // max(max_numel, 1), 7)) \
+            if prefetch_numel else 1
+        self.num_slots = 1 + depth
+        self.read_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd)
+                             for _ in range(self.num_slots)]
+        self.write_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd)
+                              for _ in range(self.num_slots)]
+        self.slots = [np.empty(3 * max_numel, np.float32)
+                      for _ in range(self.num_slots)]
+
+    @property
+    def prefetch_depth(self) -> int:
+        return self.num_slots - 1
 
     def _file(self, idx: int) -> str:
         return os.path.join(self.dir, f"leaf_{idx}.bin")
@@ -224,34 +242,41 @@ class NVMeLeafSwapper:
     def write_init(self, idx: int, master: np.ndarray):
         buf = np.concatenate([master, np.zeros_like(master),
                               np.zeros_like(master)])
-        self.write_handle.sync_pwrite(buf, self._file(idx))
+        self.write_handles[0].sync_pwrite(buf, self._file(idx))
 
     def start_read(self, idx: int, numel: int, slot: int):
+        # the slot's previous occupant must be flushed before overwriting
+        self.write_handles[slot].wait()
         view = self.slots[slot][:3 * numel]
-        self.read_handle.async_pread(view, self._file(idx))
+        self.read_handles[slot].async_pread(view, self._file(idx))
+
+    def finish_read(self, slot: int):
+        self.read_handles[slot].wait()
 
     def finish_reads(self):
-        self.read_handle.wait()
+        for h in self.read_handles:
+            h.wait()
 
     def views(self, numel: int, slot: int):
         buf = self.slots[slot]
         return (buf[:numel], buf[numel:2 * numel], buf[2 * numel:3 * numel])
 
     def start_write(self, idx: int, numel: int, slot: int):
-        self.write_handle.async_pwrite(self.slots[slot][:3 * numel],
-                                       self._file(idx))
+        self.write_handles[slot].async_pwrite(self.slots[slot][:3 * numel],
+                                              self._file(idx))
 
     def finish_writes(self):
-        self.write_handle.wait()
+        for h in self.write_handles:
+            h.wait()
 
     def read_sync(self, idx: int, numel: int, slot: int = 0):
         self.start_read(idx, numel, slot)
-        self.finish_reads()
+        self.finish_read(slot)
         return self.views(numel, slot)
 
     def write_sync(self, idx: int, numel: int, slot: int = 0):
         self.start_write(idx, numel, slot)
-        self.finish_writes()
+        self.write_handles[slot].wait()
 
 
 class HostOffloadOptimizer:
@@ -262,7 +287,8 @@ class HostOffloadOptimizer:
                  adamw: bool = True, mirror_dtype: str = "bfloat16",
                  nvme_path: Optional[str] = None, aio_cfg=None,
                  dp_shard=(0, 1, 1), init_seed: Optional[int] = None,
-                 mirror_nvme_path: Optional[str] = None, init_rules=None):
+                 mirror_nvme_path: Optional[str] = None, init_rules=None,
+                 prefetch_numel: int = 0):
         """``dp_shard=(rank_start, rank_count, dp_world)``: this host owns
         the contiguous dp-rank range [rank_start, rank_start+rank_count) of
         every flat-partitioned leaf — host work and DRAM scale ~1/hosts
@@ -283,7 +309,8 @@ class HostOffloadOptimizer:
         self.swapper = None
         if self.nvme:
             max_numel = max(l.numel for l in self.leaves)
-            self.swapper = NVMeLeafSwapper(nvme_path, max_numel, aio_cfg)
+            self.swapper = NVMeLeafSwapper(nvme_path, max_numel, aio_cfg,
+                                           prefetch_numel=prefetch_numel)
             for i, leaf in enumerate(self.leaves):
                 self.swapper.write_init(i, leaf._init_master)
                 leaf._init_master = None  # DRAM reclaimed
@@ -291,8 +318,9 @@ class HostOffloadOptimizer:
                 f"NVMe offload: master+moments for {len(self.leaves)} leaves "
                 f"({self.numel():,} params, "
                 f"{12 * self.numel() / 1e9:.2f} GB) swapped to "
-                f"{self.swapper.dir}; DRAM window = 2 x "
-                f"{3 * max_numel * 4 / 1e6:.1f} MB", ranks=[0])
+                f"{self.swapper.dir}; DRAM window = {self.swapper.num_slots}"
+                f" x {3 * max_numel * 4 / 1e6:.1f} MB "
+                f"(prefetch depth {self.swapper.prefetch_depth})", ranks=[0])
         self.mirror_store = None
         if mirror_nvme_path:
             # the PARAM tier (offload_param.device=nvme): compute-dtype
@@ -337,15 +365,17 @@ class HostOffloadOptimizer:
 
         if self.swapper is not None:
             sw = self.swapper
-            sw.start_read(0, self.leaves[0].numel, slot=0)
+            n, ns = len(self.leaves), sw.num_slots
+            # prime the prefetch window, then keep `prefetch_depth` leaves
+            # in flight ahead of the one being stepped
+            for j in range(min(sw.prefetch_depth, n)):
+                sw.start_read(j, self.leaves[j].numel, slot=j % ns)
             for i, leaf in enumerate(self.leaves):
-                slot = i % 2
-                sw.finish_reads()
-                if i + 1 < len(self.leaves):
-                    # the other slot may still be flushing leaf i-1
-                    sw.finish_writes()
-                    sw.start_read(i + 1, self.leaves[i + 1].numel,
-                                  slot=(i + 1) % 2)
+                slot = i % ns
+                sw.finish_read(slot)
+                nxt = i + sw.prefetch_depth
+                if nxt < n:
+                    sw.start_read(nxt, self.leaves[nxt].numel, slot=nxt % ns)
                 master, m, v = sw.views(leaf.numel, slot)
                 self._step_arrays(leaf, master, m, v, grads_flat[i], lr, inv)
                 sw.start_write(i, leaf.numel, slot)

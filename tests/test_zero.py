@@ -57,7 +57,12 @@ def test_stage1_shards_optimizer_state():
 
 
 def test_stage3_shards_params():
-    engine = make_engine(_zero_cfg(3))
+    # the tiny test model's leaves all sit under the reference-default
+    # param_persistence_threshold (100k), so pin it to 0 here — persistence
+    # itself is covered in tests/test_config_knobs.py
+    cfg = dict(CFG, zero_optimization={"stage": 3,
+                                       "param_persistence_threshold": 0})
+    engine = make_engine(cfg)
     specs = jax.tree.leaves(engine.rules.param_specs(engine.state["master"]),
                             is_leaf=lambda x: isinstance(x, P))
     assert any(any(ax == "dp" for ax in s if ax is not None) for s in specs)
