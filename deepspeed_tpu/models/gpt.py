@@ -485,6 +485,13 @@ class GPT(nn.Module):
         embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="wte")
         x = embed(input_ids)
+        if cfg.sequence_parallel:
+            # constrain the lookup output BEFORE anything mixes with it:
+            # born [dp, sp, ·], the vocab-sharded table gather partitions by
+            # its (dp, sp)-sharded indices instead of materializing a
+            # replicated [B, S, D] and repartitioning it (the involuntary
+            # full-remat XLA warns about when the constraint comes later)
+            x = sp_shard_sequence(x)
         if not cfg.rotary:
             pos_emb = self.param(
                 "wpe", nn.initializers.normal(0.02),

@@ -267,6 +267,17 @@ class DeepSpeedEngine:
                 "activation_checkpointing.synchronize_checkpoint_boundary "
                 "cannot be honored: the whole step is one jitted program "
                 "with no host sync points; remove the knob")
+        if ac.number_checkpoints is not None:
+            raise ValueError(
+                "activation_checkpointing.number_checkpoints cannot be "
+                "honored: remat granularity is structural here (one "
+                "checkpoint per scanned block); control the trade with the "
+                "model's remat_policy instead")
+        if ac.profile:
+            raise ValueError(
+                "activation_checkpointing.profile is not wired; use "
+                "wall_clock_breakdown or the flops_profiler block for "
+                "per-phase timing")
         model_cfg_ckpt = bool(getattr(getattr(module, "cfg", None),
                                       "cpu_checkpointing", False))
         if (ac.cpu_checkpointing or model_cfg_ckpt) and self.mesh.size > 1:
@@ -379,6 +390,9 @@ class DeepSpeedEngine:
             return
         self._onebit = None
         if self.offload_enabled:
+            # the cap contract applies to ZeRO-Infinity too (works on
+            # abstract ShapeDtypeStruct trees — only shapes are read)
+            self._check_zero3_working_set(model_parameters)
             self._init_offload_state(model_parameters, optimizer, rng)
             return
         from .zero.partition_params import is_abstract_tree
@@ -457,16 +471,28 @@ class DeepSpeedEngine:
             if getattr(mcfg, "scan_layers", False) else None
         mesh_sizes = dict(self.mesh.shape)
 
+        def dp_gathered(spec):
+            # dp nested with tp (embedding vocab dims) is never gathered at
+            # use — the lookup partitions by its indices (_stage3_embed_spec)
+            return any(entry == "dp" for entry in spec
+                       if isinstance(entry, str))
+
+        def numel_of(p):
+            n = 1
+            for d in p.shape:
+                n *= int(d)
+            return n
+
         def live_numel(path, spec, p):
-            n = int(p.size)
+            n = numel_of(p)
             shards = 1
             for a in axes_of(spec):
-                if a != "dp":
+                if a != "dp" or not dp_gathered(spec):
                     shards *= mesh_sizes.get(a, 1)
             n = -(-n // shards)
             # only dp-sharded stacked leaves gather one slice per scan step;
             # persisted (replicated) stacks are fully resident at all times
-            if scan_len and "dp" in axes_of(spec) and "blocks" in path \
+            if scan_len and dp_gathered(spec) and "blocks" in path \
                     and p.shape[0] == scan_len:
                 n = -(-n // scan_len)
             return n
@@ -478,9 +504,9 @@ class DeepSpeedEngine:
         rows = [(path_str(pth), spec, p)
                 for (pth, p), spec in zip(flat, spec_leaves)]
         persistent = sum(live_numel(pth, spec, p) for pth, spec, p in rows
-                         if "dp" not in axes_of(spec))
+                         if not dp_gathered(spec))
         largest = max((live_numel(pth, spec, p) for pth, spec, p in rows
-                       if "dp" in axes_of(spec)), default=0)
+                       if dp_gathered(spec)), default=0)
         floor = persistent + largest
         if cap < floor:
             raise ValueError(

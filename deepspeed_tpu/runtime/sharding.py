@@ -135,12 +135,37 @@ class ShardingRules:
             for d in shape:
                 numel *= d
             if numel > self.param_persistence_threshold:
-                spec = _add_axis(spec, shape, "dp", self.dp)
+                spec = self._stage3_embed_spec(path, shape, spec) \
+                    or _add_axis(spec, shape, "dp", self.dp)
             # else: persisted — replicated over dp, no per-layer gather.
             # (Stacked [L, ...] leaves compare their full stacked size, the
             # conservative direction: a leaf persists only when the whole
             # stack is small. Master/opt state stays dp-sharded either way.)
         return spec
+
+    def _stage3_embed_spec(self, path: str, shape: Tuple[int, ...],
+                           spec: P) -> Optional[P]:
+        """Embedding tables shard ``dp`` on the VOCAB dim (nested with tp),
+        never on the feature dim. A feature-sharded table poisons the token
+        lookup: the gather output is born feature-sharded while activations
+        want [dp, sp, ·], and XLA's only escape is an involuntary full
+        rematerialization (replicate-then-repartition of [B, S, D] every
+        microbatch — the SPMD warning the r2 dryrun logged). Vocab-sharded
+        operands instead partition the gather by its (dp, sp)-sharded
+        indices with a mask+psum, and the output is born with the right
+        sharding."""
+        is_table = path.endswith("kernel") or path.endswith("embedding")
+        if not (_EMBED_PAT.search(path) and is_table and len(shape) >= 2):
+            return None
+        vdim = len(shape) - 2   # vocab dim, matching tp_spec
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        if parts[vdim] == "tp" and shape[vdim] % (self.tp * self.dp) == 0:
+            parts[vdim] = ("tp", "dp")
+            return P(*parts)
+        if parts[vdim] is None and shape[vdim] % self.dp == 0:
+            parts[vdim] = "dp"
+            return P(*parts)
+        return None
 
     def master_spec(self, path: str, shape: Tuple[int, ...],
                     expert_dim: int = 0) -> P:

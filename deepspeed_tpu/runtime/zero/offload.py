@@ -224,10 +224,25 @@ class NVMeLeafSwapper:
         qd = getattr(aio_cfg, "queue_depth", 8)
         depth = max(1, min(int(prefetch_numel) // max(max_numel, 1), 7)) \
             if prefetch_numel else 1
+        if prefetch_numel and depth == 1 and prefetch_numel < max_numel:
+            log_dist(
+                f"stage3_prefetch_bucket_size={prefetch_numel:,} is smaller "
+                f"than the largest optimizer leaf ({max_numel:,} elements); "
+                f"the swap window stays at the default depth of 1 — raise "
+                f"the budget past the largest leaf to widen it", ranks=[0])
+        elif prefetch_numel and int(prefetch_numel) // max(max_numel, 1) > 7:
+            log_dist(
+                f"stage3_prefetch_bucket_size={prefetch_numel:,} asks for a "
+                f"deeper window than the 7-leaf cap; clamping (DRAM bound: "
+                f"8 buffers of the largest leaf)", ranks=[0])
         self.num_slots = 1 + depth
-        self.read_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd)
+        # one op in flight per handle -> a single IO thread each (the
+        # window, not the thread count, is what the budget sizes)
+        self.read_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd,
+                                           num_threads=1)
                              for _ in range(self.num_slots)]
-        self.write_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd)
+        self.write_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd,
+                                            num_threads=1)
                               for _ in range(self.num_slots)]
         self.slots = [np.empty(3 * max_numel, np.float32)
                       for _ in range(self.num_slots)]
