@@ -497,8 +497,10 @@ class GPT(nn.Module):
                 "wpe", nn.initializers.normal(0.02),
                 (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
             x = x + pos_emb[positions].astype(cfg.dtype)
-        if cfg.sequence_parallel:
-            x = sp_shard_sequence(x)
+            if cfg.sequence_parallel:
+                # re-constrain after the wpe add (its own gather output
+                # would otherwise set the layout)
+                x = sp_shard_sequence(x)
 
         block = Block
         if cfg.remat:

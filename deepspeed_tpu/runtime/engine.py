@@ -471,9 +471,14 @@ class DeepSpeedEngine:
             if getattr(mcfg, "scan_layers", False) else None
         mesh_sizes = dict(self.mesh.shape)
 
-        def dp_gathered(spec):
-            # dp nested with tp (embedding vocab dims) is never gathered at
-            # use — the lookup partitions by its indices (_stage3_embed_spec)
+        def dp_gathered(path, spec, p):
+            # embedding tables with dp on the vocab dim (plain or nested
+            # with tp) are never gathered at use — the lookup partitions by
+            # its indices (_stage3_embed_spec); everything else with a
+            # top-level dp axis is all-gathered for its matmul
+            from .sharding import ShardingRules as _SR
+            if _SR._is_embed_table(path, tuple(p.shape)):
+                return False
             return any(entry == "dp" for entry in spec
                        if isinstance(entry, str))
 
@@ -487,12 +492,12 @@ class DeepSpeedEngine:
             n = numel_of(p)
             shards = 1
             for a in axes_of(spec):
-                if a != "dp" or not dp_gathered(spec):
+                if a != "dp" or not dp_gathered(path, spec, p):
                     shards *= mesh_sizes.get(a, 1)
             n = -(-n // shards)
             # only dp-sharded stacked leaves gather one slice per scan step;
             # persisted (replicated) stacks are fully resident at all times
-            if scan_len and dp_gathered(spec) and "blocks" in path \
+            if scan_len and dp_gathered(path, spec, p) and "blocks" in path \
                     and p.shape[0] == scan_len:
                 n = -(-n // scan_len)
             return n
@@ -504,9 +509,9 @@ class DeepSpeedEngine:
         rows = [(path_str(pth), spec, p)
                 for (pth, p), spec in zip(flat, spec_leaves)]
         persistent = sum(live_numel(pth, spec, p) for pth, spec, p in rows
-                         if not dp_gathered(spec))
+                         if not dp_gathered(pth, spec, p))
         largest = max((live_numel(pth, spec, p) for pth, spec, p in rows
-                       if dp_gathered(spec)), default=0)
+                       if dp_gathered(pth, spec, p)), default=0)
         floor = persistent + largest
         if cap < floor:
             raise ValueError(

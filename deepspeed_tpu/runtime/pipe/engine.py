@@ -97,6 +97,23 @@ class PipelineEngine:
         self.micro_batches = self.config.gradient_accumulation_steps
         self.compute_dtype = self.config.compute_dtype
 
+        # ZeRO inside the pipeline (reference: ZeRO-1 + the BF16 optimizer
+        # compose with pipelines, runtime/pipe/engine.py:270
+        # _bf16_reduce_grads + bf16_optimizer.py:30-60; ZeRO-2/3's grad/param
+        # hooks conflict with 1F1B there). Here stage 1 shards optimizer
+        # state over the stage sub-mesh's dp axis (step computes on shards,
+        # XLA all-gathers updated params); stage 2 additionally keeps the
+        # grad accumulators dp-sharded (the in-program grad reduction
+        # becomes a reduce-scatter). Params stay replicated over stage-dp
+        # for fwd/bwd either way.
+        self.zero_stage = self.config.zero_optimization_stage
+        if self.zero_stage >= 3:
+            raise ValueError(
+                "ZeRO-3 does not compose with the pipeline engine: stage "
+                "params must be resident for the host-driven 1F1B replay. "
+                "Use zero stage 0-2 with pp, or drop pp and use stage 3's "
+                "scan-over-layers sharding")
+
         self._build_stage_meshes()
 
         rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
@@ -176,6 +193,35 @@ class PipelineEngine:
                 jnp.asarray(a), self._stage_sharding(s, self._batch_spec(a))),
             x)
 
+    # ------------------------------------------------------- ZeRO shardings
+    def _zero_dp_spec(self, shape) -> P:
+        """Flat-partition analogue for a stage leaf: the first dim the
+        stage-dp axis divides shards over ``dp`` (reference per-rank
+        partitions, stage_1_and_2.py:228-254)."""
+        if self.zero_stage >= 1 and self._stage_dp > 1:
+            for i, d in enumerate(shape):
+                if d % self._stage_dp == 0 and d >= self._stage_dp:
+                    return P(*([None] * i + ["dp"]))
+        return P()
+
+    def _zero_shard_tree(self, s: int, params):
+        return jax.tree.map(
+            lambda p: self._stage_sharding(s, self._zero_dp_spec(p.shape)),
+            params)
+
+    def _zero_opt_shardings(self, s: int, params, opt_state):
+        """Optimizer-state leaves mirroring a param shape take the param's
+        dp-shard; scalars (step count) replicate."""
+        by_shape = {}
+        for p in jax.tree.leaves(params):
+            by_shape.setdefault(
+                tuple(p.shape),
+                self._stage_sharding(s, self._zero_dp_spec(p.shape)))
+        rep = self._stage_sharding(s, P())
+        return jax.tree.map(
+            lambda x: by_shape.get(tuple(getattr(x, "shape", ())), rep),
+            opt_state)
+
     # ----------------------------------------------------------- stage build
     def _build_stages(self, model: PipelineModule, rng, model_parameters):
         self.stage_layers: List[List[Any]] = []
@@ -217,10 +263,23 @@ class PipelineEngine:
             params = jax.tree.map(lambda a: jax.device_put(a, repl), params)
             self.stage_layers.append(layers)
             self.stage_params.append(params)
-        self.opt_states = [
-            jax.tree.map(lambda a: jax.device_put(a, self._stage_sharding(s, P())),
-                         self.optimizer.init(p))
-            for s, p in enumerate(self.stage_params)]
+        self.opt_states = []
+        self._opt_shardings: List[Any] = []      # per stage, ZeRO-1+ sharded
+        self._grad_shardings: List[Any] = []     # per stage, ZeRO-2+ sharded
+        self._param_repl_shardings: List[Any] = []
+        for s, p in enumerate(self.stage_params):
+            rep = self._stage_sharding(s, P())
+            state = self.optimizer.init(p)
+            osh = self._zero_opt_shardings(s, p, state) \
+                if self.zero_stage >= 1 \
+                else jax.tree.map(lambda _: rep, state)
+            gsh = self._zero_shard_tree(s, p) if self.zero_stage >= 2 \
+                else jax.tree.map(lambda _: rep, p)
+            self._opt_shardings.append(osh)
+            self._grad_shardings.append(gsh)
+            self._param_repl_shardings.append(jax.tree.map(lambda _: rep, p))
+            self.opt_states.append(
+                jax.tree.map(jax.device_put, state, osh))
         self._built = True
 
     def _stage_apply(self, stage_id: int):
@@ -288,7 +347,14 @@ class PipelineEngine:
                     lambda a, g2: a + g2.astype(jnp.float32), acc, dparams)
                 return new_acc, dx
 
-        self._jit_bwd[s] = jax.jit(bwd, donate_argnums=(3,))
+        out_sh = None
+        if self.zero_stage >= 2:
+            # ZeRO-2: the accumulators stay dp-sharded; constraining the
+            # output turns the in-program dp grad psum into a reduce-scatter
+            out_sh = (self._grad_shardings[s], None, None) if last \
+                else (self._grad_shardings[s], None)
+        self._jit_bwd[s] = jax.jit(bwd, donate_argnums=(3,),
+                                   out_shardings=out_sh)
         return self._jit_bwd[s]
 
     def _step_prog(self, s: int):
@@ -296,14 +362,28 @@ class PipelineEngine:
             return self._jit_step[s]
         M = float(self.micro_batches)
         opt = self.optimizer
+        zero = self.zero_stage
+        shard_tree = self._zero_shard_tree(s, self.stage_params[s]) \
+            if zero >= 1 else None
 
         def step(params_list, opt_state, acc):
             grads = jax.tree.map(lambda g: g / M, acc)
+            if shard_tree is not None:
+                # ZeRO-1: each dp rank updates its slice of moments/params;
+                # out_shardings below all-gather the updated params back to
+                # replicated (the reference's step-tail allgather,
+                # stage_1_and_2.py:1652-1792)
+                grads = jax.lax.with_sharding_constraint(grads, shard_tree)
             updates, new_opt = opt.update(grads, opt_state, params_list)
+            if shard_tree is not None:
+                updates = jax.lax.with_sharding_constraint(updates, shard_tree)
             new_params = optax.apply_updates(params_list, updates)
             return new_params, new_opt
 
-        self._jit_step[s] = jax.jit(step, donate_argnums=(0, 1))
+        out_sh = (self._param_repl_shardings[s], self._opt_shardings[s]) \
+            if zero >= 1 else None
+        self._jit_step[s] = jax.jit(step, donate_argnums=(0, 1),
+                                    out_shardings=out_sh)
         return self._jit_step[s]
 
     # ------------------------------------------------------------- training
@@ -323,9 +403,9 @@ class PipelineEngine:
 
         grads_acc = [
             jax.tree.map(
-                lambda p, _sh=self._stage_sharding(s, P()): jax.device_put(
-                    jnp.zeros(p.shape, jnp.float32), _sh),
-                self.stage_params[s])
+                lambda p, sh: jax.device_put(jnp.zeros(p.shape, jnp.float32),
+                                             sh),
+                self.stage_params[s], self._grad_shardings[s])
             for s in range(S)]
         total_loss = jnp.zeros((), jnp.float32)
 
@@ -432,13 +512,13 @@ class PipelineEngine:
             gsum = grads_acc[s0][li0]
             for s, li in owners[1:]:
                 g = jax.tree.map(
-                    lambda a: jax.device_put(a, self._stage_sharding(s0, P())),
-                    grads_acc[s][li])
+                    lambda a, sh: jax.device_put(a, sh),
+                    grads_acc[s][li], self._grad_shardings[s0][li0])
                 gsum = jax.tree.map(jnp.add, gsum, g)
             for s, li in owners:
                 grads_acc[s][li] = jax.tree.map(
-                    lambda a: jax.device_put(a, self._stage_sharding(s, P())),
-                    gsum)
+                    lambda a, sh: jax.device_put(a, sh),
+                    gsum, self._grad_shardings[s][li])
 
     def _optimizer_step(self, grads_acc):
         for s in range(self.num_stages):

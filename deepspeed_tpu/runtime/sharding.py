@@ -135,16 +135,23 @@ class ShardingRules:
             for d in shape:
                 numel *= d
             if numel > self.param_persistence_threshold:
-                spec = self._stage3_embed_spec(path, shape, spec) \
-                    or _add_axis(spec, shape, "dp", self.dp)
+                if self._is_embed_table(path, shape):
+                    spec = self._stage3_embed_spec(path, shape, spec)
+                else:
+                    spec = _add_axis(spec, shape, "dp", self.dp)
             # else: persisted — replicated over dp, no per-layer gather.
             # (Stacked [L, ...] leaves compare their full stacked size, the
             # conservative direction: a leaf persists only when the whole
             # stack is small. Master/opt state stays dp-sharded either way.)
         return spec
 
+    @staticmethod
+    def _is_embed_table(path: str, shape: Tuple[int, ...]) -> bool:
+        is_table = path.endswith("kernel") or path.endswith("embedding")
+        return bool(_EMBED_PAT.search(path) and is_table and len(shape) >= 2)
+
     def _stage3_embed_spec(self, path: str, shape: Tuple[int, ...],
-                           spec: P) -> Optional[P]:
+                           spec: P) -> P:
         """Embedding tables shard ``dp`` on the VOCAB dim (nested with tp),
         never on the feature dim. A feature-sharded table poisons the token
         lookup: the gather output is born feature-sharded while activations
@@ -153,10 +160,9 @@ class ShardingRules:
         microbatch — the SPMD warning the r2 dryrun logged). Vocab-sharded
         operands instead partition the gather by its (dp, sp)-sharded
         indices with a mask+psum, and the output is born with the right
-        sharding."""
-        is_table = path.endswith("kernel") or path.endswith("embedding")
-        if not (_EMBED_PAT.search(path) and is_table and len(shape) >= 2):
-            return None
+        sharding. When the vocab dim doesn't divide, the table stays
+        REPLICATED over dp (memory for bandwidth — feature-dim dp would
+        reintroduce the per-microbatch remat)."""
         vdim = len(shape) - 2   # vocab dim, matching tp_spec
         parts = list(spec) + [None] * (len(shape) - len(spec))
         if parts[vdim] == "tp" and shape[vdim] % (self.tp * self.dp) == 0:
@@ -165,7 +171,12 @@ class ShardingRules:
         if parts[vdim] is None and shape[vdim] % self.dp == 0:
             parts[vdim] = "dp"
             return P(*parts)
-        return None
+        from ..utils.logging import logger
+        logger.warning(
+            f"stage-3: embedding table {path} {shape} keeps its vocab dim "
+            f"replicated over dp={self.dp} (dim {shape[vdim]} doesn't "
+            f"divide); pad the vocab to a multiple of tp*dp to shard it")
+        return P(*parts)
 
     def master_spec(self, path: str, shape: Tuple[int, ...],
                     expert_dim: int = 0) -> P:

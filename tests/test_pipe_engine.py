@@ -268,3 +268,58 @@ def test_pipeline_bf16_trains():
     # masters stay fp32
     for p in jax.tree.leaves(engine.stage_params[0]):
         assert p.dtype == jnp.float32
+
+
+def _zero_pipe_engine(num_stages, dp, zero_stage):
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.models.gpt_pipe import gpt_pipe_module
+
+    cfg = GPTConfig(vocab_size=64, max_seq_len=16, num_layers=2, num_heads=2,
+                    d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, scan_layers=False, remat=False)
+    pipe = gpt_pipe_module(cfg, num_stages=num_stages,
+                           partition_method="uniform")
+    engine, _, _, _ = ds.initialize(model=pipe, config={
+        "train_micro_batch_size_per_gpu": 4 // max(1, dp),
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage},
+        "mesh": {"dp": dp, "pp": num_stages if dp > 1 else 1},
+    })
+    return engine, cfg
+
+
+def _leaf_is_dp_sharded(a):
+    spec = a.sharding.spec
+    return any(ax == "dp" or (isinstance(ax, tuple) and "dp" in ax)
+               for ax in spec if ax is not None)
+
+
+@pytest.mark.parametrize("zero_stage", [1, 2])
+def test_pipeline_zero1_matches_dp1(zero_stage):
+    """pp2 x dp4 with ZeRO-1/2 inside the stages must reproduce the pp2 x
+    dp1 numerics exactly: sharding optimizer state (and, stage 2, the grad
+    accumulators) changes layout, never math (reference: ZeRO-1 + BF16
+    optimizer under pipelines, runtime/pipe/engine.py:270)."""
+    e1, cfg = _zero_pipe_engine(num_stages=2, dp=1, zero_stage=0)
+    ez, _ = _zero_pipe_engine(num_stages=2, dp=4, zero_stage=zero_stage)
+    l1 = [float(jax.device_get(e1.train_batch(_token_iter(cfg))))
+          for _ in range(3)]
+    lz = [float(jax.device_get(ez.train_batch(_token_iter(cfg))))
+          for _ in range(3)]
+    np.testing.assert_allclose(l1, lz, rtol=2e-4)
+
+    # optimizer moments are dp-sharded on every stage sub-mesh
+    for s in range(2):
+        mu_leaves = jax.tree.leaves(ez.opt_states[s].mu)
+        assert any(_leaf_is_dp_sharded(a) for a in mu_leaves), \
+            f"stage {s}: no dp-sharded moment leaves under zero{zero_stage}"
+        # params stay replicated for fwd/bwd
+        assert not any(_leaf_is_dp_sharded(a)
+                       for a in jax.tree.leaves(ez.stage_params[s]))
+
+
+def test_pipeline_zero3_rejected():
+    with pytest.raises(ValueError, match="ZeRO-3"):
+        _zero_pipe_engine(num_stages=2, dp=4, zero_stage=3)
