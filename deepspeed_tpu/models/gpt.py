@@ -46,7 +46,7 @@ def _sp_constraint(x, spec_parts):
     quietly degrades to seq-sharded GSPMD (no all-to-all — a different
     comm/memory profile than true Ulysses)."""
     from ..parallel import mesh as mesh_lib
-    mesh = mesh_lib.get_global_mesh()
+    mesh = mesh_lib.get_constraint_mesh()
     shape = dict(mesh.shape)
     if shape.get("sp", 1) == 1:
         return x
@@ -94,7 +94,7 @@ def tp_shard_sequence(x):
     mesh has no tp axis (nothing to partition across, as in the reference
     with mp=1)."""
     from ..parallel import mesh as mesh_lib
-    mesh = mesh_lib.get_global_mesh()
+    mesh = mesh_lib.get_constraint_mesh()
     shape = dict(mesh.shape)
     tp = shape.get("tp", 1)
     if tp <= 1 or x.ndim < 3:

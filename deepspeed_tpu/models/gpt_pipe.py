@@ -22,6 +22,16 @@ from ..runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
 from .gpt import GPTConfig, MLP, SelfAttention, lm_loss_fn
 
 
+def _split_aux(x):
+    """MoE pipelines carry ``(hidden, aux_loss)`` between layers so the
+    load-balancing loss reaches the last stage (the reference returns l_aux
+    from MoE.forward and the training script adds it; through a pipeline the
+    only road is the activation stream)."""
+    if isinstance(x, tuple) and len(x) == 2:
+        return x
+    return x, None
+
+
 class PipeGPTEmbed(nn.Module):
     """Token+position embedding (int input) / tied LM head (float input)."""
     cfg: GPTConfig
@@ -29,6 +39,7 @@ class PipeGPTEmbed(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        x, aux = _split_aux(x)
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wte")
         wpe = self.param("wpe", nn.initializers.normal(0.02),
@@ -36,8 +47,12 @@ class PipeGPTEmbed(nn.Module):
         if jnp.issubdtype(x.dtype, jnp.integer):   # embedding end
             h = wte(x)
             pos = jnp.arange(x.shape[1])
-            return h + wpe[pos][None].astype(cfg.dtype)
-        return wte.attend(x)                        # LM-head end
+            h = h + wpe[pos][None].astype(cfg.dtype)
+            return (h, jnp.zeros((), jnp.float32)) if cfg.moe else h
+        logits = wte.attend(x)                      # LM-head end
+        if aux is not None:
+            return logits, cfg.moe_aux_loss_coef * aux
+        return logits
 
     @staticmethod
     def num_params(cfg: GPTConfig) -> int:
@@ -45,24 +60,49 @@ class PipeGPTEmbed(nn.Module):
 
 
 class PipeGPTBlock(nn.Module):
-    """One transformer block with a single-array interface (x -> x)."""
+    """One transformer block. Interface: x -> x for dense configs; for MoE
+    configs (cfg.moe) the activation is the ``(hidden, aux)`` pair and the
+    block adds its gate's load-balancing loss to the carried aux."""
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = True):
         cfg = self.cfg
+        x, aux = _split_aux(x)
         positions = jnp.arange(x.shape[1])[None, :].repeat(x.shape[0], axis=0)
         h = x + SelfAttention(cfg, name="attn")(
             nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_1")(x),
             positions)
-        return h + MLP(cfg, name="mlp")(
-            nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         param_dtype=cfg.param_dtype, name="ln_2")(h))
+        h2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype, name="ln_2")(h)
+        if cfg.moe:
+            from ..moe.layer import MoE
+            ffn_out, l_aux, _counts = MoE(
+                hidden_size=cfg.d_model,
+                expert=MLP(cfg),
+                num_experts=cfg.num_experts,
+                k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                eval_capacity_factor=cfg.moe_eval_capacity_factor,
+                min_capacity=cfg.moe_min_capacity,
+                use_residual=cfg.moe_use_residual,
+                name="moe")(h2, deterministic=deterministic)
+            out = h + ffn_out
+            carried = l_aux if aux is None else aux + l_aux
+            return out, carried
+        out = h + MLP(cfg, name="mlp")(h2)
+        return (out, aux) if aux is not None else out
 
     @staticmethod
     def num_params(cfg: GPTConfig) -> int:
-        return 12 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff
+        n = 12 * cfg.d_model ** 2
+        if cfg.moe:
+            experts = cfg.num_experts * 2 * cfg.d_model * cfg.d_ff
+            if cfg.moe_use_residual:
+                experts += 2 * cfg.d_model * cfg.d_ff
+            return n + experts + cfg.d_model * cfg.num_experts
+        return n + 2 * cfg.d_model * cfg.d_ff
 
 
 class PipeGPTFinalNorm(nn.Module):
@@ -70,9 +110,11 @@ class PipeGPTFinalNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        return nn.LayerNorm(epsilon=self.cfg.layer_norm_eps,
-                            dtype=self.cfg.dtype,
-                            param_dtype=self.cfg.param_dtype, name="ln_f")(x)
+        x, aux = _split_aux(x)
+        out = nn.LayerNorm(epsilon=self.cfg.layer_norm_eps,
+                           dtype=self.cfg.dtype,
+                           param_dtype=self.cfg.param_dtype, name="ln_f")(x)
+        return (out, aux) if aux is not None else out
 
     @staticmethod
     def num_params(cfg: GPTConfig) -> int:
@@ -85,9 +127,13 @@ class PipeGPTLMHead(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        return nn.Dense(self.cfg.vocab_size, use_bias=False,
-                        dtype=self.cfg.dtype,
-                        param_dtype=self.cfg.param_dtype, name="lm_head")(x)
+        x, aux = _split_aux(x)
+        logits = nn.Dense(self.cfg.vocab_size, use_bias=False,
+                          dtype=self.cfg.dtype,
+                          param_dtype=self.cfg.param_dtype, name="lm_head")(x)
+        if aux is not None:
+            return logits, self.cfg.moe_aux_loss_coef * aux
+        return logits
 
     @staticmethod
     def num_params(cfg: GPTConfig) -> int:

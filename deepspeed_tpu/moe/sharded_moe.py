@@ -221,7 +221,7 @@ def _ep_constraint(x: jnp.ndarray) -> jnp.ndarray:
     this is where XLA emits the dispatch/combine all-to-all (the reference's
     explicit ``_AllToAll.apply``, sharded_moe.py:92-105)."""
     try:
-        mesh = mesh_lib.get_global_mesh()
+        mesh = mesh_lib.get_constraint_mesh()
     except Exception:
         return x
     if "ep" not in mesh.shape or x.shape[0] % max(mesh.shape["ep"], 1):

@@ -105,6 +105,39 @@ def reset_global_mesh() -> None:
     _GLOBAL_SHAPE = None
 
 
+# Model-internal sharding constraints (MoE dispatch, Ulysses, partitioned
+# activations) resolve their mesh here. Default: the process-global mesh.
+# The pipeline engine overrides it per stage program so the SAME model code
+# constrains against the stage sub-mesh (which carries dp/ep/tp axes of its
+# own) — the analogue of the reference's expert groups being built from the
+# pipe topology's stage ranks (runtime/pipe/topology.py:246).
+_CONSTRAINT_MESH: Optional[Mesh] = None
+
+
+class use_constraint_mesh:
+    """Context manager: constraints inside trace against ``mesh``."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        global _CONSTRAINT_MESH
+        self._prev = _CONSTRAINT_MESH
+        _CONSTRAINT_MESH = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _CONSTRAINT_MESH
+        _CONSTRAINT_MESH = self._prev
+        return False
+
+
+def get_constraint_mesh() -> Mesh:
+    return _CONSTRAINT_MESH if _CONSTRAINT_MESH is not None \
+        else get_global_mesh()
+
+
 def axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
     mesh = mesh or get_global_mesh()
     return mesh.shape[axis]

@@ -54,9 +54,24 @@ def _layer_init(layer, rng, x):
     return None  # parameterless
 
 
-def _layer_apply(layer, params, x):
+def _takes_deterministic(layer) -> bool:
+    import inspect
+    try:
+        sig = inspect.signature(type(layer).__call__)
+    except (TypeError, ValueError):
+        return False
+    return "deterministic" in sig.parameters
+
+
+def _layer_apply(layer, params, x, deterministic: bool = True):
     if hasattr(layer, "apply"):
-        return layer.apply({"params": params} if params is not None else {}, x)
+        kw = {}
+        if not deterministic and _takes_deterministic(layer):
+            # train-mode layers (MoE gating capacity factor, dropout) must
+            # see deterministic=False; eval keeps the default
+            kw["deterministic"] = False
+        return layer.apply(
+            {"params": params} if params is not None else {}, x, **kw)
     return layer(x)
 
 
@@ -157,23 +172,29 @@ class PipelineEngine:
 
     # ------------------------------------------------------------ sub-meshes
     def _build_stage_meshes(self):
-        """Slice the global (dp, pp, ep, sp, tp) mesh into one (dp, tp)
+        """Slice the global (dp, pp, ep, sp, tp) mesh into one (dp, ep, tp)
         sub-mesh per stage when the mesh's pp axis matches num_stages;
-        otherwise all stages share the full mesh (CPU tests, pp=1)."""
+        otherwise all stages share the full mesh (CPU tests, pp=1). The ep
+        axis rides into every stage sub-mesh so MoE layers dispatch over it
+        inside the stage programs (reference: expert groups built from the
+        pipe topology, PipeModelDataParallelTopology,
+        runtime/pipe/topology.py:246)."""
         shape = self._mesh_shape
         self._per_stage_mesh = shape.pp == self.num_stages and shape.pp > 1
+        self._stage_dp = shape.dp
+        self._stage_ep = shape.ep
         if not self._per_stage_mesh:
             self.stage_meshes = [self.mesh] * self.num_stages
-            self._stage_dp = shape.dp
             return
-        if shape.ep != 1 or shape.sp != 1:
-            raise NotImplementedError("pp does not compose with ep/sp yet")
+        if shape.sp != 1:
+            raise NotImplementedError(
+                "pp does not compose with sp yet (ring/Ulysses constraints "
+                "assume the stage holds the full sequence)")
         devs = self.mesh.devices  # [dp, pp, ep, sp, tp]
         self.stage_meshes = [
-            Mesh(devs[:, s, 0, 0, :], ("dp", "tp"))
+            Mesh(devs[:, s, :, 0, :], ("dp", "ep", "tp"))
             for s in range(self.num_stages)
         ]
-        self._stage_dp = shape.dp
 
     def _stage_sharding(self, s: int, spec: P) -> NamedSharding:
         return NamedSharding(self.stage_meshes[s], spec)
@@ -193,34 +214,63 @@ class PipelineEngine:
                 jnp.asarray(a), self._stage_sharding(s, self._batch_spec(a))),
             x)
 
-    # ------------------------------------------------------- ZeRO shardings
-    def _zero_dp_spec(self, shape) -> P:
-        """Flat-partition analogue for a stage leaf: the first dim the
-        stage-dp axis divides shards over ``dp`` (reference per-rank
-        partitions, stage_1_and_2.py:228-254)."""
-        if self.zero_stage >= 1 and self._stage_dp > 1:
+    # ------------------------------------------ stage leaf / ZeRO shardings
+    def _stage_leaf_spec(self, path: str, shape, want_dp: bool) -> P:
+        """Structural sharding of one stage-param leaf: expert-stacked
+        leaves shard their expert dim over ``ep`` (reference expert params
+        tagged allreduce=False + reduced over expert-data groups,
+        engine.py:2171-2186); with ``want_dp`` (ZeRO) the first remaining
+        divisible dim shards over stage-dp (flat-partition analogue,
+        stage_1_and_2.py:228-254)."""
+        from ..sharding import _EXPERT_PAT
+        parts = [None] * len(shape)
+        if self._stage_ep > 1 and _EXPERT_PAT.search(path) and shape \
+                and shape[0] % self._stage_ep == 0:
+            parts[0] = "ep"
+        if want_dp and self._stage_dp > 1:
             for i, d in enumerate(shape):
-                if d % self._stage_dp == 0 and d >= self._stage_dp:
-                    return P(*([None] * i + ["dp"]))
-        return P()
+                if parts[i] is None and d % self._stage_dp == 0 \
+                        and d >= self._stage_dp:
+                    parts[i] = "dp"
+                    break
+        return P(*parts)
 
-    def _zero_shard_tree(self, s: int, params):
-        return jax.tree.map(
-            lambda p: self._stage_sharding(s, self._zero_dp_spec(p.shape)),
-            params)
+    def _stage_tree_shardings(self, s: int, params, want_dp: bool):
+        from ..sharding import path_str
+
+        def leaf(pth, p):
+            return self._stage_sharding(
+                s, self._stage_leaf_spec(path_str(pth), tuple(p.shape),
+                                         want_dp))
+        return jax.tree_util.tree_map_with_path(leaf, params)
 
     def _zero_opt_shardings(self, s: int, params, opt_state):
-        """Optimizer-state leaves mirroring a param shape take the param's
-        dp-shard; scalars (step count) replicate."""
-        by_shape = {}
-        for p in jax.tree.leaves(params):
-            by_shape.setdefault(
-                tuple(p.shape),
-                self._stage_sharding(s, self._zero_dp_spec(p.shape)))
+        """Optimizer-state subtrees that mirror the param tree (optax
+        moments) take the param shardings wholesale — matched by tree
+        STRUCTURE, so an expert and a non-expert leaf with colliding shapes
+        cannot swap specs; leftover leaves (step count) replicate."""
+        pst = self._stage_tree_shardings(s, params,
+                                         want_dp=self.zero_stage >= 1)
+        ptreedef = jax.tree_util.tree_structure(params)
         rep = self._stage_sharding(s, P())
-        return jax.tree.map(
-            lambda x: by_shape.get(tuple(getattr(x, "shape", ())), rep),
-            opt_state)
+        if ptreedef.num_leaves <= 1:
+            # degenerate single-leaf model: structure matching can't tell a
+            # moment from the count scalar; match by shape instead
+            leaf = jax.tree.leaves(params)[0]
+            sh = jax.tree.leaves(pst)[0]
+            return jax.tree.map(
+                lambda x: sh if tuple(getattr(x, "shape", ())) ==
+                tuple(leaf.shape) else rep, opt_state)
+
+        def matches(sub):
+            try:
+                return jax.tree_util.tree_structure(sub) == ptreedef
+            except Exception:
+                return False
+
+        return jax.tree_util.tree_map(
+            lambda sub: pst if matches(sub) else rep,
+            opt_state, is_leaf=matches)
 
     # ----------------------------------------------------------- stage build
     def _build_stages(self, model: PipelineModule, rng, model_parameters):
@@ -259,30 +309,32 @@ class PipelineEngine:
                         self.tied_owners[spec.key] = [(s, li)]
                 params.append(p)
                 x = _layer_apply(layer, p, x)
-            repl = self._stage_sharding(s, P())
-            params = jax.tree.map(lambda a: jax.device_put(a, repl), params)
+            psh = self._stage_tree_shardings(s, params, want_dp=False)
+            params = jax.tree.map(jax.device_put, params, psh)
             self.stage_layers.append(layers)
             self.stage_params.append(params)
         self.opt_states = []
-        self._opt_shardings: List[Any] = []      # per stage, ZeRO-1+ sharded
-        self._grad_shardings: List[Any] = []     # per stage, ZeRO-2+ sharded
-        self._param_repl_shardings: List[Any] = []
+        self._opt_shardings: List[Any] = []   # ep for experts; +dp ZeRO-1+
+        self._grad_shardings: List[Any] = []  # ep for experts; +dp ZeRO-2+
+        self._param_shardings: List[Any] = []  # ep for experts, else repl
+        self._step_shardings: List[Any] = []  # shard layout the step runs in
         for s, p in enumerate(self.stage_params):
-            rep = self._stage_sharding(s, P())
             state = self.optimizer.init(p)
-            osh = self._zero_opt_shardings(s, p, state) \
-                if self.zero_stage >= 1 \
-                else jax.tree.map(lambda _: rep, state)
-            gsh = self._zero_shard_tree(s, p) if self.zero_stage >= 2 \
-                else jax.tree.map(lambda _: rep, p)
+            osh = self._zero_opt_shardings(s, p, state)
+            gsh = self._stage_tree_shardings(s, p,
+                                             want_dp=self.zero_stage >= 2)
             self._opt_shardings.append(osh)
             self._grad_shardings.append(gsh)
-            self._param_repl_shardings.append(jax.tree.map(lambda _: rep, p))
+            self._param_shardings.append(
+                self._stage_tree_shardings(s, p, want_dp=False))
+            self._step_shardings.append(
+                self._stage_tree_shardings(s, p,
+                                           want_dp=self.zero_stage >= 1))
             self.opt_states.append(
                 jax.tree.map(jax.device_put, state, osh))
         self._built = True
 
-    def _stage_apply(self, stage_id: int):
+    def _stage_apply(self, stage_id: int, deterministic: bool = True):
         layers = self.stage_layers[stage_id]
         cdt = self.compute_dtype
 
@@ -293,17 +345,32 @@ class PipelineEngine:
                                            if jnp.issubdtype(a.dtype, jnp.floating)
                                            else a, params_list)
             for layer, p in zip(layers, params_list):
-                x = _layer_apply(layer, p, x)
+                x = _layer_apply(layer, p, x, deterministic=deterministic)
             return x
 
         return apply
 
     # ---------------------------------------------------------- jitted progs
-    def _fwd_prog(self, s: int):
-        """out = stage_s(params, x); on the last stage returns the loss."""
-        if s in self._jit_fwd:
-            return self._jit_fwd[s]
-        apply = self._stage_apply(s)
+    def _wrap_stage(self, s: int, jitted):
+        """Model-internal sharding constraints (MoE dispatch all-to-all,
+        partitioned activations) must resolve against the STAGE sub-mesh —
+        the global mesh names different devices. The context only matters
+        while the first call traces; re-entering it afterwards is free."""
+        mesh = self.stage_meshes[s]
+
+        def wrapped(*args):
+            with mesh_lib.use_constraint_mesh(mesh):
+                return jitted(*args)
+        return wrapped
+
+    def _fwd_prog(self, s: int, deterministic: bool = True):
+        """out = stage_s(params, x); on the last stage returns the loss.
+        Train forwards run deterministic=False (MoE train capacity factor,
+        dropout) and must match the backward's in-jit replay."""
+        key = (s, deterministic)
+        if key in self._jit_fwd:
+            return self._jit_fwd[key]
+        apply = self._stage_apply(s, deterministic)
         last = s == self.num_stages - 1
         loss_fn = self.loss_fn
 
@@ -315,8 +382,8 @@ class PipelineEngine:
             def fwd(params_list, x):
                 return apply(params_list, x)
 
-        self._jit_fwd[s] = jax.jit(fwd)
-        return self._jit_fwd[s]
+        self._jit_fwd[key] = self._wrap_stage(s, jax.jit(fwd))
+        return self._jit_fwd[key]
 
     def _bwd_prog(self, s: int):
         """(new_acc, dx) from (params, x, g_or_labels, acc). Recomputes the
@@ -325,7 +392,7 @@ class PipelineEngine:
         all-reduce is inserted by XLA here."""
         if s in self._jit_bwd:
             return self._jit_bwd[s]
-        apply = self._stage_apply(s)
+        apply = self._stage_apply(s, deterministic=False)  # train replay
         last = s == self.num_stages - 1
         loss_fn = self.loss_fn
 
@@ -347,14 +414,14 @@ class PipelineEngine:
                     lambda a, g2: a + g2.astype(jnp.float32), acc, dparams)
                 return new_acc, dx
 
-        out_sh = None
-        if self.zero_stage >= 2:
-            # ZeRO-2: the accumulators stay dp-sharded; constraining the
-            # output turns the in-program dp grad psum into a reduce-scatter
-            out_sh = (self._grad_shardings[s], None, None) if last \
-                else (self._grad_shardings[s], None)
-        self._jit_bwd[s] = jax.jit(bwd, donate_argnums=(3,),
-                                   out_shardings=out_sh)
+        # accumulators keep their layout: ep-sharded expert leaves always
+        # (expert grads reduce over stage-dp only — each ep rank owns its
+        # experts); ZeRO-2 adds dp sharding, turning the in-program dp grad
+        # psum into a reduce-scatter
+        out_sh = (self._grad_shardings[s], None, None) if last \
+            else (self._grad_shardings[s], None)
+        self._jit_bwd[s] = self._wrap_stage(s, jax.jit(
+            bwd, donate_argnums=(3,), out_shardings=out_sh))
         return self._jit_bwd[s]
 
     def _step_prog(self, s: int):
@@ -363,8 +430,7 @@ class PipelineEngine:
         M = float(self.micro_batches)
         opt = self.optimizer
         zero = self.zero_stage
-        shard_tree = self._zero_shard_tree(s, self.stage_params[s]) \
-            if zero >= 1 else None
+        shard_tree = self._step_shardings[s] if zero >= 1 else None
 
         def step(params_list, opt_state, acc):
             grads = jax.tree.map(lambda g: g / M, acc)
@@ -380,10 +446,9 @@ class PipelineEngine:
             new_params = optax.apply_updates(params_list, updates)
             return new_params, new_opt
 
-        out_sh = (self._param_repl_shardings[s], self._opt_shardings[s]) \
-            if zero >= 1 else None
-        self._jit_step[s] = jax.jit(step, donate_argnums=(0, 1),
-                                    out_shardings=out_sh)
+        out_sh = (self._param_shardings[s], self._opt_shardings[s])
+        self._jit_step[s] = self._wrap_stage(s, jax.jit(
+            step, donate_argnums=(0, 1), out_shardings=out_sh))
         return self._jit_step[s]
 
     # ------------------------------------------------------------- training
@@ -455,7 +520,8 @@ class PipelineEngine:
                 _, labels = self._split_batch(micros[m])
                 acts[("labels", m)] = self._put_stage(labels, s)
                 return total_loss
-            out = self._fwd_prog(s)(self.stage_params[s], x)
+            out = self._fwd_prog(s, deterministic=False)(
+                self.stage_params[s], x)
             # SendActivation / RecvActivation: hop onto the next stage's mesh
             acts[(s + 1, m)] = self._put_stage(out, s + 1)
             return total_loss

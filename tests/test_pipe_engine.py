@@ -323,3 +323,62 @@ def test_pipeline_zero1_matches_dp1(zero_stage):
 def test_pipeline_zero3_rejected():
     with pytest.raises(ValueError, match="ZeRO-3"):
         _zero_pipe_engine(num_stages=2, dp=4, zero_stage=3)
+
+
+def _moe_pipe_engine(num_stages, dp, ep, gas=4):
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.models.gpt_pipe import gpt_pipe_module
+
+    cfg = GPTConfig(vocab_size=64, max_seq_len=16, num_layers=2, num_heads=2,
+                    d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, scan_layers=False, remat=False,
+                    moe=True, num_experts=4, moe_top_k=1,
+                    moe_capacity_factor=2.0)
+    pipe = gpt_pipe_module(cfg, num_stages=num_stages,
+                           partition_method="uniform")
+    engine, _, _, _ = ds.initialize(model=pipe, config={
+        "train_micro_batch_size_per_gpu": 4 // max(1, dp),
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"dp": dp, "pp": num_stages, "ep": ep},
+    })
+    return engine, cfg
+
+
+def test_pipeline_moe_ep_trains():
+    """pp2 x dp2 x ep2: MoE blocks dispatch over the stage sub-mesh's ep
+    axis; expert banks are ep-sharded per stage; training converges
+    (reference: MoE under pipeline+expert parallel via
+    PipeModelDataParallelTopology, runtime/pipe/topology.py:246)."""
+    e, cfg = _moe_pipe_engine(num_stages=2, dp=2, ep=2)
+    it = _token_iter(cfg, bs=4)
+    losses = [float(jax.device_get(e.train_batch(it))) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    assert e._per_stage_mesh and e._stage_ep == 2
+    # expert banks are sharded over ep on every stage that owns them
+    found_expert = False
+    for s in range(2):
+        flat, _ = jax.tree_util.tree_flatten_with_path(e.stage_params[s])
+        for pth, leaf in flat:
+            from deepspeed_tpu.runtime.sharding import path_str, _EXPERT_PAT
+            if _EXPERT_PAT.search(path_str(pth)):
+                found_expert = True
+                spec = leaf.sharding.spec
+                assert any(ax == "ep" for ax in spec if ax is not None), \
+                    f"expert leaf {path_str(pth)} not ep-sharded: {spec}"
+    assert found_expert
+
+
+def test_pipeline_moe_pp2_matches_pp1():
+    """Same data, same global batch: pp2 x ep2 must reproduce pp1 x ep2
+    numerics — stage placement of MoE layers changes where experts live,
+    not the math."""
+    e1, cfg = _moe_pipe_engine(num_stages=1, dp=4, ep=2)
+    e2, _ = _moe_pipe_engine(num_stages=2, dp=2, ep=2)
+    l1 = [float(jax.device_get(e1.train_batch(_token_iter(cfg))))
+          for _ in range(3)]
+    l2 = [float(jax.device_get(e2.train_batch(_token_iter(cfg))))
+          for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
