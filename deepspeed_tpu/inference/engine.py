@@ -39,18 +39,31 @@ from ..utils.logging import log_dist
 
 class InferenceEngine:
     def __init__(self, model, config=None, *, mp_size: int = 1,
+                 ep_size: int = 1,
                  dtype=jnp.bfloat16, model_parameters=None,
                  checkpoint: Optional[str] = None,
                  replace_with_kernel_inject: bool = False,
                  injection_policy=None, quantize_bits: Optional[int] = None,
                  max_tokens: Optional[int] = None,
                  replace_method: Optional[str] = None):
+        """``ep_size``: expert-parallel degree for MoE models (reference
+        InferenceEngine EP group creation, inference/engine.py:166, and the
+        dedicated MoE inference module, moe_inference.py:210). Expert banks
+        shard their expert dim over the mesh's ``ep`` axis — per-device
+        expert HBM divides by ep_size — and the dispatch/combine all-to-all
+        runs inside the jitted prefill/decode programs."""
+        if replace_method == "auto" and ep_size > 1:
+            raise ValueError(
+                "ep_size > 1 with replace_method='auto' is unsupported: "
+                "auto-TP classifies plain Linear kernels and knows nothing "
+                "about expert banks; use the native MoE model path")
         comm.init_distributed()
         n_dev = len(jax.devices())
-        shape = mesh_lib.MeshShape.infer(n_dev, tp=mp_size)
+        shape = mesh_lib.MeshShape.infer(n_dev, tp=mp_size, ep=ep_size)
         self.mesh = mesh_lib.build_mesh(shape)
         mesh_lib.set_global_mesh(self.mesh, shape)
         self.mp_world_size = mp_size
+        self.ep_world_size = ep_size
         self.module = model
         self.dtype = dtype
         self.rules = ShardingRules(self.mesh, zero_stage=0)
@@ -78,6 +91,22 @@ class InferenceEngine:
         else:
             self.param_shardings = self.rules.shardings(
                 self.rules.param_specs(params))
+            if ep_size > 1:
+                # an ep axis that shards nothing is a misconfiguration, not
+                # a degradation to silently absorb: the operator believes
+                # expert HBM divided by ep when every bank stayed replicated
+                # (no MoE layers, or num_experts % ep_size != 0)
+                specs = jax.tree.leaves(self.rules.param_specs(params),
+                                        is_leaf=lambda x: isinstance(x, P))
+                if not any("ep" in tuple(ax for e in s for ax in
+                                         ((e,) if isinstance(e, str)
+                                          else (e or ())))
+                           for s in specs):
+                    raise ValueError(
+                        f"ep_size={ep_size} sharded no parameter: the model "
+                        f"has no expert banks whose expert dim divides by "
+                        f"{ep_size} (check num_experts % ep_size == 0, or "
+                        f"drop ep_size)")
         if quantize_bits == 8:
             from ..ops.quantizer import quantize_shardings, quantize_tree
             # int8 weights live in HBM; dequant happens INSIDE the jitted
@@ -98,7 +127,7 @@ class InferenceEngine:
         self._jit_prefill = None
         self._jit_decode = {}          # keyed by (temperature, top_k)
         self.cache = None
-        log_dist(f"inference engine ready: tp={mp_size} "
+        log_dist(f"inference engine ready: tp={mp_size} ep={ep_size} "
                  f"dtype={jnp.dtype(dtype).name} quantized={self.quantized}",
                  ranks=[0])
 

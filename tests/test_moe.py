@@ -245,3 +245,63 @@ def test_moe_inference_generate():
     assert out1.shape == (2, 11)
     np.testing.assert_array_equal(out1, out2)
     np.testing.assert_array_equal(out1[:, :5], ids)
+
+
+def test_moe_inference_ep2_matches_ep1():
+    """Expert parallelism at inference (reference InferenceEngine EP groups,
+    inference/engine.py:166): ep2 shards each expert bank's expert dim over
+    the ep axis — per-device expert HBM divides by ep — and produces the
+    SAME logits and generations as the replicated ep1 engine."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    from deepspeed_tpu.runtime.sharding import _EXPERT_PAT, path_str
+
+    cfg = GPTConfig(vocab_size=64, max_seq_len=32, num_layers=2,
+                    num_heads=2, d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, remat=False, moe=True,
+                    num_experts=4, moe_top_k=1)
+    model = GPT(cfg)
+    ids = np.random.default_rng(0).integers(0, 64, (2, 5)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+
+    e1 = ds.init_inference(model, model_parameters=params, dtype=jnp.float32)
+    l1 = np.asarray(e1.forward(ids))
+    g1 = np.asarray(e1.generate(ids, max_new_tokens=6, temperature=0.0))
+
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh_lib.reset_global_mesh()
+    e2 = ds.init_inference(model, model_parameters=params, dtype=jnp.float32,
+                           ep_size=2)
+    assert e2.ep_world_size == 2
+
+    # expert banks are ep-sharded: each device holds 1/ep of the experts
+    found = False
+    flat, _ = jax.tree_util.tree_flatten_with_path(e2.params)
+    for pth, leaf in flat:
+        if _EXPERT_PAT.search(path_str(pth)):
+            found = True
+            spec = leaf.sharding.spec
+            assert any(ax == "ep" for ax in spec if ax is not None), \
+                f"expert leaf {path_str(pth)} not ep-sharded: {spec}"
+            local = leaf.addressable_shards[0].data.size
+            assert local * 2 == leaf.size, \
+                "per-device expert HBM must divide by ep"
+    assert found, "no expert leaves found"
+
+    l2 = np.asarray(e2.forward(ids))
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-5)
+    g2 = np.asarray(e2.generate(ids, max_new_tokens=6, temperature=0.0))
+    np.testing.assert_array_equal(g1, g2)
+
+
+def test_moe_inference_auto_tp_rejects_ep():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    cfg = GPTConfig(vocab_size=64, max_seq_len=32, num_layers=1, num_heads=2,
+                    d_model=32, d_ff=64, moe=True, num_experts=4)
+    model = GPT(cfg)
+    ids = np.zeros((1, 4), np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+    with pytest.raises(ValueError, match="auto"):
+        ds.init_inference(model, model_parameters=params,
+                          replace_method="auto", ep_size=2)
