@@ -89,14 +89,14 @@ class InferenceEngine:
             from ..module_inject.auto_tp import auto_tp_shardings
             self.param_shardings = auto_tp_shardings(params, self.mesh)
         else:
-            self.param_shardings = self.rules.shardings(
-                self.rules.param_specs(params))
+            param_specs = self.rules.param_specs(params)
+            self.param_shardings = self.rules.shardings(param_specs)
             if ep_size > 1:
                 # an ep axis that shards nothing is a misconfiguration, not
                 # a degradation to silently absorb: the operator believes
                 # expert HBM divided by ep when every bank stayed replicated
                 # (no MoE layers, or num_experts % ep_size != 0)
-                specs = jax.tree.leaves(self.rules.param_specs(params),
+                specs = jax.tree.leaves(param_specs,
                                         is_leaf=lambda x: isinstance(x, P))
                 if not any("ep" in tuple(ax for e in s for ax in
                                          ((e,) if isinstance(e, str)

@@ -44,13 +44,19 @@ class OnebitRunner:
                     f"1-bit optimizers communicate over the dp axis only; "
                     f"mesh has {ax}={dict(self.mesh.shape)[ax]} (reference "
                     f"parity: 1-bit Adam/LAMB are pure-DP optimizers)")
-        if engine.fp16_enabled:
+        if engine.fp16_enabled and engine.dynamic_loss_scale:
             raise ValueError(
-                "1-bit optimizers need a deterministic step schedule and an "
-                "overflow-free gradient path: fp16 loss scaling either skips "
-                "steps data-dependently (dynamic) or lets a single overflow "
-                "poison the error-feedback buffers (static). Use bf16 — the "
-                "TPU-idiomatic precision — or fp32.")
+                "1-bit optimizers need a deterministic phase schedule: "
+                "DYNAMIC fp16 loss scaling skips steps data-dependently and "
+                "re-scales mid-run, which desynchronizes the error-feedback "
+                "buffers across ranks. Use a static loss_scale (reference "
+                "1-bit Adam is an fp16 feature, fp16/onebit/adam.py:14) or "
+                "bf16 — the TPU-idiomatic precision.")
+        # fp16 static scale: grads are produced at fixed scale and unscaled
+        # in-graph; a rank-wide finite guard skips the update on overflow so
+        # a stray inf never enters the error-feedback buffers (the "poison"
+        # the previous blanket rejection guarded against)
+        self._finite_guard = engine.fp16_enabled
         if engine.gradient_clipping():
             raise ValueError(
                 "gradient_clipping is unsupported with 1-bit optimizers: in "
@@ -156,6 +162,7 @@ class OnebitRunner:
         axis = self.AXIS
         lr_fn = self._lr_fn()
         n = self.n
+        guard = self._finite_guard
 
         def per_rank(master_flat, ob, batches_l, rng, scale, count):
             ob = {k: v[0] for k, v in ob.items()}
@@ -183,10 +190,21 @@ class OnebitRunner:
             gpad = jnp.zeros((opt.npad,), jnp.float32).at[:n].set(g)
             new_p, new_ob = opt.step(mode, gpad, ob, master_flat,
                                      lr_fn(count), count, axis)
+            finite = jnp.asarray(True)
+            if guard:
+                # overflow on ANY rank skips the whole update — masters,
+                # momentum and error buffers stay untouched (reference
+                # overflow-skip semantics, engine.py:1798, without letting
+                # inf reach the compressed exchange's state)
+                finite = jax.lax.pmean(
+                    jnp.isfinite(g).all().astype(jnp.float32), axis) == 1.0
+                new_p = jnp.where(finite, new_p, master_flat)
+                new_ob = {k: jnp.where(finite, v, ob[k])
+                          for k, v in new_ob.items()}
             loss_g = jax.lax.pmean(loss_sum / (gas * scale), axis)
             gnorm = jnp.sqrt(jax.lax.pmean(jnp.sum(g * g), axis))
             return (new_p, {k: v[None] for k, v in new_ob.items()},
-                    rng, loss_g, gnorm)
+                    rng, loss_g, gnorm, finite)
 
         ob_specs = {k: P("dp", *([None] * len(shp)))
                     for k, shp in self._ob_local_shapes.items()}
@@ -195,10 +213,10 @@ class OnebitRunner:
             master_flat = self._flatten(state["master"])
             batch_specs = jax.tree.map(
                 lambda x: P(None, "dp", *([None] * (x.ndim - 2))), batches)
-            new_flat, new_ob, rng, loss, gnorm = shard_map(
+            new_flat, new_ob, rng, loss, gnorm, finite = shard_map(
                 per_rank, mesh=self.mesh,
                 in_specs=(P(), ob_specs, batch_specs, P(), P(), P()),
-                out_specs=(P(), ob_specs, P(), P(), P()),
+                out_specs=(P(), ob_specs, P(), P(), P(), P()),
                 check_vma=False)(
                     master_flat, state["opt"], batches, state["rng"],
                     state["scale"].cur_scale, state["step"] + 1)
@@ -208,10 +226,11 @@ class OnebitRunner:
                 "scale": state["scale"],
                 "rng": rng,
                 "step": state["step"] + 1,
-                "skipped": state["skipped"],
+                "skipped": state["skipped"]
+                + (1 - finite.astype(jnp.int32)),
             }
             return new_state, {"loss": loss, "grad_norm": gnorm,
-                               "finite": jnp.asarray(True)}
+                               "finite": finite}
 
         return jax.jit(step_fn, donate_argnums=(0,),
                        out_shardings=(self._state_shardings, None))

@@ -184,13 +184,47 @@ def test_zeroone_policy_restore(tmp_path):
            (p2.step, p2.var_interval, p2.local_interval, p2.frozen)
 
 
-def test_onebit_rejects_fp16_and_clipping():
-    with pytest.raises(ValueError, match="fp16|bf16"):
+def test_onebit_rejects_dynamic_fp16_and_clipping():
+    # loss_scale=0 => DYNAMIC scaling: data-dependent skips desync the
+    # error-feedback buffers, still rejected; static scale is supported
+    with pytest.raises(ValueError, match="DYNAMIC|dynamic"):
         _run("OneBitAdam", steps=1,
-             config_extra={"fp16": {"enabled": True, "loss_scale": 128}})
+             config_extra={"fp16": {"enabled": True, "loss_scale": 0}})
     with pytest.raises(ValueError, match="clip"):
         _run("OneBitAdam", steps=1,
              config_extra={"gradient_clipping": 1.0})
+
+
+def test_onebit_fp16_static_scale():
+    """Reference 1-bit Adam is an fp16 feature (fp16/onebit/adam.py:14):
+    with a STATIC loss scale the phase schedule stays deterministic and the
+    compressed phase converges; grads are produced at fixed scale and
+    unscaled in-graph."""
+    engine, losses = _run(
+        "OneBitAdam", {"freeze_step": 30}, steps=80,
+        config_extra={"fp16": {"enabled": True, "loss_scale": 1024}})
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] / 10, losses[::16]
+    assert engine._onebit.comm_bytes["compressed"] > 0
+    assert int(jax.device_get(engine.state["skipped"])) == 0
+
+
+def test_onebit_fp16_overflow_skips_step():
+    """A loss scale big enough to overflow fp16 grads must SKIP the update
+    (masters and error buffers untouched) rather than poison the
+    error-feedback state with infs."""
+    engine, losses = _run(
+        "OneBitAdam", {"freeze_step": 1000}, steps=3,
+        config_extra={"fp16": {"enabled": True, "loss_scale": 2.0 ** 24}})
+    skipped = int(jax.device_get(engine.state["skipped"]))
+    assert skipped == 3, f"expected every step skipped, got {skipped}"
+    # masters unchanged from init
+    model = _Linear()
+    init = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16)))["params"]
+    for a, b in zip(jax.tree.leaves(init),
+                    jax.tree.leaves(engine.state["master"])):
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b))
 
 
 # ---------------------------------------------------------------- 0/1 Adam
