@@ -39,6 +39,22 @@ class BertConfig:
     dtype: any = jnp.float32
     param_dtype: any = jnp.float32
     scan_layers: bool = True
+    # "sparse" routes every encoder layer through the block-sparse Pallas
+    # kernel with the (padded) attention_mask as its key-padding mask — the
+    # reference's BertSparseSelfAttention integration
+    # (ops/sparse_attention/sparse_self_attention.py:13 +
+    # sparse_attention_utils.py:225). Pad inputs with
+    # SparseAttentionUtils.pad_to_block_size first.
+    attention_impl: str = "xla"      # xla | sparse
+    sparse_attention: any = None     # SparsityConfig when attention_impl=sparse
+
+    def __post_init__(self):
+        if self.attention_impl not in ("xla", "sparse"):
+            raise ValueError(f"unknown attention_impl "
+                             f"{self.attention_impl!r}")
+        if self.attention_impl == "sparse" and self.sparse_attention is None:
+            raise ValueError("attention_impl='sparse' needs a "
+                             "sparse_attention SparsityConfig")
 
     @property
     def head_dim(self) -> int:
@@ -66,15 +82,24 @@ class BertSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (b, s, cfg.num_heads, cfg.head_dim)
         q, k, v = q.reshape(shp), k.reshape(shp), v.reshape(shp)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
-        logits = logits / math.sqrt(cfg.head_dim)
-        if attention_mask is not None:
-            logits = jnp.where(attention_mask[:, None, None, :], logits,
-                               -1e10)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s, -1)
+        if cfg.attention_impl == "sparse":
+            from ..ops.sparse_attention.sparse_self_attention import \
+                sparse_attention
+            out = sparse_attention(
+                q, k, v, cfg.sparse_attention,
+                sm_scale=1.0 / math.sqrt(cfg.head_dim),
+                causal=False, key_padding_mask=attention_mask)
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+            logits = logits / math.sqrt(cfg.head_dim)
+            if attention_mask is not None:
+                logits = jnp.where(attention_mask[:, None, None, :], logits,
+                                   -1e10)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return nn.Dense(cfg.d_model, dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype, name="out_proj")(out)
+                        param_dtype=cfg.param_dtype,
+                        name="out_proj")(out.reshape(b, s, -1))
 
 
 class BertLayer(nn.Module):

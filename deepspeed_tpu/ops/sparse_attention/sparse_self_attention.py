@@ -69,9 +69,12 @@ def _tile_mask(fine_tile, cb, bq, bk, qi, kj, causal):
 # LUT of live column tiles. Scalar-prefetch args: lut [H, n, L], cnt [H, n].
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, o_ref,
-                lse_ref, m_scr, l_scr, acc_scr, *, scale, cb, block_q,
-                block_k, causal):
+def _fwd_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, *rest,
+                scale, cb, block_q, block_k, causal, use_mask=False):
+    if use_mask:
+        kvm_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     hi, qi, t = pl.program_id(1), pl.program_id(2), pl.program_id(3)
     nt = pl.num_programs(3)
 
@@ -91,6 +94,10 @@ def _fwd_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, o_ref,
                                 preferred_element_type=jnp.float32) * scale
         mask = _tile_mask(fine_ref[0, 0, 0], cb, block_q, block_k, qi, kj,
                           causal)
+        if use_mask:
+            # key-padding mask (reference SparseSelfAttention
+            # key_padding_mask): masked keys drop out of this k-tile
+            mask = jnp.logical_and(mask, (kvm_ref[0, 0] > 0)[None, :])
         s = jnp.where(mask, s, NEG_INF)
         m_prev, l_prev = m_scr[...], l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -113,8 +120,12 @@ def _fwd_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _bwd_dq_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
-                   lse_ref, delta_ref, dq_ref, dq_scr, *, scale, cb,
-                   block_q, block_k, causal):
+                   lse_ref, delta_ref, *rest, scale, cb,
+                   block_q, block_k, causal, use_mask=False):
+    if use_mask:
+        kvm_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     hi, qi, t = pl.program_id(1), pl.program_id(2), pl.program_id(3)
     nt = pl.num_programs(3)
 
@@ -135,6 +146,11 @@ def _bwd_dq_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
                                 preferred_element_type=jnp.float32) * scale
         mask = _tile_mask(fine_ref[0, 0, 0], cb, block_q, block_k, qi, kj,
                           causal)
+        if use_mask:
+            mask = jnp.logical_and(mask, (kvm_ref[0, 0] > 0)[None, :])
+        # dead-row guard: a fully-masked query has lse = -inf; exp(s - lse)
+        # would overflow instead of vanishing
+        mask = jnp.logical_and(mask, (lse > NEG_INF / 2)[:, None])
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -148,8 +164,12 @@ def _bwd_dq_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
 
 
 def _bwd_dkv_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
-                    lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                    scale, cb, block_q, block_k, causal):
+                    lse_ref, delta_ref, *rest, scale, cb, block_q, block_k,
+                    causal, use_mask=False):
+    if use_mask:
+        kvm_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     hi, ki, t = pl.program_id(1), pl.program_id(2), pl.program_id(3)
     nt = pl.num_programs(3)
 
@@ -171,6 +191,9 @@ def _bwd_dkv_kernel(lut_ref, cnt_ref, fine_ref, q_ref, k_ref, v_ref, do_ref,
                                 preferred_element_type=jnp.float32) * scale
         mask = _tile_mask(fine_ref[0, 0, 0], cb, block_q, block_k, qi, ki,
                           causal)
+        if use_mask:
+            mask = jnp.logical_and(mask, (kvm_ref[0, 0] > 0)[None, :])
+        mask = jnp.logical_and(mask, (lseb > NEG_INF / 2)[:, None])
         p = jnp.where(mask, jnp.exp(s - lseb[:, None]), 0.0)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
@@ -240,7 +263,7 @@ class _CompiledLayout:
         return jnp.asarray(lut), jnp.asarray(counts)
 
 
-def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale):
+def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale, kvm=None):
     b, s, h, d = q.shape
     bq, bk, cb = layout.bq, layout.bk, layout.cb
     qt = q.transpose(0, 2, 1, 3)
@@ -249,23 +272,32 @@ def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale):
     nq = s // bq
     L = layout.lut_k.shape[-1]
     fq, fk = bq // cb, bk // cb
+    use_mask = kvm is not None
+
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, fq, fk),
+                     lambda bi, hi, qi, t, lut, cnt:
+                     (hi, qi, lut[hi, qi, t], 0, 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, t, lut, cnt:
+                     (bi, hi, lut[hi, qi, t], 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, t, lut, cnt:
+                     (bi, hi, lut[hi, qi, t], 0)),
+    ]
+    operands = [layout.lut_k, layout.cnt_k, layout.fine_tiles, qt, kt, vt]
+    if use_mask:
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda bi, hi, qi, t, lut, cnt:
+            (bi, 0, lut[hi, qi, t])))
+        operands.append(kvm[:, None, :])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, nq, L),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, fq, fk),
-                         lambda bi, hi, qi, t, lut, cnt:
-                         (hi, qi, lut[hi, qi, t], 0, 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, t, lut, cnt:
-                         (bi, hi, lut[hi, qi, t], 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, t, lut, cnt:
-                         (bi, hi, lut[hi, qi, t], 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d),
                          lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
@@ -279,7 +311,7 @@ def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale):
         ],
     )
     kernel = functools.partial(_fwd_kernel, scale=scale, cb=cb, block_q=bq,
-                               block_k=bk, causal=causal)
+                               block_k=bk, causal=causal, use_mask=use_mask)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -288,11 +320,11 @@ def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale):
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(layout.lut_k, layout.cnt_k, layout.fine_tiles, qt, kt, vt)
+    )(*operands)
     return out.transpose(0, 2, 1, 3), (qt, kt, vt, out, lse)
 
 
-def _sparse_bwd(layout: _CompiledLayout, causal, scale, res, g):
+def _sparse_bwd(layout: _CompiledLayout, causal, scale, res, g, kvm=None):
     qt, kt, vt, out, lse = res
     b, h, s, d = qt.shape
     bq, bk, cb = layout.bq, layout.bk, layout.cb
@@ -303,29 +335,39 @@ def _sparse_bwd(layout: _CompiledLayout, causal, scale, res, g):
     fq, fk = bq // cb, bk // cb
     L = layout.lut_k.shape[-1]
     Lq = layout.lut_q.shape[-1]
+    use_mask = kvm is not None
+
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, 1, fq, fk),
+                     lambda bi, hi, qi, t, lut, cnt:
+                     (hi, qi, lut[hi, qi, t], 0, 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, t, lut, cnt:
+                     (bi, hi, lut[hi, qi, t], 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, t, lut, cnt:
+                     (bi, hi, lut[hi, qi, t], 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, 1),
+                     lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, 1),
+                     lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
+    ]
+    dq_operands = [layout.lut_k, layout.cnt_k, layout.fine_tiles, qt, kt,
+                   vt, dot, lse, delta]
+    if use_mask:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda bi, hi, qi, t, lut, cnt:
+            (bi, 0, lut[hi, qi, t])))
+        dq_operands.append(kvm[:, None, :])
 
     dq_grid = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, nq, L),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, fq, fk),
-                         lambda bi, hi, qi, t, lut, cnt:
-                         (hi, qi, lut[hi, qi, t], 0, 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, t, lut, cnt:
-                         (bi, hi, lut[hi, qi, t], 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, t, lut, cnt:
-                         (bi, hi, lut[hi, qi, t], 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, qi, t, lut, cnt: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, hi, qi, t, lut, cnt:
                                (bi, hi, qi, 0)),
@@ -333,37 +375,44 @@ def _sparse_bwd(layout: _CompiledLayout, causal, scale, res, g):
     )
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, cb=cb, block_q=bq,
-                          block_k=bk, causal=causal),
+                          block_k=bk, causal=causal, use_mask=use_mask),
         grid_spec=dq_grid,
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
         interpret=interpret_mode(),
-    )(layout.lut_k, layout.cnt_k, layout.fine_tiles, qt, kt, vt, dot, lse,
-      delta)
+    )(*dq_operands)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, 1, fq, fk),
+                     lambda bi, hi, ki, t, lut, cnt:
+                     (hi, lut[hi, ki, t], ki, 0, 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, ki, t, lut, cnt:
+                     (bi, hi, lut[hi, ki, t], 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, ki, t, lut, cnt:
+                     (bi, hi, lut[hi, ki, t], 0)),
+        pl.BlockSpec((1, 1, bq, 1),
+                     lambda bi, hi, ki, t, lut, cnt:
+                     (bi, hi, lut[hi, ki, t], 0)),
+        pl.BlockSpec((1, 1, bq, 1),
+                     lambda bi, hi, ki, t, lut, cnt:
+                     (bi, hi, lut[hi, ki, t], 0)),
+    ]
+    dkv_operands = [layout.lut_q, layout.cnt_q, layout.fine_tiles, qt, kt,
+                    vt, dot, lse, delta]
+    if use_mask:
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda bi, hi, ki, t, lut, cnt: (bi, 0, ki)))
+        dkv_operands.append(kvm[:, None, :])
 
     dkv_grid = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, nk, Lq),
-        in_specs=[
-            pl.BlockSpec((1, 1, 1, fq, fk),
-                         lambda bi, hi, ki, t, lut, cnt:
-                         (hi, lut[hi, ki, t], ki, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t, lut, cnt:
-                         (bi, hi, lut[hi, ki, t], 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t, lut, cnt:
-                         (bi, hi, lut[hi, ki, t], 0)),
-            pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, ki, t, lut, cnt:
-                         (bi, hi, lut[hi, ki, t], 0)),
-            pl.BlockSpec((1, 1, bq, 1),
-                         lambda bi, hi, ki, t, lut, cnt:
-                         (bi, hi, lut[hi, ki, t], 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, ki, t, lut, cnt: (bi, hi, ki, 0)),
@@ -375,15 +424,14 @@ def _sparse_bwd(layout: _CompiledLayout, causal, scale, res, g):
     )
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, cb=cb, block_q=bq,
-                          block_k=bk, causal=causal),
+                          block_k=bk, causal=causal, use_mask=use_mask),
         grid_spec=dkv_grid,
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), kt.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), vt.dtype),
         ],
         interpret=interpret_mode(),
-    )(layout.lut_q, layout.cnt_q, layout.fine_tiles, qt, kt, vt, dot, lse,
-      delta)
+    )(*dkv_operands)
 
     tr = lambda x: x.transpose(0, 2, 1, 3)
     return tr(dq), tr(dk), tr(dv)
@@ -406,9 +454,30 @@ def _sparse_attn_bwd(layout, causal, scale, res, g):
 _sparse_attn.defvjp(_sparse_attn_fwd, _sparse_attn_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _sparse_attn_masked(q, k, v, kvm, layout, causal, scale):
+    out, _ = _sparse_fwd(q, k, v, layout, causal, scale, kvm=kvm)
+    return out
+
+
+def _sparse_attn_masked_fwd(q, k, v, kvm, layout, causal, scale):
+    out, res = _sparse_fwd(q, k, v, layout, causal, scale, kvm=kvm)
+    return out, (res, kvm)
+
+
+def _sparse_attn_masked_bwd(layout, causal, scale, res_kvm, g):
+    res, kvm = res_kvm
+    dq, dk, dv = _sparse_bwd(layout, causal, scale, res, g, kvm=kvm)
+    return dq, dk, dv, jnp.zeros_like(kvm)
+
+
+_sparse_attn_masked.defvjp(_sparse_attn_masked_fwd, _sparse_attn_masked_bwd)
+
+
 def sparse_attention(q, k, v, sparsity_config: SparsityConfig,
                      sm_scale: Optional[float] = None,
-                     causal: Optional[bool] = None):
+                     causal: Optional[bool] = None,
+                     key_padding_mask=None):
     """Block-sparse attention. q, k, v: [B, S, H, D] -> [B, S, H, D].
 
     ``causal=None`` derives causality from ``sparsity_config.attention``;
@@ -417,6 +486,12 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig,
     skipped). Compiled layouts (LUTs) are cached per (seq_len, causal) on
     the config, mirroring the reference's master-layout buffering
     (sparse_self_attention.py:57).
+
+    ``key_padding_mask``: optional [B, S] (1 = attend, 0 = masked key) —
+    the reference ``SparseSelfAttention.forward`` key_padding_mask, used by
+    the BERT family after ``SparseAttentionUtils.pad_to_block_size``.
+    Masked keys drop out elementwise inside the kernel tiles; a query whose
+    visible keys are ALL masked (a pure-padding row) outputs zeros.
     """
     b, s, h, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
@@ -437,4 +512,11 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig,
         bq = _kernel_block(s, cb)
         cache[key] = _CompiledLayout(fine, cb, bq, bq, causal)
     layout = cache[key]
+    if key_padding_mask is not None:
+        kvm = jnp.asarray(key_padding_mask).astype(jnp.float32)
+        if kvm.shape != (b, s):
+            raise ValueError(
+                f"key_padding_mask must be [B, S] = {(b, s)}, "
+                f"got {kvm.shape}")
+        return _sparse_attn_masked(q, k, v, kvm, layout, causal, scale)
     return _sparse_attn(q, k, v, layout, causal, scale)

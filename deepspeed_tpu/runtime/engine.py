@@ -1103,12 +1103,15 @@ class DeepSpeedEngine:
             self.quantizer.set_state(meta["quantizer"])
         if getattr(self, "_onebit", None) is not None:
             # phase selection (warmup vs compressed, 0/1 Adam intervals) is
-            # keyed on the device step counter — realign it and the host-side
-            # policy counters to the restored step
+            # keyed on APPLIED updates (step - skipped) — realign the device
+            # counters and the host-side policy counters to the restored run
             self.state["step"] = jax.device_put(
                 jnp.asarray(meta["global_steps"], jnp.int32),
                 self._onebit._rep)
-            self._onebit.restore_step(meta["global_steps"])
+            skipped = int(meta.get("skipped_steps", 0) or 0)
+            self.state["skipped"] = jax.device_put(
+                jnp.asarray(skipped, jnp.int32), self._onebit._rep)
+            self._onebit.restore_step(meta["global_steps"] - skipped)
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
         self.micro_steps = meta["micro_steps"]

@@ -213,13 +213,17 @@ class OnebitRunner:
             master_flat = self._flatten(state["master"])
             batch_specs = jax.tree.map(
                 lambda x: P(None, "dp", *([None] * (x.ndim - 2))), batches)
+            # the optimizer count is APPLIED updates (step - skipped): a
+            # skipped overflow step must not advance Adam's bias correction
+            # or the lr schedule (reference overflow-skip semantics)
+            applied = state["step"] - state["skipped"] + 1
             new_flat, new_ob, rng, loss, gnorm, finite = shard_map(
                 per_rank, mesh=self.mesh,
                 in_specs=(P(), ob_specs, batch_specs, P(), P(), P()),
                 out_specs=(P(), ob_specs, P(), P(), P(), P()),
                 check_vma=False)(
                     master_flat, state["opt"], batches, state["rng"],
-                    state["scale"].cur_scale, state["step"] + 1)
+                    state["scale"].cur_scale, applied)
             new_state = {
                 "master": self._unflatten(new_flat),
                 "opt": new_ob,
@@ -255,7 +259,11 @@ class OnebitRunner:
 
     # ---- host-driven train step --------------------------------------------------
     def train_batch(self, batches):
-        step = int(jax.device_get(self.state["step"])) + 1
+        # phase selection counts APPLIED updates: an overflow-skipped step
+        # must not eat into freeze_step's warmup budget (the frozen variance
+        # would be built from fewer real Adam updates than configured)
+        step = int(jax.device_get(self.state["step"])) \
+            - int(jax.device_get(self.state["skipped"])) + 1
         mode = self.opt.mode_for(step)
         for action in self.opt.transition_actions(step):
             if action == "reinit_errors":
