@@ -90,6 +90,16 @@ def init_distributed(dist_backend: str = "xla",
             os.environ.get("MASTER_PORT", "29500")
         os.environ.setdefault(
             "LOCAL_RANK", os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK", "0"))
+    elif auto_mpi_discovery and not coord \
+            and "MV2_COMM_WORLD_SIZE" in os.environ:
+        # launched under mpirun_rsh (MVAPICHRunner): MVAPICH2 spells the
+        # same identity MV2_* (reference mpi_discovery covers both)
+        nproc = int(os.environ["MV2_COMM_WORLD_SIZE"])
+        pid = int(os.environ["MV2_COMM_WORLD_RANK"])
+        coord = os.environ.get("MASTER_ADDR", "127.0.0.1") + ":" + \
+            os.environ.get("MASTER_PORT", "29500")
+        os.environ.setdefault(
+            "LOCAL_RANK", os.environ.get("MV2_COMM_WORLD_LOCAL_RANK", "0"))
     if coord and nproc > 1:
         jax.distributed.initialize(
             coordinator_address=coord,

@@ -1,9 +1,10 @@
 """Multi-node launch backends (reference: ``launcher/multinode_runner.py`` —
-``PDSHRunner``:45, ``OpenMPIRunner``:101; an ssh fallback replaces the
-MVAPICH variant, which targets InfiniBand clusters that TPU pods don't have).
+``PDSHRunner``:45, ``OpenMPIRunner``:101, ``MVAPICHRunner``:156; plus an
+ssh fallback with no external dependency).
 
 Each backend builds a command line that starts ``deepspeed_tpu.launcher.launch``
-on every node with the node's rank and the shared world info."""
+on every node with the node's rank and the shared world info (mpi-family
+backends start the ranks directly; comm.init_distributed reads their env)."""
 
 from __future__ import annotations
 
@@ -115,4 +116,43 @@ class OpenMPIRunner(MultiNodeRunner):
         with f:
             for host, slots in self.active.items():
                 f.write(f"{host} slots={len(slots)}\n")
+        return f.name
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """MVAPICH2 backend (reference MVAPICHRunner, multinode_runner.py:156).
+    Uses ``mpirun_rsh``, whose convention passes environment as positional
+    ``KEY=VALUE`` tokens before the command; one hostname per slot in the
+    hostfile. TPU pods talk ICI/DCN rather than InfiniBand, so the MV2
+    fabric knobs default to TCP."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun_rsh") is not None
+
+    def get_cmd(self, exports: Dict[str, str]) -> List[str]:
+        total_procs = sum(len(s) for s in self.active.values())
+        cmd = ["mpirun_rsh", "-np", str(total_procs),
+               "-hostfile", self._write_hostfile()]
+        env = dict(exports,
+                   MASTER_ADDR=self.master_addr,
+                   MASTER_PORT=str(self.args.master_port),
+                   MV2_USE_CUDA="0", MV2_SMP_USE_CMA="0",
+                   MV2_DEBUG_SHOW_BACKTRACE="1")
+        for k, v in env.items():
+            cmd.append(f"{k}={shlex.quote(str(v))}")
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        cmd += [sys.executable, "-u", self.args.user_script]
+        cmd += list(self.args.user_args)
+        return cmd
+
+    def _write_hostfile(self) -> str:
+        import tempfile
+        f = tempfile.NamedTemporaryFile(
+            "w", prefix="ds_tpu_mv2_hostfile_", suffix=".txt", delete=False)
+        with f:
+            # mpirun_rsh convention: one line per SLOT, host repeated
+            for host, slots in self.active.items():
+                for _ in slots:
+                    f.write(f"{host}\n")
         return f.name

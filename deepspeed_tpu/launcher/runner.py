@@ -131,7 +131,7 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=("pdsh", "openmpi", "ssh"),
+                        choices=("pdsh", "openmpi", "mvapich", "ssh"),
                         help="multi-node backend")
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
@@ -182,8 +182,10 @@ def main(args=None):
         result.wait()
         return result.returncode
 
-    from .multinode_runner import PDSHRunner, OpenMPIRunner, SSHRunner
+    from .multinode_runner import (MVAPICHRunner, OpenMPIRunner,
+                                   PDSHRunner, SSHRunner)
     runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "mvapich": MVAPICHRunner,
                   "ssh": SSHRunner}[args.launcher]
     runner = runner_cls(args, world_info, active, master_addr)
     if not runner.backend_exists():

@@ -1115,6 +1115,9 @@ class DeepSpeedEngine:
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
         self.micro_steps = meta["micro_steps"]
+        # the host counter feeds the next save's skipped_steps (offload
+        # mode); without restoring it a resumed run under-reports skips
+        self.skipped_steps = int(meta.get("skipped_steps", 0) or 0)
         log_dist(f"loaded checkpoint tag={res['tag']} step={self.global_steps}",
                  ranks=[0])
         return os.path.join(load_dir, res["tag"]), meta.get("client_state", {})
