@@ -27,6 +27,24 @@ assert len(jax.devices()) == 8
 
 import pytest  # noqa: E402
 
+# Modules dominated by multi-second jit compiles / process forks / NVMe
+# swaps; `pytest -m "not slow"` is the quick tier (reference CI's
+# `-m 'sequential'`-style split, nv-torch-latest-v100.yml:63).
+_SLOW_MODULES = {
+    "test_pipe_engine", "test_multiprocess", "test_offload",
+    "test_autotuning", "test_onebit", "test_sharded_checkpoint",
+    "test_sequence_parallel", "test_inference", "test_config_knobs",
+    "test_moe", "test_bert_and_autotp", "test_bert_sparse",
+    "test_features", "test_zero_init", "test_engine", "test_gpt_model",
+    "test_zero",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        if item.module.__name__ in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(autouse=True)
 def _reset_mesh():
