@@ -156,8 +156,13 @@ class GPTConfig:
     # XLA einsum elsewhere (partition-friendly on the virtual CPU mesh)
     attention_impl: str = "auto"     # auto | xla | pallas | sparse
     sparse_attention: Any = None     # SparsityConfig when attention_impl=sparse
-    decode_impl: str = "xla"         # xla | pallas (fused prefix-only kernel;
-                                     # see ops/pallas/decode_attention.py)
+    # "auto" resolves to the fused prefix-only Pallas kernel on TPU (manual
+    # DMA pipeline over live cache blocks — O(cache_len) HBM traffic; the
+    # KV cache is stored FLAT [b, S, h*d] so XLA's d-dim lane padding never
+    # costs a relayout) and to the masked einsum elsewhere. Default stays
+    # "xla" until the kernel shows a measured win on hardware (the r2 grid
+    # version lost to XLA; this rewrite is pending chip re-measurement).
+    decode_impl: str = "xla"         # auto | xla | pallas
     # Ulysses-style sequence parallelism over the mesh's `sp` axis (the
     # long-context strategy beyond the reference's block-sparse attention;
     # DeepSpeed-Ulysses all-to-all design, here expressed as sharding
@@ -199,7 +204,7 @@ class GPTConfig:
                 f"cp_impl must be 'ulysses' or 'ring', got {self.cp_impl!r}")
         if self.attention_impl not in ("auto", "xla", "pallas", "sparse"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
-        if self.decode_impl not in ("xla", "pallas"):
+        if self.decode_impl not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown decode_impl {self.decode_impl!r}")
 
     @property
@@ -355,30 +360,64 @@ class SelfAttention(nn.Module):
     def _decode_attention(self, q, k, v, positions):
         """KV-cache attention (reference ``softmax_context`` kernel with
         cache append, inference/csrc/softmax.cu): writes this step's k/v at
-        ``cache_index`` and attends over the filled prefix."""
+        ``cache_index`` and attends over the filled prefix. Under the
+        Pallas decode impl the cache lives FLAT [b, S, h*d]: XLA lane-pads
+        a trailing d=64 dim (to 128), so a rank-4 cache would pay a
+        full-cache relayout copy on every kernel call."""
         cfg = self.cfg
         b, s, h, d = q.shape
+        impl = cfg.decode_impl
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        from ..ops.pallas.decode_attention import pallas_decode_supported
+        use_flat = (impl == "pallas" and self.window is None
+                    and pallas_decode_supported(b, cfg.max_seq_len, h, d,
+                                                cfg.dtype))
+        scale = (cfg.qk_scale if cfg.qk_scale is not None
+                 else 1.0 / math.sqrt(d))
+        idx = self.variable("cache", "cache_index",
+                            lambda: jnp.zeros((), jnp.int32))
+        cur = idx.value
+        if use_flat:
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, cfg.max_seq_len, h * d), cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, cfg.max_seq_len, h * d), cfg.dtype)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype).reshape(b, s, h * d),
+                (0, cur, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype).reshape(b, s, h * d),
+                (0, cur, 0))
+            idx.value = cur + s
+            from ..ops.pallas.decode_attention import decode_attention
+            if s == 1:
+                # fused prefix-only decode (reference softmax_context):
+                # O(cache_len) compute AND HBM traffic per token
+                return decode_attention(q, ck.value, cv.value, cur + s,
+                                        scale=scale)
+            # prefill: one relayout of the cache view per prefill call
+            ck4 = ck.value.reshape(b, cfg.max_seq_len, h, d)
+            cv4 = cv.value.reshape(b, cfg.max_seq_len, h, d)
+            return self._cache_einsum(q, ck4, cv4, cur, s, scale)
         ck = self.variable("cache", "cached_key", jnp.zeros,
                            (b, cfg.max_seq_len, h, d), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros,
                            (b, cfg.max_seq_len, h, d), cfg.dtype)
-        idx = self.variable("cache", "cache_index",
-                            lambda: jnp.zeros((), jnp.int32))
-        cur = idx.value
         ck.value = jax.lax.dynamic_update_slice(
             ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
         cv.value = jax.lax.dynamic_update_slice(
             cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
         idx.value = cur + s
-        scale = (cfg.qk_scale if cfg.qk_scale is not None
-                 else 1.0 / math.sqrt(d))
-        if s == 1 and self.window is None and cfg.decode_impl == "pallas":
-            # fused prefix-only decode (reference softmax_context kernel):
-            # O(cache_len) work instead of O(max_seq_len) per token
+        if s == 1 and self.window is None and impl == "pallas":
             from ..ops.pallas.decode_attention import decode_attention
             return decode_attention(q, ck.value, cv.value, cur + s,
                                     scale=scale)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value
+        return self._cache_einsum(q, ck.value, cv.value, cur, s, scale)
+
+    def _cache_einsum(self, q, ck, cv, cur, s, scale):
+        cfg = self.cfg
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck
                             ).astype(jnp.float32) * scale
         key_pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
         q_pos = (cur + jnp.arange(s))[None, None, :, None]
@@ -388,7 +427,7 @@ class SelfAttention(nn.Module):
                                       key_pos > q_pos - self.window)
         logits = jnp.where(visible, logits, -1e10)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
 
 
 class MLP(nn.Module):

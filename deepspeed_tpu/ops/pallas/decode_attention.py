@@ -3,23 +3,29 @@
 Reference analogue: the ``softmax_context`` inference kernel
 (``csrc/transformer/inference/csrc/softmax.cu``) — single-token attention
 over the KV cache. The plain XLA decode path does O(max_seq_len) work per
-token regardless of fill (masked einsum over the whole cache); this kernel
-makes the COMPUTE O(cache_len): the number of LIVE kv blocks rides in as a
-scalar-prefetch operand, dead grid steps are predicated out, and their
-index_map clamps to the last live block (the block-sparse kernel's LUT
-trick applied to a dynamic prefix length).
+token regardless of fill (masked einsum over the whole cache).
 
-Status: numerically verified on TPU v5e, but currently OPT-IN
-(``GPTConfig.decode_impl="pallas"``) — the clamped index_map does not stop
-Mosaic from re-issuing the clamped block's DMA on this toolchain, so HBM
-traffic stays O(max_seq_len) and XLA's fused masked-einsum wins at these
-sizes (84-124us vs 145-163us per token at b=4, S=2048, h=16 on v5e).
-Making the win real needs a manual DMA pipeline over a dynamically-bounded
-loop (splash-attention style) — tracked as follow-up work.
+This kernel makes both COMPUTE and HBM TRAFFIC O(cache_len): the cache
+stays in HBM and the kernel drives its own double-buffered DMA pipeline
+over a ``fori_loop`` whose trip count is the number of LIVE kv blocks (a
+scalar-prefetch operand). Dead blocks are never fetched — the
+splash-attention pattern applied to a dynamic prefix length. (The previous
+revision walked a grid over all of S with a clamped index_map; Mosaic
+re-issued the clamped block's DMA every dead step, so HBM traffic stayed
+O(max_seq_len) and XLA won.)
 
-Layout: one query token, heads as the softmax row dimension —
-q [b, h, d], cache [b, h, S, d], online softmax over kv blocks with
-(m, l, acc) in VMEM scratch.
+Layout notes, the part that makes Mosaic happy AND fast:
+  * The cache rides FLATTENED as [b, S, h*d] — a free reshape of the
+    native [b, S, h, d] cache. The rank-4 layout tiles (h, d) and
+    lane-pads d (64 -> 128), which both doubles the DMA bytes and makes
+    dynamic sub-slices unaligned; the flat layout's (S, h*d) tiling is
+    exactly aligned, so a [bk, h*d] block is one contiguous DMA.
+  * Per-head dots become ONE MXU matmul against a block-diagonal query
+    matrix qmat [h*d, hp] (qmat[g*d + j, g] = q[g, j]):
+    s = k_flat @ qmat. The combine p^T @ v_flat yields [hp, h*d] whose
+    row g holds every head's segment weighted by head g's probabilities;
+    the wrapper slices the block diagonal — 16x more output elements than
+    needed, but the arrays are tiny and it keeps the hot loop on the MXU.
 """
 
 from __future__ import annotations
@@ -39,126 +45,184 @@ from ._utils import interpret_mode
 NEG_INF = float(np.finfo(np.float32).min)
 
 
-def _decode_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, block_k, h):
-    kb = pl.program_id(1)
-    nk_total = pl.num_programs(1)
-    nb = meta_ref[0]       # number of live kv blocks
+def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
+                   k_buf, v_buf, k_sem, v_sem, *, scale, block_k, b, hp, hd):
+    """Single program. k_hbm/v_hbm: full [b, S, h*d] refs in HBM;
+    k_buf/v_buf: [2, b, block_k, h*d] VMEM slots — ALL batch rows ride one
+    (strided) DMA per block, so the DMA count is O(live blocks), not
+    O(b * live blocks). Online softmax state rides the loop carry; the
+    per-batch dots unroll statically (b is small at decode time)."""
+    nb = meta_ref[0]       # live kv blocks
     clen = meta_ref[1]     # filled prefix length (includes this token)
-    hp = m_scr.shape[0]    # head count padded to the sublane tile
 
-    @pl.when(kb == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
+    def k_copy(i, slot):
+        return pltpu.make_async_copy(
+            k_hbm.at[:, pl.ds(i * block_k, block_k)],
+            k_buf.at[slot], k_sem.at[slot])
 
-    @pl.when(kb < nb)
-    def _compute():
-        # cache blocks arrive in their NATIVE [bk, h, d] layout (no
-        # host-side transpose — that would copy the whole cache per call);
-        # per-head matvecs as broadcast-multiply-reduce (Mosaic has no
-        # batched dot, and decode is DMA-bound — the VPU covers the FLOPs).
-        # When h isn't a sublane multiple, k/v blocks are zero-padded to hp
-        # in VMEM (q's pad rows are zero, so pad-head logits are 0 and the
-        # junk lanes are sliced off by the wrapper).
-        q = q_ref[0].astype(jnp.float32)          # [hp, d]
-        kbk = k_ref[0].astype(jnp.float32)        # [bk, h, d]
-        vbk = v_ref[0].astype(jnp.float32)
-        if hp != h:
-            widths = ((0, 0), (0, hp - h), (0, 0))
-            kbk = jnp.pad(kbk, widths)
-            vbk = jnp.pad(vbk, widths)
-        s = jnp.sum(q[None, :, :] * kbk, axis=2) * scale      # [bk, hp]
-        pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0)
-        s = jnp.where(pos < clen, s, NEG_INF)
-        m_prev, l_prev = m_scr[...], l_scr[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=0))
-        p = jnp.exp(s - m_new[None, :])
-        corr = jnp.exp(m_prev - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_prev * corr + jnp.sum(p, axis=0)
-        acc_scr[...] = acc_scr[...] * corr[:, None] + jnp.sum(
-            p[:, :, None] * vbk, axis=0)                      # [hp, d]
+    def v_copy(i, slot):
+        return pltpu.make_async_copy(
+            v_hbm.at[:, pl.ds(i * block_k, block_k)],
+            v_buf.at[slot], v_sem.at[slot])
 
-    @pl.when(kb == nk_total - 1)
-    def _finalize():
-        l = l_scr[...]
-        l_safe = jnp.where(l == 0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+    # prologue: stage block 0 into slot 0
+    k_copy(0, 0).start()
+    v_copy(0, 0).start()
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry                # [b,hp] [b,hp] [b,hp,hd]
+        slot = jax.lax.rem(i, 2)
+        nxt = i + 1
+
+        @pl.when(nxt < nb)
+        def _prefetch():
+            ns = jax.lax.rem(nxt, 2)
+            k_copy(nxt, ns).start()
+            v_copy(nxt, ns).start()
+
+        k_copy(i, slot).wait()
+        v_copy(i, slot).wait()
+        pos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, hp), 0)
+        live = pos < clen
+        ms, ls, accs = [], [], []
+        for bi in range(b):                        # static unroll
+            kbk = k_buf[slot, bi].astype(jnp.float32)   # [bk, h*d]
+            vbk = v_buf[slot, bi].astype(jnp.float32)
+            qmat = qmat_ref[bi].astype(jnp.float32)     # [h*d, hp]
+            s = jax.lax.dot(kbk, qmat,
+                            preferred_element_type=jnp.float32) * scale
+            s = jnp.where(live, s, NEG_INF)
+            m_new = jnp.maximum(m_prev[bi], jnp.max(s, axis=0))
+            p = jnp.exp(s - m_new[None, :])
+            corr = jnp.exp(m_prev[bi] - m_new)
+            l_new = l_prev[bi] * corr + jnp.sum(p, axis=0)
+            # p^T @ v: [hp, h*d]; row g = every segment under head-g weights
+            pv = jax.lax.dot_general(p, vbk, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ms.append(m_new)
+            ls.append(l_new)
+            accs.append(acc[bi] * corr[:, None] + pv)
+        return (jnp.stack(ms), jnp.stack(ls), jnp.stack(accs))
+
+    m0 = jnp.full((b, hp), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hp), jnp.float32)
+    a0 = jnp.zeros((b, hp, hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, :, None]).astype(o_ref.dtype)
 
 
-def _pick_block(s: int, want: int = 512) -> Optional[int]:
+def _pick_block(s: int, want: int = 256) -> Optional[int]:
     cand = want
     while cand >= 128:
         if s % cand == 0:
             return cand
         cand //= 2
-    return s if s <= 128 else None
+    return s if s <= 128 and s % 8 == 0 else None
+
+
+_VMEM_BUDGET = 8 * 1024 * 1024   # staging window budget (2 slots x k+v)
+
+
+def _choose_block(b: int, S: int, h: int, d: int, itemsize: int,
+                  block_k: Optional[int] = None) -> Optional[int]:
+    """kv block size for the DMA window, or None when the kernel can't run
+    (S not block-decomposable, h*d lane-unaligned handled by caller, or the
+    window would blow the VMEM arena even at the smallest block)."""
+    bk = block_k or _pick_block(S)
+    if bk is None:
+        return None
+    while bk > 128 and 4 * b * bk * h * d * itemsize > _VMEM_BUDGET:
+        bk //= 2
+    if 4 * b * bk * h * d * itemsize > _VMEM_BUDGET:
+        return None
+    return bk
+
+
+def pallas_decode_supported(b: int, S: int, h: int, d: int, dtype) -> bool:
+    """Callers choosing a cache LAYOUT (models/gpt.py flat cache) must agree
+    with the kernel's own feasibility test — a flat cache whose every decode
+    falls back to the XLA path would pay a full-cache relayout per token."""
+    if (h * d) % 128 != 0:
+        return False
+    return _choose_block(b, S, h, d, jnp.dtype(dtype).itemsize) is not None
 
 
 def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
                      cached_value: jnp.ndarray, cache_len,
                      scale: Optional[float] = None,
                      block_k: Optional[int] = None) -> jnp.ndarray:
-    """q: [b, 1, h, d]; cached_key/value: [b, S, h, d]; cache_len: scalar
-    int32 count of valid cache positions (including this token, already
-    written). Returns [b, 1, h, d]."""
+    """q: [b, 1, h, d]. cached_key/value: PREFERABLY the flat [b, S, h*d]
+    cache layout — rank-4 [b, S, h, d] caches are accepted but XLA
+    lane-pads their d dim (64 -> 128), so every call pays a full-cache
+    relayout copy; keep the cache flat (models/gpt.py does when decode_impl
+    resolves to pallas). cache_len: scalar int32 count of valid cache
+    positions (including this token, already written).
+    Returns [b, 1, h, d]."""
     b, s_q, h, d = q.shape
     S = cached_key.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    bk = block_k or _pick_block(S)
-    if s_q != 1 or bk is None:
+    bk = _choose_block(b, S, h, d, jnp.dtype(cached_key.dtype).itemsize,
+                       block_k)
+    flat = cached_key.ndim == 3
+    if s_q != 1 or bk is None or (h * d) % 128 != 0:
+        if flat:
+            cached_key = cached_key.reshape(b, S, h, d)
+            cached_value = cached_value.reshape(b, S, h, d)
         return _xla_decode(q, cached_key, cached_value, cache_len, scale)
 
-    # heads ride the sublane dim of q/out: pad to the TPU tile multiple.
-    # The CACHE is consumed in its native [b, S, h, d] layout — h is its
-    # sublane dim inside a block, so only q/out (tiny) ever get padded.
     hp = -(-h // 8) * 8
-    qt = q[:, 0]                                   # [b, h, d]
-    if hp != h:
-        qt = jnp.pad(qt, ((0, 0), (0, hp - h), (0, 0)))
+    hd = h * d
+    # block-diagonal query: qmat[g*d + j, g] = q[g, j]
+    qt = q[:, 0]                                            # [b, h, d]
+    eye = jnp.eye(h, hp, dtype=q.dtype)                     # [h, hp]
+    qmat = jnp.einsum("bhd,hg->bhdg", qt, eye).reshape(b, hd, hp)
 
-    nk = S // bk
     clen = jnp.asarray(cache_len, jnp.int32)
-    nb = jnp.maximum((clen + bk - 1) // bk, 1)
+    nb = jnp.clip((clen + bk - 1) // bk, 1, S // bk)
     meta = jnp.stack([nb, clen])
 
-    kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk, h=h)
+    if flat:
+        kf, vf = cached_key, cached_value
+    else:
+        kf = cached_key.reshape(b, S, hd)
+        vf = cached_value.reshape(b, S, hd)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk,
+                               b=b, hp=hp, hd=hd)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, nk),
+        grid=(1,),
         in_specs=[
-            pl.BlockSpec((1, hp, d), lambda bi, kb, meta: (bi, 0, 0)),
-            # dead blocks clamp to the last live block: no fresh DMA
-            pl.BlockSpec((1, bk, h, d),
-                         lambda bi, kb, meta: (bi,
-                                               jnp.minimum(kb, meta[0] - 1),
-                                               0, 0)),
-            pl.BlockSpec((1, bk, h, d),
-                         lambda bi, kb, meta: (bi,
-                                               jnp.minimum(kb, meta[0] - 1),
-                                               0, 0)),
+            pl.BlockSpec((b, hd, hp), lambda g, meta: (0, 0, 0)),
+            # the cache never enters VMEM wholesale: the kernel DMAs only
+            # live blocks out of HBM
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ],
-        out_specs=pl.BlockSpec((1, hp, d), lambda bi, kb, meta: (bi, 0, 0)),
+        out_specs=pl.BlockSpec((b, hp, hd), lambda g, meta: (0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((hp,), jnp.float32),
-            pltpu.VMEM((hp,), jnp.float32),
-            pltpu.VMEM((hp, d), jnp.float32),
+            pltpu.VMEM((2, b, bk, hd), cached_key.dtype),
+            pltpu.VMEM((2, b, bk, hd), cached_value.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hp, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, hp, hd), q.dtype),
         interpret=interpret_mode(),
-    )(meta, qt, cached_key, cached_value)
-    return out[:, :h].reshape(b, 1, h, d)
+    )(meta, qmat, kf, vf)
+    # block diagonal: head g's output is row g, segment g
+    out = out[:, :h].reshape(b, h, h, d)
+    out = jnp.diagonal(out, axis1=1, axis2=2)               # [b, d, h]
+    return out.transpose(0, 2, 1).reshape(b, 1, h, d)
 
 
 def _xla_decode(q, ck, cv, cache_len, scale):
-    """Masked-einsum fallback (the previous default path)."""
+    """Masked-einsum fallback."""
     S = ck.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32) * scale
     visible = jnp.arange(S)[None, None, None, :] < cache_len

@@ -42,6 +42,8 @@ from ...ops.adam import fused_adam
 from ...parallel import mesh as mesh_lib
 from ...utils.logging import log_dist
 from ..config import DeepSpeedConfig
+from ..fp16.loss_scaler import (grads_finite, make_loss_scale_state,
+                                update_scale)
 from ..lr_schedules import build_lr_scheduler
 from . import schedule as sched_lib
 from .module import LayerSpec, PipelineModule, TiedLayerSpec
@@ -128,6 +130,19 @@ class PipelineEngine:
                 "params must be resident for the host-driven 1F1B replay. "
                 "Use zero stage 0-2 with pp, or drop pp and use stage 3's "
                 "scan-over-layers sharding")
+
+        # fp16 loss scaling (reference: pipelines run under FP16_Optimizer;
+        # this engine's analogue seeds the last stage's vjp with the scale,
+        # unscales at the optimizer step, and skips the whole update on
+        # overflow — the host-driven schedule makes the scale/skip decision
+        # a host step, unlike the dense engine's fully in-graph scaler)
+        self.fp16_enabled = self.config.fp16.enabled
+        self.dynamic_loss_scale = (self.config.fp16.dynamic_loss_scale
+                                   if self.fp16_enabled else False)
+        self.scale_state = make_loss_scale_state(
+            static_scale=(self.config.fp16.loss_scale
+                          if self.fp16_enabled else 1.0),
+            initial_scale_power=self.config.fp16.initial_scale_power)
 
         self._build_stage_meshes()
 
@@ -397,12 +412,14 @@ class PipelineEngine:
         loss_fn = self.loss_fn
 
         if last:
-            def bwd(params_list, x, labels, acc):
+            def bwd(params_list, x, labels, acc, scale):
                 def f(pl, xx):
                     out = apply(pl, xx)
                     return loss_fn(out, labels).astype(jnp.float32)
                 loss, vjp_fn = jax.vjp(f, params_list, x)
-                dparams, dx = vjp_fn(jnp.ones((), jnp.float32))
+                # the loss scale seeds the vjp (fp16: grads ride scaled
+                # through every stage; bf16/fp32: scale == 1)
+                dparams, dx = vjp_fn(scale.astype(jnp.float32))
                 new_acc = jax.tree.map(
                     lambda a, g: a + g.astype(jnp.float32), acc, dparams)
                 return new_acc, dx, loss
@@ -427,13 +444,15 @@ class PipelineEngine:
     def _step_prog(self, s: int):
         if s in self._jit_step:
             return self._jit_step[s]
-        M = float(self.micro_batches)
         opt = self.optimizer
         zero = self.zero_stage
         shard_tree = self._step_shardings[s] if zero >= 1 else None
 
-        def step(params_list, opt_state, acc):
-            grads = jax.tree.map(lambda g: g / M, acc)
+        def step(params_list, opt_state, acc, denom, apply_update):
+            # denom = M * loss_scale (1 for bf16/fp32); apply_update False
+            # keeps params/opt untouched (fp16 overflow skip, reference
+            # engine.py:1798 semantics)
+            grads = jax.tree.map(lambda g: g / denom, acc)
             if shard_tree is not None:
                 # ZeRO-1: each dp rank updates its slice of moments/params;
                 # out_shardings below all-gather the updated params back to
@@ -444,6 +463,12 @@ class PipelineEngine:
             if shard_tree is not None:
                 updates = jax.lax.with_sharding_constraint(updates, shard_tree)
             new_params = optax.apply_updates(params_list, updates)
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(apply_update, n, o),
+                new_params, params_list)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(apply_update, n, o),
+                new_opt, opt_state)
             return new_params, new_opt
 
         out_sh = (self._param_shardings[s], self._opt_shardings[s])
@@ -486,9 +511,32 @@ class PipelineEngine:
                 for cmd in next(iters[s]):
                     total_loss = self._exec(cmd, s, micros, acts,
                                             cotangents, grads_acc, total_loss)
-        self._optimizer_step(grads_acc)
+        stepped = True
+        if self.fp16_enabled:
+            # dispatch every stage's finite program, THEN fetch all flags
+            # (+ the scale) in one transfer — S sequential device_gets
+            # would serialize host<->device round trips on the hot path
+            flags = [self._finite_prog(s)(grads_acc[s]) for s in range(S)]
+            fetched = jax.device_get(flags + [self.scale_state.cur_scale])
+            finite = all(bool(v) for v in fetched[:-1])
+            scale_val = float(fetched[-1])
+            fp16c = self.config.fp16
+            self.scale_state = update_scale(
+                self.scale_state, jnp.asarray(finite),
+                dynamic=self.dynamic_loss_scale,
+                scale_window=fp16c.loss_scale_window,
+                min_scale=fp16c.min_loss_scale,
+                hysteresis=fp16c.hysteresis)
+            stepped = finite
+            denom = jnp.asarray(M * scale_val, jnp.float32)
+            self._optimizer_step(grads_acc, denom, jnp.asarray(finite))
+        else:
+            self._optimizer_step(grads_acc, jnp.asarray(float(M), jnp.float32),
+                                 jnp.asarray(True))
         self.global_steps += 1
-        if self.lr_scheduler is not None:
+        # an overflow-skipped step must not march the lr schedule through
+        # warmup with zero real updates (reference _take_model_step:1798)
+        if self.lr_scheduler is not None and stepped:
             self.lr_scheduler.step()
         return total_loss / M
 
@@ -531,7 +579,8 @@ class PipelineEngine:
             if s == self.num_stages - 1:
                 labels = acts.pop(("labels", m))
                 grads_acc[s], dx, loss = self._bwd_prog(s)(
-                    self.stage_params[s], x, labels, grads_acc[s])
+                    self.stage_params[s], x, labels, grads_acc[s],
+                    self.scale_state.cur_scale)
                 total_loss = total_loss + jax.device_put(
                     loss, NamedSharding(self.mesh, P()))
             else:
@@ -586,10 +635,18 @@ class PipelineEngine:
                     lambda a, sh: jax.device_put(a, sh),
                     gsum, self._grad_shardings[s][li])
 
-    def _optimizer_step(self, grads_acc):
+    def _finite_prog(self, s: int):
+        if not hasattr(self, "_jit_fin"):
+            self._jit_fin = {}
+        if s not in self._jit_fin:
+            self._jit_fin[s] = self._wrap_stage(s, jax.jit(grads_finite))
+        return self._jit_fin[s]
+
+    def _optimizer_step(self, grads_acc, denom, apply_update):
         for s in range(self.num_stages):
             self.stage_params[s], self.opt_states[s] = self._step_prog(s)(
-                self.stage_params[s], self.opt_states[s], grads_acc[s])
+                self.stage_params[s], self.opt_states[s], grads_acc[s],
+                denom, apply_update)
 
     def eval_batch(self, data_iter):
         batch = next(data_iter) if not isinstance(data_iter, (dict, tuple, list)) else data_iter
@@ -602,6 +659,11 @@ class PipelineEngine:
         last = self.num_stages - 1
         labels = self._put_stage(labels, last)
         return self._fwd_prog(last)(self.stage_params[last], x, labels)
+
+    @property
+    def skipped_steps(self) -> int:
+        """Single source of truth: the scaler's overflow counter."""
+        return int(jax.device_get(self.scale_state.overflows))
 
     # kept for API parity
     @property

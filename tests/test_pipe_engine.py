@@ -382,3 +382,68 @@ def test_pipeline_moe_pp2_matches_pp1():
     l2 = [float(jax.device_get(e2.train_batch(_token_iter(cfg))))
           for _ in range(3)]
     np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def _fp16_pipe_engine(num_stages, loss_scale, init_power=16, dp=1):
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.models.gpt_pipe import gpt_pipe_module
+
+    cfg = GPTConfig(vocab_size=64, max_seq_len=16, num_layers=2, num_heads=2,
+                    d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, scan_layers=False, remat=False)
+    pipe = gpt_pipe_module(cfg, num_stages=num_stages,
+                           partition_method="uniform")
+    engine, _, _, _ = ds.initialize(model=pipe, config={
+        "train_micro_batch_size_per_gpu": 4 // max(1, dp),
+        "gradient_accumulation_steps": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "fp16": {"enabled": True, "loss_scale": loss_scale,
+                 "initial_scale_power": init_power, "hysteresis": 1,
+                 "loss_scale_window": 4},
+        "mesh": {"dp": dp, "pp": num_stages if dp > 1 else 1},
+    })
+    return engine, cfg
+
+
+def test_pipeline_fp16_static_scale_matches_fp32():
+    """fp16 static loss scaling through the 1F1B schedule: the scale seeds
+    the last stage's vjp and divides out at the step, so (with fp32 compute
+    in this tiny config) losses must track the unscaled run exactly."""
+    e0, cfg = _tied_gpt_engine(num_stages=2)
+    e1, _ = _fp16_pipe_engine(num_stages=2, loss_scale=1024)
+    # fp16 config forces compute dtype float16; to isolate the SCALING
+    # math from fp16 rounding, compare against a small tolerance
+    l0 = [float(jax.device_get(e0.train_batch(_token_iter(cfg))))
+          for _ in range(4)]
+    l1 = [float(jax.device_get(e1.train_batch(_token_iter(cfg))))
+          for _ in range(4)]
+    np.testing.assert_allclose(l0, l1, rtol=2e-2)
+    assert e1.skipped_steps == 0
+
+
+def test_pipeline_fp16_dynamic_overflow_skips_and_backs_off():
+    """Dynamic scaling: an absurd initial scale overflows fp16 grads; the
+    engine must SKIP those updates (params untouched), halve the scale, and
+    recover to real training."""
+    e, cfg = _fp16_pipe_engine(num_stages=2, loss_scale=0, init_power=40)
+    it = _token_iter(cfg)
+    e.eval_batch(it)   # lazy-build stage params without an optimizer step
+    before = [np.asarray(jax.device_get(l)).copy()
+              for l in jax.tree.leaves(e.stage_params[0])]
+    e.train_batch(it)
+    assert e.skipped_steps >= 1, "2**40 scale must overflow fp16 grads"
+    after = jax.tree.leaves(e.stage_params[0])
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, np.asarray(jax.device_get(b)))
+    s0 = float(jax.device_get(e.scale_state.cur_scale))
+    assert s0 < 2.0 ** 40
+    # keep training until the scale backs off enough to produce finite
+    # grads and updates resume
+    losses = [float(jax.device_get(e.train_batch(it))) for _ in range(30)]
+    assert np.isfinite(losses[-1])
+    assert e.skipped_steps < 31
+    moved = any(
+        not np.array_equal(a, np.asarray(jax.device_get(b)))
+        for a, b in zip(before, jax.tree.leaves(e.stage_params[0])))
+    assert moved, "updates never resumed after backoff"
