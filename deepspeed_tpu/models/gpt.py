@@ -332,7 +332,7 @@ class SelfAttention(nn.Module):
                 from ..parallel import mesh as mesh_lib
                 scale = (cfg.qk_scale if cfg.qk_scale is not None
                          else 1.0 / math.sqrt(cfg.head_dim))
-                out = ring_attention(q, k, v, mesh_lib.get_global_mesh(),
+                out = ring_attention(q, k, v, mesh_lib.get_constraint_mesh(),
                                      scale=scale, causal=True)
             else:
                 if cfg.sequence_parallel:
@@ -416,18 +416,9 @@ class SelfAttention(nn.Module):
         return self._cache_einsum(q, ck.value, cv.value, cur, s, scale)
 
     def _cache_einsum(self, q, ck, cv, cur, s, scale):
-        cfg = self.cfg
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck
-                            ).astype(jnp.float32) * scale
-        key_pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
-        q_pos = (cur + jnp.arange(s))[None, None, :, None]
-        visible = key_pos <= q_pos
-        if self.window is not None:
-            visible = jnp.logical_and(visible,
-                                      key_pos > q_pos - self.window)
-        logits = jnp.where(visible, logits, -1e10)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        from ..ops.pallas.decode_attention import masked_cache_attention
+        return masked_cache_attention(q, ck, cv, cur, scale,
+                                      window=self.window)
 
 
 class MLP(nn.Module):

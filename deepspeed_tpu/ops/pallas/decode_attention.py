@@ -129,13 +129,22 @@ def _choose_block(b: int, S: int, h: int, d: int, itemsize: int,
                   block_k: Optional[int] = None) -> Optional[int]:
     """kv block size for the DMA window, or None when the kernel can't run
     (S not block-decomposable, h*d lane-unaligned handled by caller, or the
-    window would blow the VMEM arena even at the smallest block)."""
-    bk = block_k or _pick_block(S)
+    window would blow the VMEM arena even at the smallest block). Every
+    candidate must divide S — a non-divisor would silently drop the cache
+    tail (nb is clipped to S // bk)."""
+    if block_k is not None:
+        if S % block_k != 0:
+            raise ValueError(
+                f"block_k={block_k} must divide the cache length S={S}")
+        bk = block_k
+    else:
+        bk = _pick_block(S)
     if bk is None:
         return None
-    while bk > 128 and 4 * b * bk * h * d * itemsize > _VMEM_BUDGET:
+    while bk > 128 and 4 * b * bk * h * d * itemsize > _VMEM_BUDGET \
+            and S % (bk // 2) == 0:
         bk //= 2
-    if 4 * b * bk * h * d * itemsize > _VMEM_BUDGET:
+    if S % bk != 0 or 4 * b * bk * h * d * itemsize > _VMEM_BUDGET:
         return None
     return bk
 
@@ -221,11 +230,25 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     return out.transpose(0, 2, 1).reshape(b, 1, h, d)
 
 
-def _xla_decode(q, ck, cv, cache_len, scale):
-    """Masked-einsum fallback."""
+def masked_cache_attention(q, ck, cv, first_q_pos, scale, window=None):
+    """The ONE masked-einsum cache attention (shared by the kernel's XLA
+    fallback and the model's prefill/window paths, so the two can't drift):
+    q [b, s, h, d] with query i at absolute position ``first_q_pos + i``,
+    ck/cv [b, S, h, d]; each query sees keys at positions <= its own
+    (within the trailing local ``window`` if given)."""
     S = ck.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32) * scale
-    visible = jnp.arange(S)[None, None, None, :] < cache_len
+    key_pos = jnp.arange(S)[None, None, None, :]
+    q_pos = (first_q_pos + jnp.arange(q.shape[1]))[None, None, :, None]
+    visible = key_pos <= q_pos
+    if window is not None:
+        visible = jnp.logical_and(visible, key_pos > q_pos - window)
     logits = jnp.where(visible, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
+def _xla_decode(q, ck, cv, cache_len, scale):
+    """Masked-einsum fallback."""
+    first_q = jnp.asarray(cache_len, jnp.int32) - q.shape[1]
+    return masked_cache_attention(q, ck, cv, first_q, scale)

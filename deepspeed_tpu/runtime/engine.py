@@ -419,6 +419,7 @@ class DeepSpeedEngine:
         scale_state = make_loss_scale_state(
             static_scale=self.config.fp16.loss_scale if self.fp16_enabled else 1.0,
             initial_scale_power=self.config.fp16.initial_scale_power,
+            hysteresis=self.config.fp16.hysteresis,
         ) if self.fp16_enabled else make_loss_scale_state(static_scale=1.0)
 
         if rng is None:

@@ -23,11 +23,15 @@ class LossScaleState(NamedTuple):
 
 
 def make_loss_scale_state(static_scale: float = 0.0,
-                          initial_scale_power: int = 16) -> LossScaleState:
+                          initial_scale_power: int = 16,
+                          hysteresis: int = 2) -> LossScaleState:
     init = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
     return LossScaleState(
         cur_scale=jnp.asarray(init, jnp.float32),
-        cur_hysteresis=jnp.asarray(0, jnp.int32),
+        # start with the full hysteresis budget (reference DynamicLossScaler
+        # inits cur_hysteresis to delayed_shift): the FIRST overflow only
+        # decrements; the scale shrinks after `hysteresis` consecutive ones
+        cur_hysteresis=jnp.asarray(hysteresis, jnp.int32),
         last_overflow_step=jnp.asarray(-1, jnp.int32),
         step=jnp.asarray(0, jnp.int32),
         overflows=jnp.asarray(0, jnp.int32),

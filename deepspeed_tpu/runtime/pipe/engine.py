@@ -142,7 +142,8 @@ class PipelineEngine:
         self.scale_state = make_loss_scale_state(
             static_scale=(self.config.fp16.loss_scale
                           if self.fp16_enabled else 1.0),
-            initial_scale_power=self.config.fp16.initial_scale_power)
+            initial_scale_power=self.config.fp16.initial_scale_power,
+            hysteresis=self.config.fp16.hysteresis)
 
         self._build_stage_meshes()
 
@@ -198,16 +199,13 @@ class PipelineEngine:
         self._per_stage_mesh = shape.pp == self.num_stages and shape.pp > 1
         self._stage_dp = shape.dp
         self._stage_ep = shape.ep
+        self._stage_sp = shape.sp
         if not self._per_stage_mesh:
             self.stage_meshes = [self.mesh] * self.num_stages
             return
-        if shape.sp != 1:
-            raise NotImplementedError(
-                "pp does not compose with sp yet (ring/Ulysses constraints "
-                "assume the stage holds the full sequence)")
         devs = self.mesh.devices  # [dp, pp, ep, sp, tp]
         self.stage_meshes = [
-            Mesh(devs[:, s, :, 0, :], ("dp", "ep", "tp"))
+            Mesh(devs[:, s], ("dp", "ep", "sp", "tp"))
             for s in range(self.num_stages)
         ]
 
@@ -215,11 +213,21 @@ class PipelineEngine:
         return NamedSharding(self.stage_meshes[s], spec)
 
     def _batch_spec(self, x) -> P:
-        """Shard the leading (batch) dim over dp when it divides."""
-        if getattr(x, "ndim", 0) >= 1 and self._stage_dp > 1 \
-                and x.shape[0] % self._stage_dp == 0:
-            return P("dp")
-        return P()
+        """Shard the leading (batch) dim over dp when it divides; under
+        sequence parallelism activations/batches land seq-sharded over sp
+        too (the Ulysses constraints inside the stage programs keep them
+        there — the p2p hop then moves S/sp-sized shards per chip)."""
+        nd = getattr(x, "ndim", 0)
+        parts: list = []
+        if nd >= 1 and self._stage_dp > 1 and x.shape[0] % self._stage_dp == 0:
+            parts.append("dp")
+        else:
+            parts.append(None)
+        if nd >= 2 and self._stage_sp > 1 and x.shape[1] % self._stage_sp == 0:
+            parts.append("sp")
+        if not any(a for a in parts):
+            return P()
+        return P(*parts)
 
     def _put_stage(self, x, s: int):
         """Move an activation/batch onto stage s's sub-mesh (the p2p hop —
@@ -675,10 +683,13 @@ class PipelineEngine:
         tag = tag or f"global_step{self.global_steps}"
         tree = {f"stage_{s}": self.stage_params[s] for s in range(self.num_stages)}
         opt = {f"stage_{s}": self.opt_states[s] for s in range(self.num_stages)}
+        sc = jax.device_get(self.scale_state)
         return saving.save_checkpoint_dir(
             save_dir, tag, master_params=tree, opt_state=opt,
             meta={"global_steps": self.global_steps,
                   "parts": self.module.parts,
+                  "scale_state": {k: float(v) for k, v in
+                                  zip(sc._fields, sc)},
                   "client_state": client_state or {}})
 
     def load_checkpoint(self, load_dir, tag=None, **kw):
@@ -696,6 +707,19 @@ class PipelineEngine:
             self.stage_params[s] = res["master_params"][f"stage_{s}"]
             self.opt_states[s] = res["opt_state"][f"stage_{s}"]
         self.global_steps = res["meta"]["global_steps"]
+        sc = res["meta"].get("scale_state")
+        if sc:
+            # resume the dynamic scaler where it settled (reference
+            # FP16_Optimizer persists the scaler in its state_dict) — a
+            # re-inited 2**16 scale would skip/halve its way back down
+            self.scale_state = self.scale_state._replace(
+                cur_scale=jnp.asarray(sc["cur_scale"], jnp.float32),
+                cur_hysteresis=jnp.asarray(int(sc["cur_hysteresis"]),
+                                           jnp.int32),
+                last_overflow_step=jnp.asarray(
+                    int(sc["last_overflow_step"]), jnp.int32),
+                step=jnp.asarray(int(sc["step"]), jnp.int32),
+                overflows=jnp.asarray(int(sc["overflows"]), jnp.int32))
         return res["tag"], res["meta"].get("client_state", {})
 
     @property

@@ -65,7 +65,10 @@ def test_fp16_dynamic_loss_scale_runs():
 
 
 def test_fp16_overflow_skips_step():
-    cfg = dict(BASE_CONFIG, fp16={"enabled": True, "initial_scale_power": 4})
+    # hysteresis=1 => the scale halves on the first overflow (the default
+    # of 2, reference delayed_shift semantics, needs TWO consecutive ones)
+    cfg = dict(BASE_CONFIG, fp16={"enabled": True, "initial_scale_power": 4,
+                                  "hysteresis": 1})
     engine = make_engine(cfg)
     before = jax.device_get(jax.tree.leaves(engine.state["master"])[0]).copy()
     # poison one micro-batch to produce inf grads
