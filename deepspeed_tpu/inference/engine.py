@@ -116,12 +116,12 @@ class InferenceEngine:
             # inherit the fp leaf's spec, per-group scales follow), so
             # mp_size>1 actually divides the HBM footprint
             q = quantize_tree(params)
-            self.params = jax.device_put(
+            self.params = self._place(
                 q, quantize_shardings(q, self.param_shardings, self.mesh))
             self.quantized = True
         else:
             self.quantized = False
-            self.params = jax.device_put(params, self.param_shardings)
+            self.params = self._place(params, self.param_shardings)
 
         self._jit_forward = None
         self._jit_prefill = None
@@ -130,6 +130,29 @@ class InferenceEngine:
         log_dist(f"inference engine ready: tp={mp_size} ep={ep_size} "
                  f"dtype={jnp.dtype(dtype).name} quantized={self.quantized}",
                  ranks=[0])
+
+    # ----------------------------------------------------- multi-process
+    @staticmethod
+    def _place(tree, shardings):
+        """Place a host tree against shardings. Multi-host (reference: the
+        InferenceEngine is rank-per-GPU; here one process per host), a
+        plain device_put of host-local data onto non-addressable devices is
+        illegal — every process holds the SAME full values (deterministic
+        init / same checkpoint) and contributes its addressable shards."""
+        if jax.process_count() == 1:
+            return jax.device_put(tree, shardings)
+        return jax.tree.map(
+            lambda a, sh: jax.make_array_from_process_local_data(
+                sh, np.asarray(a), global_shape=np.asarray(a).shape),
+            tree, shardings)
+
+    def _global_input(self, x):
+        if jax.process_count() == 1:
+            return jnp.asarray(x)
+        sh = NamedSharding(self.mesh, P())
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sh, x, global_shape=x.shape)
 
     # ------------------------------------------------------------ forward
     def _materialize(self, params):
@@ -160,9 +183,10 @@ class InferenceEngine:
                     out = out[0]
                 return out
             self._jit_forward = jax.jit(f)
-        kw = {k: jnp.asarray(v) for k, v in kwargs.items()
+        kw = {k: self._global_input(v) for k, v in kwargs.items()
               if v is not None}
-        return self._jit_forward(self.params, jnp.asarray(input_ids), kw)
+        return self._jit_forward(self.params, self._global_input(input_ids),
+                                 kw)
 
     __call__ = forward
 
@@ -173,9 +197,10 @@ class InferenceEngine:
         """Greedy/temperature sampling with KV cache: one jitted prefill
         over the prompt, then a jitted per-token decode replayed
         max_new_tokens times."""
-        ids = jnp.asarray(input_ids)
+        ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
+        ids = self._global_input(ids)
         b, s = ids.shape
         max_len = getattr(getattr(self.module, "cfg", None), "max_seq_len",
                           None)

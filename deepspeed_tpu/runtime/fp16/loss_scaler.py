@@ -66,10 +66,13 @@ def update_scale(state: LossScaleState, finite: jnp.ndarray,
         shrink,
         jnp.maximum(state.cur_scale / scale_factor, min_scale),
         state.cur_scale)
-    # growth on a clean window
+    # growth on a clean window — which also restores the hysteresis budget
+    # (reference DynamicLossScaler resets it to delayed_shift on growth, so
+    # rare isolated overflows never ratchet the scale down)
     clean_window = finite & ((step - state.last_overflow_step) % scale_window == 0) \
         & (step - state.last_overflow_step >= scale_window)
     new_scale = jnp.where(clean_window, new_scale * scale_factor, new_scale)
+    hys = jnp.where(clean_window, hysteresis, hys)
     hys = jnp.where(~finite & shrink, hysteresis, hys)
     return LossScaleState(
         cur_scale=new_scale,
