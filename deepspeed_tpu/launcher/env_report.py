@@ -4,9 +4,11 @@ driving the ``ds_report`` bin script — version matrix + op build status)."""
 from __future__ import annotations
 
 import importlib
+import json
 import os
 import platform
 import shutil
+import subprocess
 import sys
 
 GREEN_OK = "\033[92m[OKAY]\033[0m"
@@ -19,6 +21,30 @@ def _try_version(mod: str):
         return getattr(m, "__version__", "unknown")
     except Exception:
         return None
+
+
+def probe_devices(timeout: float = 30.0) -> dict:
+    """Bounded device probe. Backend init can hang indefinitely when the
+    accelerator transport is wedged (reference ds_report assumes CUDA probes
+    return promptly; a wedged TPU relay does not), so the probe runs in a
+    child process with a hard timeout and never blocks the report."""
+    code = (
+        "import json, jax\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'devices': [str(d) for d in jax.devices()]}))\n")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": f"backend init timed out after {timeout:.0f}s"}
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()
+        return {"error": tail[-1] if tail else f"probe rc={out.returncode}"}
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        return {"error": "unparseable probe output"}
 
 
 def op_report() -> list:
@@ -60,17 +86,18 @@ def main() -> int:
     print("-" * 64)
     print("devices")
     print("-" * 64)
-    try:
-        import jax
-        devs = jax.devices()
-        print(f"backend ................ {jax.default_backend()}")
+    probe = probe_devices(timeout=float(os.environ.get(
+        "DS_REPORT_DEVICE_TIMEOUT", "30")))
+    if "error" in probe:
+        print(f"jax devices unavailable: {probe['error']}")
+    else:
+        devs = probe["devices"]
+        print(f"backend ................ {probe['backend']}")
         print(f"device count ........... {len(devs)}")
         for d in devs[:8]:
             print(f"  {d}")
         if len(devs) > 8:
             print(f"  ... and {len(devs) - 8} more")
-    except Exception as e:
-        print(f"jax devices unavailable: {e}")
 
     print("-" * 64)
     print("op compatibility")

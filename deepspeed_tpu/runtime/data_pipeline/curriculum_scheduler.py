@@ -32,6 +32,18 @@ class CurriculumScheduler:
             if key not in config:
                 raise ValueError(f"curriculum learning requires '{key}'")
         self.curriculum_type = config["curriculum_type"]
+        if self.curriculum_type != "seqlen":
+            # The engine honors seqlen curricula by slicing the batch's
+            # sequence axis; any other type would parse but change nothing.
+            # A parsed knob must change the compiled program or error —
+            # never silently no-op (see runtime/engine.py remat policy note).
+            raise ValueError(
+                f"curriculum_type={self.curriculum_type!r} is not supported: "
+                "only 'seqlen' curricula are honored (the batch's sequence "
+                "axis is sliced to the scheduled difficulty). Reference "
+                "analogue: deepspeed injects curriculum_seqlen kwargs "
+                "(engine.py:1577-1583); other types would silently no-op "
+                "here, so they are rejected at config time.")
         self.min_difficulty = int(config["min_difficulty"])
         self.max_difficulty = int(config["max_difficulty"])
         self.schedule_type = config["schedule_type"]

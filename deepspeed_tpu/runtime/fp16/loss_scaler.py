@@ -72,8 +72,12 @@ def update_scale(state: LossScaleState, finite: jnp.ndarray,
     clean_window = finite & ((step - state.last_overflow_step) % scale_window == 0) \
         & (step - state.last_overflow_step >= scale_window)
     new_scale = jnp.where(clean_window, new_scale * scale_factor, new_scale)
+    # the budget is only restored on the clean-window growth path: after the
+    # first shrink the exhausted budget stays exhausted, so sustained overflow
+    # halves the scale on EVERY subsequent step (matching the reference
+    # DynamicLossScaler, which leaves cur_hysteresis at 1 after a shrink —
+    # fast descent from a far-too-high scale)
     hys = jnp.where(clean_window, hysteresis, hys)
-    hys = jnp.where(~finite & shrink, hysteresis, hys)
     return LossScaleState(
         cur_scale=new_scale,
         cur_hysteresis=hys.astype(jnp.int32),

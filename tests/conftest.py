@@ -36,7 +36,7 @@ _SLOW_MODULES = {
     "test_sequence_parallel", "test_inference", "test_config_knobs",
     "test_moe", "test_bert_and_autotp", "test_bert_sparse",
     "test_features", "test_zero_init", "test_engine", "test_gpt_model",
-    "test_zero",
+    "test_zero", "test_launcher", "test_175b_plan",
 }
 
 

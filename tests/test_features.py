@@ -64,6 +64,17 @@ def test_curriculum_fixed_discrete():
             "schedule_config": {"difficulty": [1, 2], "max_step": [5, 10]}})
 
 
+def test_curriculum_non_seqlen_type_rejected():
+    # only seqlen curricula change the compiled program; anything else must
+    # error at config time rather than silently no-op
+    with pytest.raises(ValueError, match="seqlen"):
+        CurriculumScheduler({
+            "curriculum_type": "vocab_rarity", "min_difficulty": 8,
+            "max_difficulty": 64, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+
+
 def _gpt_engine(extra_cfg=None, seq=32, **gpt_kw):
     cfg = GPTConfig(vocab_size=128, max_seq_len=seq, num_layers=2,
                     num_heads=2, d_model=32, d_ff=64, dtype=jnp.float32,
