@@ -1,76 +1,410 @@
-"""Benchmark: GPT-2 125M bf16 training step on the real TPU chip.
+"""Hardware benchmark driver. Prints one JSON line per case; the flagship
+GPT-2 125M MFU line is re-printed LAST so the driver's parsed result always
+lands on it.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured MFU / 0.45 (the north-star MFU target from
-BASELINE.md; >1.0 beats the target)."""
+Hardened against a wedged TPU transport (round 3 lost its number to one
+"Unable to initialize backend" mid-run): the backend is probed in a child
+process with a hard timeout, every case runs in its own child process with
+a timeout and ONE retry, and total failure still emits a clear JSON line
+with diagnostics instead of a traceback.
 
+Cases (north-star ladder, BASELINE.md):
+  gpt2_125m_zero1       flagship MFU (round-over-round comparable)
+  ladder_zero1          largest pure-HBM model, ZeRO-1
+  ladder_zero3          same model, ZeRO-3 machinery overhead at dp=1
+  ladder_zero3_offload  ~1.3B, ZeRO-3 + host-offloaded optimizer
+                        (reference claim to beat: 50 TFlops/GPU,
+                        docs/_posts/2021-03-08-zero3-offload.md:65)
+  max_params            max params/chip per offload tier (measured HBM +
+                        host DRAM + NVMe free, documented bytes/param)
+  decode_microbench     pallas vs xla decode attention across cache fills
+
+Env knobs: BENCH_PROBE_TIMEOUT (600s), BENCH_CASE_TIMEOUT (1800s),
+BENCH_BUDGET_S (7200s), BENCH_CASES (comma list).
+"""
+
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+FLAGSHIP = "gpt2_125m_zero1"
+ALL_CASES = [FLAGSHIP, "ladder_zero1", "ladder_zero3",
+             "ladder_zero3_offload", "max_params", "decode_microbench"]
+
+# bf16 peak FLOP/s per chip by TPU generation (public spec sheets)
+_PEAKS = {"v5e": 197e12, "v5p": 459e12, "v4": 275e12, "v6e": 918e12,
+          "v3": 123e12}
 
 
-def main():
+def _device_info():
+    """Child-side: device kind, bf16 peak, usable HBM bytes."""
+    import jax
+    dev = jax.devices()[0]
+    kind = str(getattr(dev, "device_kind", dev)).lower()
+    peak = next((v for k, v in _PEAKS.items() if k in kind), 197e12)
+    try:
+        hbm = dev.memory_stats()["bytes_limit"]
+    except Exception:
+        hbm = 16e9
+    return {"device": str(dev), "kind": kind, "peak_bf16": peak, "hbm": hbm}
+
+
+def _sync(x):
+    # device_get of a scalar is the reliable sync under the axon relay
+    # (block_until_ready is not)
+    import jax
+    import jax.numpy as jnp
+    leaf = jax.tree.leaves(x)[0]
+    return float(jax.device_get(jnp.sum(leaf.astype(jnp.float32))))
+
+
+def _measure_train(engine, batch_iter_factory, warmup=2, steps=5):
+    import jax
+    for _ in range(warmup):
+        loss = engine.train_batch(batch_iter_factory())
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch_iter_factory())
+    float(jax.device_get(loss))
+    return (time.perf_counter() - t0) / steps
+
+
+def _train_case(cfg, batch, gas, zero_stage, offload, metric,
+                scan_unroll=None, vs="mfu"):
+    import numpy as np
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models.gpt import GPT, gpt2_125m, lm_loss_fn
+    from deepspeed_tpu.models.gpt import GPT, gpt_flops_per_token, lm_loss_fn
 
-    seq = 1024
-    batch = 8
-    gas = 16   # whole global batch is ONE jitted scan -> amortizes the
-               # per-dispatch relay overhead and is a realistic large-batch
-               # training config (train_batch_size=128)
+    info = _device_info()
+    model = GPT(cfg)
+    seq = cfg.max_seq_len
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    zcfg = {"stage": zero_stage}
+    if offload:
+        zcfg["offload_optimizer"] = {"device": "cpu"}
+    if offload:
+        # stream shard fills instead of materializing a replicated init
+        from deepspeed_tpu.runtime.zero.partition_params import abstract_init
+        params = abstract_init(model, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))
+    else:
+        params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=lm_loss_fn,
+        config={"train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": gas,
+                "bf16": {"enabled": True},
+                "zero_optimization": zcfg,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "steps_per_print": 100_000})
+    steps = 3 if offload else 5
+    dt = _measure_train(engine, lambda: iter([{"input_ids": ids}] * gas),
+                        warmup=1 if offload else 2, steps=steps)
+    tokens = batch * seq * gas
+    n_params = engine.num_parameters() if hasattr(engine, "num_parameters") \
+        else sum(int(p.size) for p in jax.tree.leaves(params))
+    # gpt_flops_per_token is ALREADY the full training number (6N fwd+bwd
+    # + attention term) — no extra fwd/bwd factor
+    achieved = gpt_flops_per_token(cfg, seq) * tokens / dt
+    mfu = achieved / info["peak_bf16"]
+    if vs == "tflops50":
+        value = round(achieved / 1e12, 1)           # TFLOP/s, as named
+        vs_baseline = round(value / 50.0, 4)
+    else:
+        value = round(mfu, 4)
+        vs_baseline = round(mfu / 0.45, 4)
+    return {"metric": metric, "value": value,
+            "unit": (f"{'TFLOP/s' if vs == 'tflops50' else 'MFU'} "
+                     f"(tokens/s={tokens / dt:.0f}, "
+                     f"{achieved / 1e12:.1f} TFLOP/s, MFU={mfu:.4f}, "
+                     f"{n_params / 1e6:.0f}M params, zero{zero_stage}"
+                     f"{'+cpu-offload' if offload else ''}, "
+                     f"{info['kind']})"),
+            "vs_baseline": vs_baseline}
+
+
+# --------------------------------------------------------------------- cases
+
+def case_gpt2_125m_zero1():
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import gpt2_125m
     # full scan unroll: layers inline into one program so XLA schedules
     # across layer boundaries (+20% tokens/s at 125M; compile ~2min once)
-    cfg = gpt2_125m(max_seq_len=seq, dtype=jnp.bfloat16, scan_unroll=12)
-    model = GPT(cfg)
+    cfg = gpt2_125m(max_seq_len=1024, dtype=jnp.bfloat16, scan_unroll=12)
+    return _train_case(cfg, batch=8, gas=16, zero_stage=1, offload=False,
+                       metric="gpt2_125m_train_mfu")
+
+
+def _cfg_params(cfg) -> int:
+    """Dense GPT param count from config geometry (single source for all
+    fit predictions in this file)."""
+    return ((12 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff)
+            * cfg.num_layers + cfg.vocab_size * cfg.d_model
+            + cfg.max_seq_len * cfg.d_model)
+
+
+def _ladder_cfg(hbm, bytes_per_param, reserve=2e9, headroom=0.92):
+    """Largest ladder model predicted to fit: n*bpp + reserve < hbm*head."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPTConfig, gpt2_1_3b
+    # param_dtype=bf16 halves the transient replicated-init copy (the
+    # engine's persistent master is fp32 either way)
+    menu = [
+        ("gpt2_1.3b", gpt2_1_3b(max_seq_len=1024, dtype=jnp.bfloat16,
+                                param_dtype=jnp.bfloat16)),
+        ("gpt_760m", GPTConfig(num_layers=24, num_heads=16, d_model=1536,
+                               d_ff=6144, max_seq_len=1024,
+                               dtype=jnp.bfloat16,
+                               param_dtype=jnp.bfloat16)),
+        ("gpt_350m", GPTConfig(num_layers=24, num_heads=16, d_model=1024,
+                               d_ff=4096, max_seq_len=1024,
+                               dtype=jnp.bfloat16,
+                               param_dtype=jnp.bfloat16)),
+    ]
+    for name, cfg in menu:
+        if _cfg_params(cfg) * bytes_per_param + reserve < hbm * headroom:
+            return name, cfg
+    return menu[-1]
+
+
+def case_ladder_zero1():
+    info = _device_info()
+    # dp=1 pure-HBM state: fp32 master+m+v (12) + fp32 acc (4) + bf16 (2)
+    name, cfg = _ladder_cfg(info["hbm"], bytes_per_param=18)
+    r = _train_case(cfg, batch=4, gas=4, zero_stage=1, offload=False,
+                    metric=f"ladder_{name}_zero1_mfu")
+    return r
+
+
+def case_ladder_zero3():
+    info = _device_info()
+    name, cfg = _ladder_cfg(info["hbm"], bytes_per_param=18)
+    return _train_case(cfg, batch=4, gas=4, zero_stage=3, offload=False,
+                       metric=f"ladder_{name}_zero3_mfu")
+
+
+def case_ladder_zero3_offload():
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import gpt2_1_3b
+    info = _device_info()
+    # device side: bf16 params (2) + fp32 acc (4); optimizer lives on host
+    name, cfg = "gpt2_1.3b", gpt2_1_3b(max_seq_len=1024, dtype=jnp.bfloat16)
+    if _cfg_params(cfg) * 6 + 2e9 > info["hbm"] * 0.92:
+        name, cfg = _ladder_cfg(info["hbm"], bytes_per_param=6)
+    return _train_case(cfg, batch=4, gas=2, zero_stage=3, offload=True,
+                       metric=f"ladder_{name}_zero3_offload_tflops",
+                       vs="tflops50")
+
+
+def case_max_params():
+    """Max params/chip per tier. bytes/param: pure-HBM ZeRO-1/2/3 at dp=1
+    keep fp32 master+m+v+acc and a bf16 compute copy (18); host offload
+    keeps bf16 params + fp32 acc on device (6) and master+m+v on host
+    (12); NVMe offload additionally mirrors bf16 params on disk (14/param
+    on NVMe, host DRAM holds only staging windows). Reference analogue:
+    13B/40B-on-one-V100 claims, docs/_posts/2021-03-08-zero3-offload.md:9."""
+    info = _device_info()
+    hbm_usable = info["hbm"] * 0.92 - 2e9
+    with open("/proc/meminfo") as f:
+        host = int(f.read().split("MemAvailable:")[1].split()[0]) * 1024
+    import shutil
+    nvme = shutil.disk_usage("/tmp").free
+    tiers = {
+        "hbm_only": hbm_usable / 18,
+        "host_offload": min(hbm_usable / 6, host * 0.9 / 12),
+        "nvme_offload": min(hbm_usable / 6, nvme * 0.9 / 14),
+    }
+    return {"metric": "max_params_per_chip_B",
+            "value": round(tiers["nvme_offload"] / 1e9, 2),
+            "unit": (f"B params (hbm_only={tiers['hbm_only'] / 1e9:.2f}B, "
+                     f"host_offload={tiers['host_offload'] / 1e9:.2f}B, "
+                     f"nvme_offload={tiers['nvme_offload'] / 1e9:.2f}B; "
+                     f"hbm={info['hbm'] / 1e9:.0f}GB host={host / 1e9:.0f}GB "
+                     f"nvme_free={nvme / 1e9:.0f}GB, {info['kind']})"),
+            "vs_baseline": round(tiers["nvme_offload"] / 1e9 / 40.0, 4)}
+
+
+def case_decode_microbench():
+    """Op-level decode attention: Pallas DMA-pipeline kernel (O(fill) HBM
+    traffic) vs the masked-einsum XLA path (O(max_seq) traffic) at GPT-2
+    125M geometry. Decides models/gpt.py decode_impl default."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.pallas.decode_attention import (
+        decode_attention, masked_cache_attention, pallas_decode_supported)
+    b, S, h, d = 8, 8192, 12, 64
+    dt = jnp.bfloat16
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), dt)
+    ck4 = jnp.asarray(rng.standard_normal((b, S, h, d)), dt)
+    cv4 = jnp.asarray(rng.standard_normal((b, S, h, d)), dt)
+    ck = ck4.reshape(b, S, h * d)
+    cv = cv4.reshape(b, S, h * d)
+    scale = 1.0 / (d ** 0.5)
+    assert pallas_decode_supported(b, S, h, d, dt)
 
-    engine, _, _, _ = ds.initialize(
-        model=model, model_parameters=params, loss_fn=lm_loss_fn,
-        config={
-            "train_micro_batch_size_per_gpu": batch,
-            "gradient_accumulation_steps": gas,
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 1},
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "steps_per_print": 1000,
-        })
+    pal = jax.jit(lambda q, k, v, n: decode_attention(q, k, v, n,
+                                                      scale=scale))
+    xla = jax.jit(lambda q, k, v, n: masked_cache_attention(
+        q, k, v, n - 1, scale))
 
-    it = lambda: iter([{"input_ids": ids}] * gas)
-    # warmup / compile. NOTE: device_get of the scalar loss is the sync —
-    # block_until_ready is not reliable under the axon relay.
-    for _ in range(3):
-        loss = engine.train_batch(it())
-    float(jax.device_get(loss))
+    def timed(fn, *args, reps=20):
+        _sync(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        _sync(out)
+        return (time.perf_counter() - t0) / reps * 1e3  # ms
 
-    steps = 6
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(it())
-    float(jax.device_get(loss))
-    dt = (time.perf_counter() - t0) / steps
+    fills, rows, speedups = [128, 512, 2048, 8192], [], []
+    for f in fills:
+        n = jnp.asarray(f, jnp.int32)
+        ms_p = timed(pal, q, ck, cv, n)
+        ms_x = timed(xla, q, ck4, cv4, n)
+        err = float(jnp.max(jnp.abs(
+            pal(q, ck, cv, n).astype(jnp.float32)
+            - xla(q, ck4, cv4, n).astype(jnp.float32))))
+        rows.append(f"fill={f}: pallas={ms_p:.3f}ms xla={ms_x:.3f}ms "
+                    f"({ms_x / ms_p:.2f}x, maxerr={err:.3g})")
+        speedups.append(ms_x / ms_p)
+    geo = float(np.prod(speedups) ** (1 / len(speedups)))
+    return {"metric": "decode_pallas_vs_xla_speedup", "value": round(geo, 3),
+            "unit": "; ".join(rows),
+            "vs_baseline": round(geo, 3)}
 
-    n_params = sum(int(p.size) for p in jax.tree.leaves(params))
-    tokens = batch * seq * gas
-    # training flops: 6*N per token + attention 12*L*d*s per token
-    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * cfg.d_model * seq
-    achieved = flops_per_token * tokens / dt
-    # bf16 peak per chip: v5e ~197 TFLOPs, v5p ~459 TFLOPs
-    dev = jax.devices()[0]
-    peak = 459e12 if "v5p" in str(dev).lower() else 197e12
-    mfu = achieved / peak
 
+CASE_FNS = {
+    "gpt2_125m_zero1": case_gpt2_125m_zero1,
+    "ladder_zero1": case_ladder_zero1,
+    "ladder_zero3": case_ladder_zero3,
+    "ladder_zero3_offload": case_ladder_zero3_offload,
+    "max_params": case_max_params,
+    "decode_microbench": case_decode_microbench,
+}
+
+
+# ------------------------------------------------------------- orchestration
+# NOTE: this parent process must never import jax/deepspeed_tpu — a wedged
+# TPU transport hangs the import itself — so the child-run helper is local
+# rather than shared with launcher/env_report.probe_devices.
+
+def _run_child(cmd, timeout, want_key):
+    """Run a child, return (last JSON dict containing want_key, error)."""
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout:.0f}s"
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict) and want_key in obj:
+                return obj, None
+        except ValueError:
+            continue
+    tail = ((p.stderr or "").strip().splitlines() or ["no output"])[-1]
+    return None, f"rc={p.returncode}: {tail[:300]}"
+
+
+def _probe(timeout):
+    code = ("import sys, json; sys.path.insert(0, %r); "
+            "from bench import _device_info; "
+            "print(json.dumps(_device_info()))" % os.path.dirname(
+                os.path.abspath(__file__)))
+    return _run_child([sys.executable, "-c", code], timeout, "device")
+
+
+def _run_case(name, timeout):
+    return _run_child(
+        [sys.executable, os.path.abspath(__file__), "--case", name],
+        timeout, "metric")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--case", choices=sorted(CASE_FNS))
+    args = ap.parse_args()
+    if args.case:  # child mode
+        print(json.dumps(CASE_FNS[args.case]()), flush=True)
+        return 0
+
+    t_start = time.time()
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
+    case_timeout = float(os.environ.get("BENCH_CASE_TIMEOUT", "1800"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "7200"))
+    asked = [c for c in os.environ.get(
+        "BENCH_CASES", ",".join(ALL_CASES)).split(",") if c]
+    cases = [c for c in asked if c in CASE_FNS]
+    for bad in set(asked) - set(cases):
+        print(f"[bench] unknown case {bad!r} ignored "
+              f"(valid: {','.join(sorted(CASE_FNS))})", file=sys.stderr)
+    if not cases:
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0,
+            "unit": f"no valid cases in BENCH_CASES={asked}",
+            "vs_baseline": 0.0}), flush=True)
+        return 1
+
+    info, err = _probe(probe_timeout)
+    if info is None:
+        print(f"[bench] probe failed ({err}); retrying once", file=sys.stderr)
+        info, err = _probe(probe_timeout)
+    if info is None:
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0,
+            "unit": f"backend unavailable after 2 probes: {err}",
+            "vs_baseline": 0.0}), flush=True)
+        return 1
+    print(f"[bench] device: {info['device']} "
+          f"hbm={info['hbm'] / 1e9:.0f}GB", file=sys.stderr)
+
+    flagship_line, failures = None, []
+    for name in cases:
+        remaining = budget - (time.time() - t_start)
+        if remaining <= 0:
+            print(f"[bench] budget exhausted, skipping {name}",
+                  file=sys.stderr)
+            failures.append(f"{name}: skipped (budget)")
+            continue
+        # a case (and its retry) never overshoots the remaining budget
+        obj, err = _run_case(name, min(case_timeout, remaining))
+        if obj is None:
+            remaining = budget - (time.time() - t_start)
+            if remaining <= 0:
+                failures.append(f"{name}: {err}; no budget for retry")
+                print(f"[bench] {name} failed ({err}); budget spent",
+                      file=sys.stderr)
+                continue
+            print(f"[bench] {name} failed ({err}); retrying once",
+                  file=sys.stderr)
+            obj, err = _run_case(name, min(case_timeout, remaining))
+        if obj is None:
+            failures.append(f"{name}: {err}")
+            print(f"[bench] {name} failed twice: {err}", file=sys.stderr)
+            continue
+        print(json.dumps(obj), flush=True)
+        if name == FLAGSHIP:
+            flagship_line = obj
+
+    if flagship_line is not None:
+        print(json.dumps(flagship_line), flush=True)  # parsed lands here
+        return 0
+    if FLAGSHIP not in cases:  # explicitly restricted run
+        return 0
     print(json.dumps({
-        "metric": "gpt2_125m_train_mfu",
-        "value": round(mfu, 4),
-        "unit": f"MFU (tokens/s={tokens/dt:.0f}, {achieved/1e12:.1f} TFLOP/s)",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
+        "metric": "bench_failed", "value": 0.0,
+        "unit": "flagship case failed: " + "; ".join(failures)[:400],
+        "vs_baseline": 0.0}), flush=True)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -65,7 +65,8 @@ def rung_gpt125m(quick: bool):
                 "steps_per_print": 10_000})
     toks, dt = _train_tput(engine, lambda: iter([{"input_ids": ids}] * gas),
                            batch * gas * seq)
-    flops = toks * gpt_flops_per_token(cfg, seq) * 3
+    # gpt_flops_per_token is already the full training number (6N + attn)
+    flops = toks * gpt_flops_per_token(cfg, seq)
     return {"config": "gpt2_125m_zero1", "tokens_per_sec": round(toks),
             "tflops": round(flops / 1e12, 1), "step_ms": round(dt * 1e3, 1)}
 

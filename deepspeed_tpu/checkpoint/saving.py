@@ -134,6 +134,18 @@ def load_sharded_tree(path: str, template, shardings=None):
                          _abstract_like(template, shardings))
 
 
+def drop_recovery_script(ckpt_dir: str) -> None:
+    """Copy the standalone zero_to_fp32.py into the checkpoint dir so the
+    checkpoint is recoverable with numpy alone (reference: engine.py:3066-3075
+    copies deepspeed/utils/zero_to_fp32.py into every checkpoint)."""
+    from . import zero_to_fp32
+    src = zero_to_fp32.__file__
+    try:
+        shutil.copyfile(src, os.path.join(ckpt_dir, "zero_to_fp32.py"))
+    except OSError as e:  # never fail a save over the convenience script
+        log_dist(f"could not drop zero_to_fp32.py: {e}", ranks=[0])
+
+
 def save_checkpoint_dir(save_dir: str, tag: str, *, master_params, opt_state,
                         meta: Dict[str, Any], sharded: bool = False) -> str:
     ckpt_dir = os.path.join(save_dir, tag)
@@ -153,6 +165,7 @@ def save_checkpoint_dir(save_dir: str, tag: str, *, master_params, opt_state,
             json.dump(meta, fh, indent=2)
         with open(os.path.join(save_dir, "latest"), "w") as fh:
             fh.write(tag)
+        drop_recovery_script(ckpt_dir)
     log_dist(f"saved checkpoint {ckpt_dir}"
              f"{' (sharded)' if sharded else ''}", ranks=[0])
     return ckpt_dir

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Standalone fp32 weight recovery from a deepspeed_tpu checkpoint directory.
+
+This file is copied into every checkpoint directory by ``save_checkpoint``
+(reference analogue: ``deepspeed/utils/zero_to_fp32.py``, dropped in at
+``engine.py:3066-3075``) so a checkpoint is recoverable with nothing but the
+files in the directory and numpy — no framework, no jax, no TPU.
+
+Supported formats (``meta.json`` ``format`` field / file layout):
+
+  * npz ("small" format): ``model_states.npz`` already holds the full fp32
+    master weights, path-keyed — this script just re-exports them.
+  * host_sharded (ZeRO-offload/Infinity tier): ``zero_host_shard_pN.npz`` +
+    ``.json`` pairs hold each host's contiguous slice of every flattened
+    leaf (the reference's ``zero_pp_rank_*_optim_states.pt`` scheme). The
+    slices are merged by offset, truncated to ``global_numel`` (padding laid
+    past it), and reshaped to the recorded shape.
+  * sharded (orbax OCDBT directories): not numpy-readable; this script
+    reports the one-liner that consolidates it with the framework installed.
+
+Usage:
+    python zero_to_fp32.py <checkpoint_dir> [output.npz]
+
+where <checkpoint_dir> is either a tag directory (contains meta.json) or a
+save root (contains ``latest``). Writes ``output.npz`` (default
+``fp32_weights.npz`` inside the tag dir), path-keyed fp32 arrays, loadable
+with ``numpy.load``.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _resolve_tag_dir(path):
+    if os.path.isfile(os.path.join(path, "meta.json")) or glob.glob(
+            os.path.join(path, "zero_host_shard_p*.json")):
+        return path
+    latest = os.path.join(path, "latest")
+    if os.path.isfile(latest):
+        with open(latest) as fh:
+            tag = fh.read().strip()
+        return os.path.join(path, tag)
+    raise FileNotFoundError(
+        f"{path!r} is neither a checkpoint tag dir (no meta.json) nor a "
+        "save root (no 'latest' file)")
+
+
+def _from_npz(tag_dir):
+    path = os.path.join(tag_dir, "model_states.npz")
+    with np.load(path, allow_pickle=False) as f:
+        return {k: f[k].astype(np.float32) for k in f.files}
+
+
+def _from_host_shards(tag_dir):
+    metas = []
+    for jpath in sorted(glob.glob(
+            os.path.join(tag_dir, "zero_host_shard_p*.json"))):
+        with open(jpath) as fh:
+            m = json.load(fh)
+        m["_npz"] = jpath[:-5] + ".npz"
+        metas.append(m)
+    if not metas:
+        raise FileNotFoundError(
+            f"no zero_host_shard_p*.json files in {tag_dir}")
+    n_leaves = len(metas[0]["leaves"])
+    for m in metas:
+        if len(m["leaves"]) != n_leaves:
+            raise ValueError("inconsistent leaf counts across shard files")
+    infos = metas[0]["leaves"]
+    for info in infos:
+        if "shape" not in info:
+            raise ValueError(
+                "shard files predate self-describing metadata (no 'shape'); "
+                "re-save the checkpoint or consolidate in-process with "
+                "engine.consolidated_fp32_state_dict()")
+    flats = [np.zeros(int(i["global_numel"]), np.float32) for i in infos]
+    filled = [np.zeros(int(i["global_numel"]), bool) for i in infos]
+    # one zip open per shard file (not per leaf x shard)
+    for m in metas:
+        with np.load(m["_npz"], allow_pickle=False) as f:
+            for i, info in enumerate(infos):
+                li = m["leaves"][i]
+                if li["path"] != info["path"]:
+                    raise ValueError(
+                        f"leaf {i} path mismatch across shards: "
+                        f"{li['path']!r} vs {info['path']!r}")
+                arr = f[f"{i}:master"]
+                total = len(flats[i])
+                lo = int(li["offset"])
+                hi = min(lo + len(arr), total)
+                if hi > lo:
+                    flats[i][lo:hi] = arr[:hi - lo]
+                    filled[i][lo:hi] = True
+    out = {}
+    for i, info in enumerate(infos):
+        if not filled[i].all():
+            missing = int((~filled[i]).sum())
+            raise ValueError(
+                f"leaf {info['path']!r}: {missing}/{len(flats[i])} elements "
+                "not covered by any shard file — incomplete checkpoint "
+                "(a host's shard file is missing)")
+        shape = tuple(info["shape"])
+        out[info["path"]] = flats[i].reshape(shape) if shape else flats[i][0]
+    return out
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Full fp32 weights as {path: np.ndarray} from a checkpoint dir."""
+    if tag is not None:
+        checkpoint_dir = os.path.join(checkpoint_dir, tag)
+    tag_dir = _resolve_tag_dir(checkpoint_dir)
+    if os.path.isfile(os.path.join(tag_dir, "model_states.npz")):
+        return _from_npz(tag_dir)
+    if glob.glob(os.path.join(tag_dir, "zero_host_shard_p*.json")):
+        return _from_host_shards(tag_dir)
+    if os.path.isdir(os.path.join(tag_dir, "model_states")):
+        raise RuntimeError(
+            "this checkpoint uses the orbax OCDBT sharded format, which is "
+            "not numpy-readable. With the framework installed run:\n"
+            "  from deepspeed_tpu.checkpoint.saving import load_sharded_tree"
+            "\n(engine.load_checkpoint consolidates it automatically)")
+    raise FileNotFoundError(f"no recognizable model states in {tag_dir}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Reconstruct full fp32 weights from a deepspeed_tpu "
+                    "checkpoint (numpy only, no framework needed)")
+    ap.add_argument("checkpoint_dir",
+                    help="tag dir (has meta.json) or save root (has latest)")
+    ap.add_argument("output", nargs="?", default=None,
+                    help="output .npz (default: fp32_weights.npz in tag dir)")
+    args = ap.parse_args(argv)
+    tag_dir = _resolve_tag_dir(args.checkpoint_dir)
+    state = get_fp32_state_dict_from_zero_checkpoint(tag_dir)
+    out = args.output or os.path.join(tag_dir, "fp32_weights.npz")
+    np.savez(out, **state)
+    total = sum(int(v.size) for v in state.values())
+    print(f"wrote {len(state)} tensors ({total:,} params, fp32) -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -745,8 +745,7 @@ class DeepSpeedEngine:
         — here the batch itself is cut so attention/loss shapes shrink with
         difficulty, which is where the TPU speedup comes from)."""
         diff = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
-        if self.curriculum_scheduler.curriculum_type != "seqlen":
-            return batches
+        # non-seqlen types are rejected at CurriculumScheduler construction
         axis = 2 if stacked else 1
 
         def cut(x):
@@ -1053,6 +1052,7 @@ class DeepSpeedEngine:
                 _json.dump(dict(meta, format="host_sharded"), fh, indent=2)
             with open(os.path.join(save_dir, "latest"), "w") as fh:
                 fh.write(tag)
+            ckpt_saving.drop_recovery_script(ckpt_dir)
         log_dist(f"saved host-sharded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir
 
@@ -1359,11 +1359,15 @@ class DeepSpeedEngine:
             since = step - self._host_last_overflow
             if since >= window and since % window == 0:
                 self._host_scale *= 2.0
+                # only the clean-window growth path restores the budget:
+                # under sustained overflow the scale then halves every step
+                # (reference DynamicLossScaler leaves cur_hysteresis at 1
+                # after the first shrink — fast descent from a bad scale)
+                self._host_hysteresis = self.config.fp16.hysteresis
         else:
             if self._host_hysteresis <= 1:
                 self._host_scale = max(self._host_scale / 2.0,
                                        self.config.fp16.min_loss_scale)
-                self._host_hysteresis = self.config.fp16.hysteresis
             else:
                 self._host_hysteresis -= 1
             self._host_last_overflow = step
