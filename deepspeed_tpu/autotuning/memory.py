@@ -89,6 +89,85 @@ def max_micro_batch_for_budget(budget_bytes: float, *, num_params: int,
     return max(0, int((budget_bytes - states) // per_sample))
 
 
+# Published TPU pod-slice host topology: chips per host and host DRAM.
+# v5p hosts carry 4 chips and ~448GB DRAM; the planner defaults stay
+# conservative (400GB usable) so a plan that "fits" here fits in practice.
+TPU_HOST = {
+    "v5e": {"chips_per_host": 8, "host_dram": 256e9},
+    "v5p": {"chips_per_host": 4, "host_dram": 400e9},
+    "v4": {"chips_per_host": 4, "host_dram": 256e9},
+}
+
+
+def plan_infinity(leaf_numels, *, chips: int, hosts: int,
+                  hbm_per_chip: float, host_dram_per_host: float,
+                  nvme_per_host: float,
+                  micro_batch: int = 1, seq_len: int = 2048,
+                  hidden: int = 12288, layers: int = 96,
+                  prefetch_numel: int = 0, mirror_on_nvme: bool = True,
+                  headroom: float = 0.10) -> Dict[str, object]:
+    """Capacity plan for the ZeRO-Infinity tier (offload_optimizer=nvme +
+    offload_param=nvme): every budget is derived from what the runtime
+    classes actually allocate, per tier:
+
+      * NVMe/host   — per-leaf [master|m|v] fp32 swap files
+                      (``NVMeLeafSwapper.write_init``: 12 B/param local) +
+                      compute-dtype mirrors (``MirrorNVMeStore``: 2 B/param)
+      * DRAM/host   — the swapper's slot windows ((1+depth) buffers of
+                      3 x largest leaf shard, fp32; ``NVMeLeafSwapper``) +
+                      one full set of local grad shards (the engine streams
+                      ALL grad flats D2H before the leaf loop,
+                      ``engine._offload_train_batch``) + one mirror staging
+                      window (largest leaf shard, 2 B)
+      * HBM/chip    — transient compute params (bf16 / chips; params are
+                      rebuilt from mirrors and donated each step,
+                      ``engine._params_resident=False``) + fp32 grad
+                      accumulator shard (4 B / chips) + activations (remat)
+
+    Leaves are dp-sharded exactly as ``_Leaf`` shards them: ceil(numel/dp)
+    per rank, ranks-per-host slices per host.
+
+    Reference analogues: the 175B/512-GPU fit tables in
+    ``docs/_posts/2021-03-08-zero3-offload.md:51`` and the pipelined
+    optimizer swapper (``swap_tensor/pipelined_optimizer_swapper.py:61``).
+    Returns the plan dict; ``plan["fits"]`` is True only when every tier
+    fits within ``1 - headroom`` of its budget."""
+    from ..runtime.zero.offload import NVMeLeafSwapper
+
+    dp = chips
+    ranks_per_host = max(1, chips // hosts)
+    n_global = int(sum(leaf_numels))
+    shard_lens = [-(-int(n) // dp) for n in leaf_numels]       # ceil
+    local_numel = sum(s * ranks_per_host for s in shard_lens)  # per host
+    max_shard = max(shard_lens)
+
+    depth = NVMeLeafSwapper.window_depth(max_shard, prefetch_numel)
+    slots = 1 + depth
+    nvme = local_numel * 12.0 + (local_numel * 2.0 if mirror_on_nvme else 0.0)
+    dram = (slots * 3 * max_shard * 4.0      # swapper slot windows
+            + local_numel * 4.0              # D2H grad shards (fp32)
+            + max_shard * 2.0)               # mirror upload staging
+    acts = activation_memory_per_chip(
+        micro_batch=micro_batch, seq_len=seq_len, hidden=hidden,
+        layers=layers, checkpoint_activations=True)
+    hbm = n_global * 2.0 / chips + n_global * 4.0 / chips + acts
+
+    fit = lambda used, budget: used <= budget * (1.0 - headroom)
+    plan = {
+        "params": n_global, "chips": chips, "hosts": hosts,
+        "swap_window_slots": slots,
+        "nvme_bytes_per_host": nvme, "nvme_budget": nvme_per_host,
+        "dram_bytes_per_host": dram, "dram_budget": host_dram_per_host,
+        "hbm_bytes_per_chip": hbm, "hbm_budget": hbm_per_chip,
+        "fits_nvme": fit(nvme, nvme_per_host),
+        "fits_dram": fit(dram, host_dram_per_host),
+        "fits_hbm": fit(hbm, hbm_per_chip),
+    }
+    plan["fits"] = bool(plan["fits_nvme"] and plan["fits_dram"]
+                        and plan["fits_hbm"])
+    return plan
+
+
 def estimate_zero_model_states_mem_needs(num_params: int,
                                          num_chips_per_host: int = 4,
                                          num_hosts: int = 1) -> Dict[int, float]:

@@ -17,6 +17,38 @@ import numpy as np
 
 from .op_builder import get_native_lib
 
+# O_DIRECT granularity: 4096 covers every modern NVMe/filesystem (logical
+# block 512 or 4096). Buffers, lengths and offsets must all be multiples.
+DIRECT_ALIGN = 4096
+
+
+def padded_nbytes(nbytes: int) -> int:
+    """Round a transfer length up to the O_DIRECT granularity."""
+    return -(-int(nbytes) // DIRECT_ALIGN) * DIRECT_ALIGN
+
+
+def aligned_empty(n: int, dtype=np.float32) -> np.ndarray:
+    """Uninitialized 1-D array holding AT LEAST ``n`` elements: the data
+    pointer is DIRECT_ALIGN-aligned and the returned length is rounded up
+    to the alignment boundary, so ``arr[:k]`` slices serve compute while
+    ``arr[:padded_count]`` slices serve direct I/O without leaving the
+    allocation. (The reference pins + aligns its aio buffers the same way,
+    csrc/aio/common/deepspeed_aio_utils.cpp.)"""
+    itemsize = np.dtype(dtype).itemsize
+    padded = padded_nbytes(n * itemsize)
+    assert padded % itemsize == 0
+    raw = np.empty(padded + DIRECT_ALIGN, np.uint8)
+    off = (-raw.ctypes.data) % DIRECT_ALIGN
+    view = raw[off:off + padded].view(dtype)
+    assert view.ctypes.data % DIRECT_ALIGN == 0
+    return view
+
+
+def _is_direct_ok(array: np.ndarray, nbytes: int, offset: int) -> bool:
+    return (array.ctypes.data % DIRECT_ALIGN == 0
+            and nbytes % DIRECT_ALIGN == 0
+            and offset % DIRECT_ALIGN == 0)
+
 
 class AsyncIOHandle:
     """Thread-pooled async file reader/writer over the native engine.
@@ -45,10 +77,19 @@ class AsyncIOHandle:
         return self._handle is not None
 
     # ------------------------------------------------------------- async
-    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0):
+    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0,
+                     direct: bool = False):
+        """``direct=True`` bypasses the page cache (O_DIRECT; the reference
+        aio engine always runs this way): the caller must pass an
+        ``aligned_empty`` buffer sliced to a ``padded_nbytes`` length and an
+        aligned offset — asserted, because silent fallback would re-enable
+        cache pollution at Infinity scale without anyone noticing."""
         array = np.ascontiguousarray(array)
         if self._handle is not None:
-            fd = self._lib.aio_open(path.encode(), 1, 0)
+            if direct:
+                assert _is_direct_ok(array, array.nbytes, offset), \
+                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+            fd = self._lib.aio_open(path.encode(), 1, 1 if direct else 0)
             if fd < 0:
                 raise OSError(f"aio_open failed for {path}")
             self._fds.append(fd)
@@ -61,10 +102,14 @@ class AsyncIOHandle:
             self._pending_py.append(("w", array, path, offset))
         return 1
 
-    def async_pread(self, array: np.ndarray, path: str, offset: int = 0):
+    def async_pread(self, array: np.ndarray, path: str, offset: int = 0,
+                    direct: bool = False):
         assert array.flags["C_CONTIGUOUS"]
         if self._handle is not None:
-            fd = self._lib.aio_open(path.encode(), 0, 0)
+            if direct:
+                assert _is_direct_ok(array, array.nbytes, offset), \
+                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+            fd = self._lib.aio_open(path.encode(), 0, 1 if direct else 0)
             if fd < 0:
                 raise OSError(f"aio_open failed for {path}")
             self._fds.append(fd)
@@ -95,10 +140,14 @@ class AsyncIOHandle:
         return 0
 
     # -------------------------------------------------------------- sync
-    def sync_pwrite(self, array: np.ndarray, path: str, offset: int = 0):
+    def sync_pwrite(self, array: np.ndarray, path: str, offset: int = 0,
+                    direct: bool = False):
         array = np.ascontiguousarray(array)
         if self._lib is not None:
-            fd = self._lib.aio_open(path.encode(), 1, 0)
+            if direct:
+                assert _is_direct_ok(array, array.nbytes, offset), \
+                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+            fd = self._lib.aio_open(path.encode(), 1, 1 if direct else 0)
             try:
                 rc = self._lib.aio_sync_pwrite(
                     fd, array.ctypes.data_as(ctypes.c_void_p),
@@ -113,10 +162,14 @@ class AsyncIOHandle:
             f.write(array.tobytes())
         return array.nbytes
 
-    def sync_pread(self, array: np.ndarray, path: str, offset: int = 0):
+    def sync_pread(self, array: np.ndarray, path: str, offset: int = 0,
+                   direct: bool = False):
         assert array.flags["C_CONTIGUOUS"]
         if self._lib is not None:
-            fd = self._lib.aio_open(path.encode(), 0, 0)
+            if direct:
+                assert _is_direct_ok(array, array.nbytes, offset), \
+                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+            fd = self._lib.aio_open(path.encode(), 0, 1 if direct else 0)
             try:
                 rc = self._lib.aio_sync_pread(
                     fd, array.ctypes.data_as(ctypes.c_void_p),

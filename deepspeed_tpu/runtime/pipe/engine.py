@@ -197,6 +197,7 @@ class PipelineEngine:
         runtime/pipe/topology.py:246)."""
         shape = self._mesh_shape
         self._per_stage_mesh = shape.pp == self.num_stages and shape.pp > 1
+        self._stage_tp = shape.tp
         self._stage_dp = shape.dp
         self._stage_ep = shape.ep
         self._stage_sp = shape.sp
@@ -223,7 +224,14 @@ class PipelineEngine:
             parts.append("dp")
         else:
             parts.append(None)
-        if nd >= 2 and self._stage_sp > 1 and x.shape[1] % self._stage_sp == 0:
+        # dim 1 is only treated as a sequence axis when the tensor is
+        # clearly sequence-shaped: rank>=3 activations [B, S, D] or rank-2
+        # integer token ids [B, S]. A rank-2 float [B, F] feature tensor on
+        # an sp>1 mesh must NOT be sharded on its feature dim just because
+        # F happens to divide sp.
+        seq_shaped = nd >= 3 or (
+            nd == 2 and jnp.issubdtype(x.dtype, jnp.integer))
+        if seq_shaped and self._stage_sp > 1 and x.shape[1] % self._stage_sp == 0:
             parts.append("sp")
         if not any(a for a in parts):
             return P()
@@ -245,10 +253,18 @@ class PipelineEngine:
         engine.py:2171-2186); with ``want_dp`` (ZeRO) the first remaining
         divisible dim shards over stage-dp (flat-partition analogue,
         stage_1_and_2.py:228-254)."""
-        from ..sharding import _EXPERT_PAT
+        from ..sharding import _EXPERT_PAT, tp_spec
         parts = [None] * len(shape)
+        if self._stage_tp > 1:
+            # Megatron column/row split inside each stage (reference
+            # PipeModelDataParallelTopology, pipe/topology.py:246); XLA
+            # inserts the row-parallel psum in the stage program. Dims the
+            # axis doesn't divide stay replicated.
+            parts = [a if (a == "tp" and shape[i] % self._stage_tp == 0)
+                     else None
+                     for i, a in enumerate(tp_spec(path, len(shape)))]
         if self._stage_ep > 1 and _EXPERT_PAT.search(path) and shape \
-                and shape[0] % self._stage_ep == 0:
+                and parts[0] is None and shape[0] % self._stage_ep == 0:
             parts[0] = "ep"
         if want_dp and self._stage_dp > 1:
             for i, d in enumerate(shape):

@@ -42,7 +42,7 @@ try:
 except ImportError:  # ml_dtypes ships with jax; belt and braces
     _BF16 = None
 
-from ...ops.aio import AsyncIOHandle
+from ...ops.aio import AsyncIOHandle, aligned_empty, padded_nbytes
 from ...ops.cpu_adam import DeepSpeedCPUAdam, f32_to_bf16_bits
 from ...utils.logging import log_dist
 from ..sharding import path_str
@@ -188,19 +188,26 @@ class MirrorNVMeStore:
                       num_threads=aio_cfg.thread_count)
         self.handle = AsyncIOHandle(**kw)
         max_numel = max((l.numel for l in leaves), default=1)
-        self._staging = np.zeros(max_numel * self.itemsize, np.uint8)
+        # DIRECT_ALIGN-aligned so every transfer runs O_DIRECT: Infinity
+        # swap traffic must not churn the host page cache (the reference aio
+        # engine is O_DIRECT throughout, csrc/aio/common)
+        self._staging = aligned_empty(max_numel * self.itemsize, np.uint8)
 
     def _file(self, idx: int) -> str:
         return os.path.join(self.path, f"mirror_{idx}.bin")
 
     def write(self, idx: int, mirror_bytes: np.ndarray) -> None:
-        self.handle.sync_pwrite(mirror_bytes.view(np.uint8).reshape(-1),
-                                self._file(idx))
+        flat = mirror_bytes.view(np.uint8).reshape(-1)
+        padded = padded_nbytes(flat.nbytes)
+        view = self._staging[:padded]
+        view[:flat.nbytes] = flat
+        view[flat.nbytes:] = 0  # never persist stale staging bytes
+        self.handle.sync_pwrite(view, self._file(idx), direct=True)
 
     def read(self, idx: int, nbytes: int) -> np.ndarray:
-        view = self._staging[:nbytes]
-        self.handle.sync_pread(view, self._file(idx))
-        return view
+        view = self._staging[:padded_nbytes(nbytes)]
+        self.handle.sync_pread(view, self._file(idx), direct=True)
+        return view[:nbytes]
 
     def staging_view(self, nbytes: int) -> np.ndarray:
         return self._staging[:nbytes]
@@ -216,14 +223,23 @@ class NVMeLeafSwapper:
     read/write aio handle so waiting for leaf i's data never blocks on the
     deeper prefetches still in flight."""
 
+    @staticmethod
+    def window_depth(max_numel: int, prefetch_numel: int = 0) -> int:
+        """Prefetch depth for a given budget: how many leaves ride ahead of
+        the one being stepped (1 when no budget; capped at 7 = 8 slots).
+        Shared with the Infinity capacity planner (autotuning/memory.py) so
+        planned DRAM windows match what this class actually allocates."""
+        if not prefetch_numel:
+            return 1
+        return max(1, min(int(prefetch_numel) // max(max_numel, 1), 7))
+
     def __init__(self, nvme_path: str, max_numel: int, aio_cfg=None,
                  prefetch_numel: int = 0):
         self.dir = os.path.join(nvme_path, "zero_offload_swap")
         os.makedirs(self.dir, exist_ok=True)
         bs = getattr(aio_cfg, "block_size", 1 << 20)
         qd = getattr(aio_cfg, "queue_depth", 8)
-        depth = max(1, min(int(prefetch_numel) // max(max_numel, 1), 7)) \
-            if prefetch_numel else 1
+        depth = self.window_depth(max_numel, prefetch_numel)
         if prefetch_numel and depth == 1 and prefetch_numel < max_numel:
             log_dist(
                 f"stage3_prefetch_bucket_size={prefetch_numel:,} is smaller "
@@ -244,8 +260,17 @@ class NVMeLeafSwapper:
         self.write_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd,
                                             num_threads=1)
                               for _ in range(self.num_slots)]
-        self.slots = [np.empty(3 * max_numel, np.float32)
+        # aligned + padded-record I/O => every swap runs O_DIRECT, bypassing
+        # the page cache (reference aio engine behavior): at Infinity scale
+        # cached swap traffic would evict the host's working set and double-
+        # copy every byte
+        self.slots = [aligned_empty(3 * max_numel, np.float32)
                       for _ in range(self.num_slots)]
+
+    @staticmethod
+    def _rec_f32(numel: int) -> int:
+        """float32 length of one padded [master|m|v] record."""
+        return padded_nbytes(3 * numel * 4) // 4
 
     @property
     def prefetch_depth(self) -> int:
@@ -255,15 +280,19 @@ class NVMeLeafSwapper:
         return os.path.join(self.dir, f"leaf_{idx}.bin")
 
     def write_init(self, idx: int, master: np.ndarray):
-        buf = np.concatenate([master, np.zeros_like(master),
-                              np.zeros_like(master)])
-        self.write_handles[0].sync_pwrite(buf, self._file(idx))
+        n = len(master)
+        buf = aligned_empty(self._rec_f32(n), np.float32)
+        buf[:n] = master
+        buf[n:] = 0.0
+        self.write_handles[0].sync_pwrite(buf[:self._rec_f32(n)],
+                                          self._file(idx), direct=True)
 
     def start_read(self, idx: int, numel: int, slot: int):
         # the slot's previous occupant must be flushed before overwriting
         self.write_handles[slot].wait()
-        view = self.slots[slot][:3 * numel]
-        self.read_handles[slot].async_pread(view, self._file(idx))
+        view = self.slots[slot][:self._rec_f32(numel)]
+        self.read_handles[slot].async_pread(view, self._file(idx),
+                                            direct=True)
 
     def finish_read(self, slot: int):
         self.read_handles[slot].wait()
@@ -277,8 +306,12 @@ class NVMeLeafSwapper:
         return (buf[:numel], buf[numel:2 * numel], buf[2 * numel:3 * numel])
 
     def start_write(self, idx: int, numel: int, slot: int):
-        self.write_handles[slot].async_pwrite(self.slots[slot][:3 * numel],
-                                              self._file(idx))
+        rec = self._rec_f32(numel)
+        # zero the alignment tail: never persist stale bytes from a prior
+        # (larger) occupant of this slot
+        self.slots[slot][3 * numel:rec] = 0.0
+        self.write_handles[slot].async_pwrite(
+            self.slots[slot][:rec], self._file(idx), direct=True)
 
     def finish_writes(self):
         for h in self.write_handles:
@@ -467,7 +500,11 @@ class HostOffloadOptimizer:
             meta["leaves"].append({
                 "path": leaf.path, "offset": int(leaf.offset),
                 "numel": int(leaf.numel), "padded": int(leaf.padded),
-                "global_numel": int(leaf.global_numel)})
+                "global_numel": int(leaf.global_numel),
+                # shape makes the shard files self-describing: the dropped-in
+                # zero_to_fp32.py recovery script reconstructs full weights
+                # from the files alone, no framework import
+                "shape": list(leaf.shape)})
         base = os.path.join(ckpt_dir, f"zero_host_shard_p{pid}")
         np.savez(base + ".npz", **arrays)
         with open(base + ".json", "w") as fh:
