@@ -11,10 +11,18 @@ train_batch calls, delete — which also reuses the compilation cache across
 micro-batch variants of the same stage.
 
 Search strategies (reference tuner/: GridSearchTuner, RandomTuner,
-ModelBasedTuner): grid and random port directly; the xgboost cost model is
-replaced by the closed-form ZeRO memory model in ``memory.py`` for pruning
-plus measured refinement — on TPU the memory model is exact enough that a
-learned model is unnecessary.
+ModelBasedTuner): grid and random port directly; ``tuner_type="model"``
+is the ModelBasedTuner analogue (tuner/model_based_tuner.py:158) with a
+ridge regression over (stage, log-micro-batch, mesh) features standing in
+for xgboost — after a bootstrap phase it measures candidates best-first by
+predicted metric. The closed-form ZeRO memory model in ``memory.py`` does
+hard pruning either way.
+
+Isolation (reference autotuning/scheduler.py): ``isolation="process"``
+runs every experiment through ``autotuning/runner.py`` in its own child
+process with a timeout — compile caches and HBM fragmentation cannot leak
+across experiments, and a hard XLA crash (OOM, sigkill) fails only that
+point; the tune keeps going and still returns the measured best.
 """
 
 from __future__ import annotations
@@ -23,6 +31,9 @@ import gc
 import itertools
 import json
 import os
+import subprocess
+import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -72,17 +83,35 @@ class Autotuner:
       model_dims: dict(seq_len=, hidden=, layers=) for activation estimates.
     """
 
-    def __init__(self, engine_factory: Callable[[dict], Any],
-                 data_factory: Callable[[int], Callable[[], Any]],
+    def __init__(self, engine_factory: Optional[Callable[[dict], Any]],
+                 data_factory: Optional[Callable[[int], Callable[[], Any]]],
                  base_config: dict, *, num_params: int = 0,
                  model_dims: Optional[dict] = None,
                  metric: str = METRIC_THROUGHPUT,
                  warmup_steps: int = 2, measure_steps: int = 3,
                  results_dir: str = "autotuning_results",
                  tuner_type: str = "gridsearch", max_experiments: int = 64,
-                 early_stop_plateau: int = 2, seed: int = 0):
+                 early_stop_plateau: int = 2, seed: int = 0,
+                 isolation: str = "inproc",
+                 factory_path: Optional[str] = None,
+                 experiment_timeout: float = 900.0,
+                 model_bootstrap: int = 4):
+        """``isolation="process"`` requires ``factory_path`` ("module:fn",
+        importable in the child; fn(config) -> (engine, make_iter)) instead
+        of the in-process factories. ``model_bootstrap``: measured points
+        before the ``tuner_type="model"`` regressor starts ranking."""
+        if isolation not in ("inproc", "process"):
+            raise ValueError(f"unknown isolation {isolation!r}")
+        if isolation == "process" and not factory_path:
+            raise ValueError("isolation='process' requires factory_path")
+        if tuner_type not in ("gridsearch", "random", "model"):
+            raise ValueError(f"unknown tuner_type {tuner_type!r}")
         self.engine_factory = engine_factory
         self.data_factory = data_factory
+        self.isolation = isolation
+        self.factory_path = factory_path
+        self.experiment_timeout = experiment_timeout
+        self.model_bootstrap = model_bootstrap
         self.base_config = dict(base_config)
         self.num_params = num_params
         self.model_dims = model_dims or {}
@@ -162,6 +191,41 @@ class Autotuner:
 
     # ---- measurement -------------------------------------------------------
     def _run_experiment(self, exp: Experiment) -> Optional[float]:
+        if self.isolation == "process":
+            return self._run_subprocess(exp)
+        return self._run_inproc(exp)
+
+    def _run_subprocess(self, exp: Experiment) -> Optional[float]:
+        """One experiment = one child process through autotuning/runner.py
+        (reference scheduler.py job launch): a crash or hang only loses
+        this point."""
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as fh:
+            json.dump(exp.config, fh)
+            cfg_path = fh.name
+        cmd = [sys.executable, "-m", "deepspeed_tpu.autotuning.runner",
+               "--factory", self.factory_path, "--config", cfg_path,
+               "--warmup", str(self.warmup_steps),
+               "--steps", str(self.measure_steps), "--metric", self.metric]
+        try:
+            p = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=self.experiment_timeout)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"experiment timed out after {self.experiment_timeout:.0f}s")
+        finally:
+            os.unlink(cfg_path)
+        for line in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "metric_val" in obj:
+                return float(obj["metric_val"])
+        tail = ((p.stderr or "").strip().splitlines() or ["no output"])[-1]
+        raise RuntimeError(f"experiment rc={p.returncode}: {tail[:300]}")
+
+    def _run_inproc(self, exp: Experiment) -> Optional[float]:
         import jax
         engine = None
         try:
@@ -189,12 +253,92 @@ class Autotuner:
     def _better(self, a: float, b: float) -> bool:
         return a < b if self.metric == METRIC_LATENCY else a > b
 
+    # ---- cost model (reference tuner/model_based_tuner.py:158) -------------
+    @staticmethod
+    def _features(exp: Experiment) -> np.ndarray:
+        cfg = exp.config
+        stage = float(cfg.get("zero_optimization", {}).get("stage", 0))
+        micro = float(cfg.get("train_micro_batch_size_per_gpu", 1))
+        mesh = cfg.get("mesh", {}) or {}
+        lm = np.log2(max(micro, 1.0))
+        return np.array([1.0, stage, lm, lm * lm, stage * lm,
+                         float(mesh.get("pp", 1)), float(mesh.get("tp", 1)),
+                         float(mesh.get("ep", 1))])
+
+    def _fit_predict(self, measured: List[Experiment],
+                     candidates: List[Experiment]) -> np.ndarray:
+        """Ridge regression metric predictor (xgboost stand-in: the space
+        is small and smooth in (stage, log mbs), so a quadratic linear
+        model ranks candidates well after a few bootstrap points)."""
+        X = np.stack([self._features(e) for e in measured])
+        y = np.array([e.metric_val for e in measured])
+        lam = 1e-3
+        w = np.linalg.solve(X.T @ X + lam * np.eye(X.shape[1]), X.T @ y)
+        return np.stack([self._features(e) for e in candidates]) @ w
+
+    def _measure(self, exp: Experiment) -> None:
+        """Run + record one experiment (shared by both tune loops)."""
+        try:
+            exp.metric_val = self._run_experiment(exp)
+        except Exception as e:   # OOM / crash / timeout = infeasible point
+            exp.error = f"{type(e).__name__}: {e}"
+            logger.warning(f"autotuner: {exp.name} failed: {exp.error}")
+        self.records.append(exp)
+        self._write_record(exp)
+        if exp.metric_val is not None:
+            if self.best is None or self._better(exp.metric_val,
+                                                 self.best.metric_val):
+                self.best = exp
+            log_dist(f"autotuner: {exp.name} {self.metric}="
+                     f"{exp.metric_val:.2f} (best {self.best.name})",
+                     ranks=[0])
+
+    def _tune_model_based(self, exps: List[Experiment]) -> Optional[dict]:
+        """Bootstrap a few points, then fit-predict-measure best-first;
+        stop after `early_stop_plateau` consecutive non-improvements and
+        prune the rest by predicted rank."""
+        todo = list(exps)
+        for exp in todo[:self.model_bootstrap]:
+            self._measure(exp)
+        todo = todo[self.model_bootstrap:]
+        misses = 0
+        while todo:
+            measured = [r for r in self.records if r.metric_val is not None]
+            if len(measured) < 2:     # model unfittable; fall back to order
+                pick = todo.pop(0)
+            else:
+                preds = self._fit_predict(measured, todo)
+                order = np.argsort(preds)
+                idx = int(order[0 if self.metric == METRIC_LATENCY
+                                else -1])
+                pick = todo.pop(idx)
+            prev_best = self.best.metric_val if self.best else None
+            self._measure(pick)
+            if pick.metric_val is not None:
+                # like the grid loop, only MEASURED regressions count as
+                # plateau misses; crashed/OOM points are infeasible-space
+                # probes (capped by max_experiments), not evidence the
+                # feasible region has stopped improving
+                improved = (prev_best is None or
+                            self._better(pick.metric_val, prev_best))
+                misses = 0 if improved else misses + 1
+            if misses >= self.early_stop_plateau:
+                for exp in todo:
+                    exp.error = "skipped: cost-model prune"
+                    self.records.append(exp)
+                    self._write_record(exp)
+                break
+        self._write_summary()
+        return self.best.config if self.best else None
+
     # ---- main loop (reference tune(), autotuner.py:396) ---------------------
     def tune(self, space: Optional[TuningSpace] = None) -> Optional[dict]:
         space = space or TuningSpace()
         exps = self._experiments(space)
         log_dist(f"autotuner: {len(exps)} experiments", ranks=[0])
         os.makedirs(self.results_dir, exist_ok=True)
+        if self.tuner_type == "model":
+            return self._tune_model_based(exps)
         plateau: Dict[str, int] = {}
         best_in_group: Dict[str, float] = {}
         stopped: set = set()
@@ -208,17 +352,8 @@ class Autotuner:
                 self.records.append(exp)
                 self._write_record(exp)
                 continue
-            try:
-                exp.metric_val = self._run_experiment(exp)
-            except Exception as e:  # OOM / compile failure = infeasible point
-                exp.error = f"{type(e).__name__}: {e}"
-                logger.warning(f"autotuner: {exp.name} failed: {exp.error}")
-            self.records.append(exp)
-            self._write_record(exp)
+            self._measure(exp)
             if exp.metric_val is not None:
-                if self.best is None or self._better(exp.metric_val,
-                                                     self.best.metric_val):
-                    self.best = exp
                 # plateau is judged against this (stage, mesh) group's OWN
                 # best — a family whose first points trail another group's
                 # global best may still be climbing toward its knee
@@ -228,9 +363,6 @@ class Autotuner:
                     plateau[exp.group] = 0
                 else:
                     plateau[exp.group] = plateau.get(exp.group, 0) + 1
-                log_dist(f"autotuner: {exp.name} {self.metric}="
-                         f"{exp.metric_val:.2f} (best {self.best.name})",
-                         ranks=[0])
                 if self.tuner_type == "gridsearch" and \
                         plateau[exp.group] >= self.early_stop_plateau:
                     stopped.add(exp.group)

@@ -1,0 +1,33 @@
+"""Child-process engine factory for autotuner isolation tests.
+
+Imported by ``deepspeed_tpu.autotuning.runner`` inside each experiment's
+subprocess (the reference launches each experiment as its own job,
+autotuning/scheduler.py). ``AUTOTUNE_INDUCE_OOM`` makes large micro-batch
+points die with a hard abort — the way an XLA OOM takes a process down —
+so tests can prove the tuner survives and keeps measuring.
+"""
+
+import os
+
+import numpy as np
+
+
+def build(config):
+    if (os.environ.get("AUTOTUNE_INDUCE_OOM")
+            and config.get("train_micro_batch_size_per_gpu", 1) >= 16):
+        os._exit(134)  # SIGABRT-style death, like an XLA OOM abort
+
+    import jax
+    import deepspeed_tpu as ds
+    from simple_model import SimpleModel, mse_loss, random_batch
+
+    hidden = 16
+    model = SimpleModel(hidden_dim=hidden)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, hidden), np.float32))["params"]
+    engine, *_ = ds.initialize(model=model, model_parameters=params,
+                               loss_fn=mse_loss, config=config)
+    micro = config.get("train_micro_batch_size_per_gpu", 1)
+    dp = len(jax.devices())
+    batch = random_batch(micro * dp, dim=hidden)
+    return engine, lambda: iter([batch])

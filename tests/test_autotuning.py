@@ -3,6 +3,7 @@ deepspeed/autotuning/autotuner.py)."""
 
 import json
 import os
+import time
 
 import jax
 import numpy as np
@@ -108,3 +109,94 @@ def test_autotuner_records_failures(tmp_path):
     best = tuner.tune(TuningSpace(zero_stages=(1,), micro_batches=(4,)))
     assert best is None
     assert tuner.records[0].error and "boom" in tuner.records[0].error
+
+
+# ------------------------------------------- process isolation + cost model
+
+def test_subprocess_isolation_survives_hard_crash(tmp_path, monkeypatch):
+    """isolation="process": each experiment is its own child through
+    autotuning/runner.py (reference scheduler.py launched jobs). An induced
+    hard abort (the way an XLA OOM dies) on the mbs=16 point must only
+    lose that point — the tune keeps going and returns the measured best."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tests = os.path.join(repo, "tests")
+    monkeypatch.setenv("PYTHONPATH", tests + os.pathsep + repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.setenv("AUTOTUNE_INDUCE_OOM", "1")
+    base = {"gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10000}
+    tuner = Autotuner(None, None, base, isolation="process",
+                      factory_path="autotune_factory:build",
+                      warmup_steps=1, measure_steps=1,
+                      experiment_timeout=300,
+                      results_dir=str(tmp_path))
+    best = tuner.tune(TuningSpace(zero_stages=(1,), micro_batches=(2, 4, 16)))
+    assert best is not None
+    assert best["train_micro_batch_size_per_gpu"] in (2, 4)
+    errs = [r for r in tuner.records if r.error]
+    assert len(errs) == 1 and "rc=" in errs[0].error, \
+        [r.as_record() for r in tuner.records]
+    oks = [r for r in tuner.records if r.metric_val is not None]
+    assert len(oks) == 2
+
+
+def _fake_engine_factory(step_time_of):
+    """Engines whose train_batch really SLEEPS step_time_of(mbs) seconds —
+    the tuner wall-clock-times train_batch, so the synthetic curve must go
+    through real elapsed time."""
+    class FakeEngine:
+        def __init__(self, cfg):
+            self.cfg = cfg
+
+        def train_batch(self, it):
+            import jax.numpy as jnp
+            time.sleep(step_time_of(self.cfg["train_micro_batch_size_per_gpu"]))
+            return jnp.zeros(())
+
+        def train_batch_size(self):
+            return self.cfg["train_micro_batch_size_per_gpu"] * 8
+
+    return FakeEngine
+
+
+def test_model_based_tuner_finds_knee_winner(tmp_path):
+    """tuner_type="model" (reference tuner/model_based_tuner.py:158): a
+    throughput curve peaking at mbs=8 — whatever order the ridge model
+    explores in, the winner must be the true knee point."""
+    # efficiency rises to mbs=8 then collapses => throughput 800*eff(m)
+    def step_time(m):
+        eff = m if m <= 8 else max(8 - (m - 8) / 4.0, 2.0)
+        return m / (100.0 * eff)
+
+    eng = _fake_engine_factory(step_time)
+    tuner = Autotuner(lambda cfg: eng(cfg), lambda m: lambda: iter([None]),
+                      {}, tuner_type="model", model_bootstrap=3,
+                      early_stop_plateau=2, warmup_steps=0, measure_steps=1,
+                      results_dir=str(tmp_path))
+    best = tuner.tune(TuningSpace(zero_stages=(0,),
+                                  micro_batches=(1, 2, 4, 8, 16, 32)))
+    assert best is not None
+    assert best["train_micro_batch_size_per_gpu"] == 8, \
+        [(r.name, r.metric_val) for r in tuner.records]
+    names = [r.name for r in tuner.records]
+    assert len(names) == len(set(names)) == 6
+
+
+def test_model_based_tuner_prunes_after_plateau(tmp_path):
+    """Monotone-DECREASING throughput: bootstrap finds the winner, every
+    later pick is a measured regression, so after early_stop_plateau=2
+    picks the remaining candidate is cost-model-pruned unmeasured."""
+    eng = _fake_engine_factory(lambda m: 0.002 * m * m)  # tput ~ 1/m
+    tuner = Autotuner(lambda cfg: eng(cfg), lambda m: lambda: iter([None]),
+                      {}, tuner_type="model", model_bootstrap=3,
+                      early_stop_plateau=2, warmup_steps=0, measure_steps=1,
+                      results_dir=str(tmp_path))
+    best = tuner.tune(TuningSpace(zero_stages=(0,),
+                                  micro_batches=(1, 2, 4, 8, 16, 32)))
+    assert best["train_micro_batch_size_per_gpu"] == 1
+    skipped = [r for r in tuner.records
+               if r.error and "cost-model" in r.error]
+    measured = [r for r in tuner.records if r.metric_val is not None]
+    assert len(measured) == 5 and len(skipped) == 1, \
+        [(r.name, r.metric_val, r.error) for r in tuner.records]

