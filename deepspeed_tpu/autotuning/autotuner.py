@@ -104,6 +104,12 @@ class Autotuner:
             raise ValueError(f"unknown isolation {isolation!r}")
         if isolation == "process" and not factory_path:
             raise ValueError("isolation='process' requires factory_path")
+        if isolation == "inproc" and (engine_factory is None
+                                      or data_factory is None):
+            raise ValueError(
+                "isolation='inproc' requires engine_factory and "
+                "data_factory (with factory_path, pass "
+                "isolation='process')")
         if tuner_type not in ("gridsearch", "random", "model"):
             raise ValueError(f"unknown tuner_type {tuner_type!r}")
         self.engine_factory = engine_factory

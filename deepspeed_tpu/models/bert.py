@@ -29,7 +29,8 @@ import jax.numpy as jnp
 class BertConfig:
     vocab_size: int = 30522
     max_seq_len: int = 512
-    type_vocab_size: int = 2
+    type_vocab_size: int = 2     # 0 = no token-type embedding (DistilBERT)
+    use_pooler: bool = True      # False = raw [CLS] state (DistilBERT)
     num_layers: int = 12
     num_heads: int = 12
     d_model: int = 768
@@ -145,9 +146,10 @@ class BertModel(nn.Module):
         wpe = self.param("wpe", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
         x = x + wpe[None, :s].astype(cfg.dtype)
-        x = x + nn.Embed(cfg.type_vocab_size, cfg.d_model, dtype=cfg.dtype,
-                         param_dtype=cfg.param_dtype,
-                         name="wtt")(token_type_ids)
+        if cfg.type_vocab_size:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.d_model,
+                             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name="wtt")(token_type_ids)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          param_dtype=cfg.param_dtype, name="ln_emb")(x)
         if attention_mask is not None:
@@ -169,6 +171,8 @@ class BertModel(nn.Module):
                 x, _ = BertLayer(cfg, name=f"block_{i}")(
                     x, attention_mask, deterministic)
 
+        if not cfg.use_pooler:
+            return x, x[:, 0]
         pooled = nn.Dense(cfg.d_model, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, name="pooler")(x[:, 0])
         return x, jnp.tanh(pooled)

@@ -449,6 +449,72 @@ HFGPT2Policy.export = staticmethod(_export_gpt2)
 HFBertPolicy.export = staticmethod(_export_bert)
 
 
+class HFDistilBertPolicy:
+    """DistilBERT (reference HFDistilBertLayerPolicy — the one arch the
+    round-3 policy table lacked): BERT-shaped post-LN encoder with no
+    token-type embeddings and no pooler; q/k/v live as separate q_lin/
+    k_lin/v_lin Linears under transformer.layer.N.attention."""
+
+    @staticmethod
+    def config_from_hf(hf_config):
+        import jax.numpy as jnp
+        from ..models.bert import BertConfig
+        return BertConfig(
+            vocab_size=hf_config.vocab_size,
+            max_seq_len=hf_config.max_position_embeddings,
+            type_vocab_size=0,
+            use_pooler=False,
+            num_layers=hf_config.n_layers,
+            num_heads=hf_config.n_heads,
+            d_model=hf_config.dim,
+            d_ff=hf_config.hidden_dim,
+            layer_norm_eps=getattr(hf_config, "layer_norm_eps", 1e-12),
+            hidden_dropout=0.0,
+            dtype=jnp.float32, param_dtype=jnp.float32, scan_layers=True)
+
+    @staticmethod
+    def convert(state_dict: Dict[str, Any], n_layer: int) -> Dict[str, Any]:
+        sd = {k.removeprefix("distilbert."): v for k, v in state_dict.items()}
+        pre = "transformer.layer.{}."
+
+        def lin(fmt):
+            return (_stack(sd, fmt + ".weight", n_layer,
+                           transform=lambda m: m.T),
+                    _stack(sd, fmt + ".bias", n_layer))
+
+        def ln(fmt):
+            return {"scale": _stack(sd, fmt + ".weight", n_layer),
+                    "bias": _stack(sd, fmt + ".bias", n_layer)}
+
+        qk = [np.concatenate(
+            [_np(sd[pre.format(i) + f"attention.{n}.weight"]).T
+             for n in ("q_lin", "k_lin", "v_lin")], axis=1)
+            for i in range(n_layer)]
+        qb = [np.concatenate(
+            [_np(sd[pre.format(i) + f"attention.{n}.bias"])
+             for n in ("q_lin", "k_lin", "v_lin")])
+            for i in range(n_layer)]
+        ok, ob = lin(pre + "attention.out_lin")
+        uk, ub = lin(pre + "ffn.lin1")
+        dk, db = lin(pre + "ffn.lin2")
+        return {
+            "wte": {"embedding": _np(sd["embeddings.word_embeddings.weight"])},
+            "wpe": _np(sd["embeddings.position_embeddings.weight"]),
+            "ln_emb": {"scale": _np(sd["embeddings.LayerNorm.weight"]),
+                       "bias": _np(sd["embeddings.LayerNorm.bias"])},
+            "blocks": {
+                "attn": {
+                    "qkv": {"kernel": np.stack(qk), "bias": np.stack(qb)},
+                    "out_proj": {"kernel": ok, "bias": ob},
+                },
+                "ln_attn": ln(pre + "sa_layer_norm"),
+                "up_proj": {"kernel": uk, "bias": ub},
+                "down_proj": {"kernel": dk, "bias": db},
+                "ln_ffn": ln(pre + "output_layer_norm"),
+            },
+        }
+
+
 def export_hf_state_dict(model_type: str, params: Dict[str, Any]
                          ) -> Dict[str, Any]:
     """Inverse injection: our param tree back to an HF state dict (numpy),
@@ -464,6 +530,7 @@ _POLICIES = {
     "gpt_neo": HFGPTNeoPolicy,
     "gptj": HFGPTJPolicy,
     "bert": HFBertPolicy,
+    "distilbert": HFDistilBertPolicy,
     "megatron": MegatronGPTPolicy,
 }
 

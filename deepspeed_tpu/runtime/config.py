@@ -79,6 +79,10 @@ class OffloadParamConfig:
     buffer_size: int = 100_000_000
     max_in_cpu: int = 1_000_000_000
     pin_memory: bool = False
+    # stream transformer blocks through HBM one layer at a time (ZeRO-
+    # Infinity capacity tier on a single chip: max params becomes a host
+    # DRAM/NVMe bound, not an HBM bound); see runtime/zero/layer_stream.py
+    layer_streaming: bool = False
 
 
 @dataclass

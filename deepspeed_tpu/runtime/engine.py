@@ -205,6 +205,12 @@ class DeepSpeedEngine:
                 "into the offload train path; disable offload_optimizer or "
                 "these features (silently ignoring them would train a "
                 "different model than configured)")
+        if zc.offload_param.layer_streaming and not self.offload_enabled:
+            raise ValueError(
+                "offload_param.layer_streaming requires offload_optimizer "
+                "(the host owns master+moments and serves the per-layer "
+                "param fetches); a parsed knob must change the compiled "
+                "program or error, never silently no-op")
 
         # ---- parameters ----------------------------------------------------
         if model_parameters is None:
@@ -1234,14 +1240,36 @@ class DeepSpeedEngine:
 
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed)
-        dev_params = self._offload_restore_params()
-        zeros = jax.jit(
-            lambda t: jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), t),
-            out_shardings=self.grad_shardings)(dev_params)
-        self.state = {
-            "params": dev_params if self._params_resident else None,
-            "acc": zeros, "rng": rng}
+        self._layer_streamer = None
+        if op.layer_streaming:
+            from .zero.layer_stream import LayerStreamer
+            gpt_cfg = getattr(self.module, "cfg", None)
+            if gpt_cfg is None or not hasattr(gpt_cfg, "num_layers"):
+                raise ValueError(
+                    "offload_param.layer_streaming drives the GPT scan-"
+                    "over-layers structure directly and needs a model with "
+                    "a .cfg (models/gpt.py GPT)")
+            if any(v > 1 for v in dict(self.mesh.shape).values()):
+                raise ValueError(
+                    "offload_param.layer_streaming is the SINGLE-chip "
+                    "capacity tier (per-layer host fetches inside the "
+                    "program); at mesh sizes > 1 use ZeRO-3 sharding for "
+                    "capacity instead")
+            self._layer_streamer = LayerStreamer(
+                self.host_optimizer, gpt_cfg, self.loss_fn,
+                self.compute_dtype)
+            # no full device params, no device grad accumulator: between
+            # steps HBM holds nothing of the model (the capacity tier)
+            self.state = {"params": None, "acc": None, "rng": rng}
+        else:
+            dev_params = self._offload_restore_params()
+            zeros = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), t),
+                out_shardings=self.grad_shardings)(dev_params)
+            self.state = {
+                "params": dev_params if self._params_resident else None,
+                "acc": zeros, "rng": rng}
         self._off_state_shardings = {
             "acc": self.grad_shardings,
             "rng": NamedSharding(self.mesh, P()),
@@ -1372,7 +1400,52 @@ class DeepSpeedEngine:
                 self._host_hysteresis -= 1
             self._host_last_overflow = step
 
+    def _streamed_train_batch(self, batches):
+        """Layer-streamed capacity tier (runtime/zero/layer_stream.py):
+        one jitted program fetches block params per layer and emits block
+        grads per layer via callbacks; the host steps every leaf."""
+        from .zero.layer_stream import build_streamed_step
+        st = self._layer_streamer
+        gas = self.gradient_accumulation_steps()
+        if self._jit_train is None:
+            self._jit_train = build_streamed_step(st, gas)
+        scale = jnp.asarray(self._host_scale, jnp.float32)
+        res = jax.tree.map(
+            lambda a: jnp.asarray(a), st.resident_host_tree())
+        st.reset_grads()
+        flats, metrics = self._jit_train(res, batches, scale)
+        # ordered emit callbacks are effects of the program: force them to
+        # completion before reading the host buffers
+        flats = jax.device_get(flats)
+        jax.effects_barrier()
+        finite = bool(jax.device_get(metrics["finite"]))
+        denom = float(self._host_scale) * gas
+        res_sq = float(jax.device_get(metrics["res_sq"]))
+        gnorm = float(np.sqrt(res_sq + st.blocks_grad_sq())) / denom
+        if finite:
+            clip = self.gradient_clipping()
+            combined = denom
+            if clip and clip > 0 and gnorm > clip:
+                combined *= gnorm / clip
+            resident_flats = {}
+            for li, g in zip(st.resident_idx, flats):
+                leaf = self.host_optimizer.leaves[li]
+                pad = np.zeros(leaf.numel, np.float32)
+                pad[:leaf.global_numel] = np.asarray(g, np.float32)
+                resident_flats[li] = pad
+            self.host_optimizer.step(st.grads_flat_all(resident_flats),
+                                     lr=self.get_lr()[0],
+                                     combined_scale=combined)
+        else:
+            self.skipped_steps += 1
+        self._host_update_scale(finite)
+        self._last_grad_norm = gnorm
+        return {"loss": metrics["loss"], "grad_norm": gnorm,
+                "finite": finite}
+
     def _offload_train_batch(self, batches):
+        if self._layer_streamer is not None:
+            return self._streamed_train_batch(batches)
         if self._jit_train is None:
             self._jit_train = self._build_offload_jit()
         scale = jnp.asarray(self._host_scale, jnp.float32)

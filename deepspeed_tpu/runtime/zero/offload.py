@@ -209,6 +209,15 @@ class MirrorNVMeStore:
         self.handle.sync_pread(view, self._file(idx), direct=True)
         return view[:nbytes]
 
+    def read_range(self, idx: int, offset: int, nbytes: int) -> np.ndarray:
+        """Byte range of one leaf file (layer-streaming fetches: one
+        layer's slice, not the whole leaf). Interior offsets are rarely
+        DIRECT_ALIGN-aligned, so ranges read buffered — bounded by one
+        layer, they do not recreate the cache-pollution problem."""
+        view = self._staging[:nbytes]
+        self.handle.sync_pread(view, self._file(idx), offset=offset)
+        return view[:nbytes]
+
     def staging_view(self, nbytes: int) -> np.ndarray:
         return self._staging[:nbytes]
 

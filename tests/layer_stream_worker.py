@@ -1,0 +1,116 @@
+"""Child-process worker for layer-streaming tests: the streamed capacity
+tier is single-chip by design, so it runs under a 1-device CPU backend
+(the pytest process holds the 8-device mesh). Modes print one JSON line.
+
+Usage: python layer_stream_worker.py <mode> [workdir]
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import deepspeed_tpu as ds  # noqa: E402
+from deepspeed_tpu.models.gpt import GPT, GPTConfig, lm_loss_fn  # noqa: E402
+
+
+def _model(rotary=False, tie=True):
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=3,
+                    num_heads=2, d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, scan_layers=True, remat=False,
+                    rotary=rotary, tie_embeddings=tie)
+    model = GPT(cfg)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 32)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+    return model, params
+
+
+def _engine(model, params, stream, *, nvme=None, clip=0.0):
+    zcfg = {"stage": 1, "offload_optimizer": {"device": "cpu"}}
+    if nvme:
+        zcfg = {"stage": 3,
+                "offload_optimizer": {"device": "nvme", "nvme_path": nvme}}
+    if stream:
+        zcfg.setdefault("offload_param", {})["layer_streaming"] = True
+        if nvme:
+            zcfg["offload_param"]["device"] = "nvme"
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 2,
+           "zero_optimization": zcfg,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 10000}
+    if clip:
+        cfg["gradient_clipping"] = clip
+    e, *_ = ds.initialize(model=model, model_parameters=params,
+                          loss_fn=lm_loss_fn, config=cfg)
+    return e
+
+
+def _it(seed):
+    ids = np.random.default_rng(seed).integers(0, 128, (2, 32)).astype(np.int32)
+    return iter([{"input_ids": ids}] * 2)
+
+
+def mode_parity(rotary, tie, clip=0.0):
+    model, params = _model(rotary=rotary, tie=tie)
+    ea = _engine(model, params, stream=False, clip=clip)
+    eb = _engine(model, params, stream=True, clip=clip)
+    assert eb.state["params"] is None and eb.state["acc"] is None
+    # count host round trips: 2L fetches (fwd+bwd) and L emits per micro
+    st = eb._layer_streamer
+    fetches, emits = [0], [0]
+    orig_fetch, orig_emit = st.fetch_layer, st.emit_layer
+    st.fetch_layer = lambda i: (fetches.__setitem__(0, fetches[0] + 1),
+                                orig_fetch(i))[1]
+    st.emit_layer = lambda i, *g: (emits.__setitem__(0, emits[0] + 1),
+                                   orig_emit(i, *g))[1]
+    diffs = []
+    for s in range(4):
+        la = float(jax.device_get(ea.train_batch(_it(s))))
+        lb = float(jax.device_get(eb.train_batch(_it(s))))
+        diffs.append(abs(la - lb))
+    L, gas, steps = 3, 2, 4
+    print(json.dumps({
+        "max_diff": max(diffs),
+        "fetches": fetches[0], "emits": emits[0],
+        "expect_fetches": 2 * L * gas * steps,
+        "expect_emits": L * gas * steps,
+        "gnorm_a": ea.get_global_grad_norm(),
+        "gnorm_b": eb.get_global_grad_norm()}))
+
+
+def mode_nvme(workdir):
+    model, params = _model()
+    ea = _engine(model, params, stream=True)                 # DRAM mirrors
+    eb = _engine(model, params, stream=True, nvme=workdir)   # NVMe tier
+    assert eb._layer_streamer.opt.leaves[0].store is not None or \
+        any(l.store is not None for l in eb._layer_streamer.opt.leaves)
+    diffs = []
+    for s in range(3):
+        la = float(jax.device_get(ea.train_batch(_it(s))))
+        lb = float(jax.device_get(eb.train_batch(_it(s))))
+        diffs.append(abs(la - lb))
+    print(json.dumps({"max_diff": max(diffs)}))
+
+
+def main():
+    mode = sys.argv[1]
+    if mode == "parity":
+        mode_parity(rotary=False, tie=True)
+    elif mode == "parity_rotary_untied":
+        mode_parity(rotary=True, tie=False)
+    elif mode == "parity_clip":
+        mode_parity(rotary=False, tie=True, clip=0.01)
+    elif mode == "nvme":
+        mode_nvme(sys.argv[2])
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

@@ -242,3 +242,44 @@ def test_export_roundtrip_gpt2():
           if "attn.bias" not in k and "masked_bias" not in k}
     for k in sd:
         np.testing.assert_array_equal(back[k], sd[k], err_msg=k)
+
+
+# ------------------------------------------------------------- DistilBERT
+
+def test_distilbert_logit_parity_vs_hf():
+    """DistilBERT injection policy (reference HFDistilBertLayerPolicy —
+    the last per-architecture policy missing from the table): exact
+    hidden-state parity vs the HF torch model."""
+    import torch
+    from transformers import DistilBertConfig as HFDBConfig
+    from transformers import DistilBertModel as HFDBModel
+    from deepspeed_tpu.module_inject.policies import HFDistilBertPolicy
+
+    hf_cfg = HFDBConfig(vocab_size=128, dim=64, n_layers=3, n_heads=4,
+                        hidden_dim=128, max_position_embeddings=64,
+                        dropout=0.0, attention_dropout=0.0,
+                        sinusoidal_pos_embds=False)
+    torch.manual_seed(0)
+    hf = HFDBModel(hf_cfg).eval()
+    cfg = HFDistilBertPolicy.config_from_hf(hf_cfg)
+    assert cfg.type_vocab_size == 0 and not cfg.use_pooler
+    params = HFDistilBertPolicy.convert(dict(hf.state_dict()),
+                                        cfg.num_layers)
+    ids, mask, _ = _inputs()
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                 attention_mask=torch.tensor(mask.astype(np.int64)))
+    seq, cls = BertModel(cfg).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        jnp.asarray(ids), None, jnp.asarray(mask))
+    live = mask.astype(bool)
+    err = np.abs(np.asarray(seq) - ref.last_hidden_state.numpy())[live].max()
+    assert err < 2e-5, err
+    np.testing.assert_allclose(np.asarray(cls),
+                               np.asarray(seq)[:, 0], atol=0)
+
+
+def test_distilbert_policy_registered():
+    from deepspeed_tpu.module_inject.policies import (HFDistilBertPolicy,
+                                                      policy_for)
+    assert policy_for("distilbert") is HFDistilBertPolicy

@@ -153,3 +153,50 @@ def test_aio_throughput_smoke(tmp_path):
     h.wait()
     dt = time.time() - t0
     assert dt < 10.0  # 16 MB in <10s even on slow disks
+
+
+def test_aligned_empty_contract():
+    from deepspeed_tpu.ops.aio import DIRECT_ALIGN, aligned_empty, padded_nbytes
+    for n in (1, 1023, 1024, 4096, 999_937):
+        a = aligned_empty(n, np.float32)
+        assert a.ctypes.data % DIRECT_ALIGN == 0
+        assert a.nbytes == padded_nbytes(n * 4)
+        assert a.nbytes >= n * 4
+    assert padded_nbytes(1) == DIRECT_ALIGN
+    assert padded_nbytes(DIRECT_ALIGN) == DIRECT_ALIGN
+
+
+def test_aio_direct_roundtrip_matches_buffered(tmp_path):
+    """O_DIRECT padded-record write/read returns byte-identical payload to
+    the buffered path (the Infinity swap files must be readable by either)."""
+    from deepspeed_tpu.ops.aio import (AsyncIOHandle, aligned_empty,
+                                       padded_nbytes)
+    h = AsyncIOHandle(block_size=1 << 16, queue_depth=2)
+    if not h.native:
+        pytest.skip("native aio unavailable")
+    n = 100_003                      # deliberately unaligned element count
+    src = aligned_empty(n, np.float32)
+    rng = np.random.default_rng(0)
+    src[:n] = rng.standard_normal(n).astype(np.float32)
+    src[n:] = 0.0
+    rec = padded_nbytes(n * 4) // 4
+    pd = str(tmp_path / "direct.bin")
+    h.sync_pwrite(src[:rec], pd, direct=True)
+
+    back_direct = aligned_empty(n, np.float32)
+    h.sync_pread(back_direct[:rec], pd, direct=True)
+    np.testing.assert_array_equal(back_direct[:n], src[:n])
+
+    back_buffered = np.empty(rec, np.float32)     # plain buffered read
+    h.sync_pread(back_buffered, pd)
+    np.testing.assert_array_equal(back_buffered[:n], src[:n])
+
+
+def test_aio_direct_rejects_misaligned(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle()
+    if not h.native:
+        pytest.skip("native aio unavailable")
+    bad = np.empty(1000, np.float32)              # unpadded length
+    with pytest.raises(AssertionError):
+        h.sync_pwrite(bad, str(tmp_path / "x.bin"), direct=True)
