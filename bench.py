@@ -212,29 +212,17 @@ def case_ladder_zero3_offload():
 
 
 def case_max_params():
-    """Max params/chip per tier. bytes/param: pure-HBM ZeRO-1/2/3 at dp=1
-    keep fp32 master+m+v+acc and a bf16 compute copy (18); host offload
-    keeps bf16 params + fp32 acc on device (6) and master+m+v on host
-    (12); NVMe offload additionally mirrors bf16 params on disk (14/param
-    on NVMe, host DRAM holds only staging windows). With
-    offload_param.layer_streaming the device holds ONE block at a time
-    (runtime/zero/layer_stream.py) so the bound moves to the host: DRAM
-    16/param (master+m+v+grad buffers), or with NVMe optimizer state DRAM
-    4/param grads + 14/param on disk. Reference analogue: the 13B/40B-on-
-    one-V100 claims, docs/_posts/2021-03-08-zero3-offload.md:9."""
+    """Max params/chip per offload tier, from the measured HBM/DRAM/NVMe of
+    this host (the bytes-per-param model lives in
+    deepspeed_tpu.autotuning.memory.capacity_tiers, shared with the
+    ds_report capacity table)."""
+    from deepspeed_tpu.autotuning.memory import capacity_tiers
     info = _device_info()
-    hbm_usable = info["hbm"] * 0.92 - 2e9
     with open("/proc/meminfo") as f:
         host = int(f.read().split("MemAvailable:")[1].split()[0]) * 1024
     import shutil
     nvme = shutil.disk_usage("/tmp").free
-    tiers = {
-        "hbm_only": hbm_usable / 18,
-        "host_offload": min(hbm_usable / 6, host * 0.9 / 12),
-        "nvme_offload": min(hbm_usable / 6, nvme * 0.9 / 14),
-        "streamed_host": host * 0.9 / 16,
-        "streamed_nvme": min(nvme * 0.9 / 14, host * 0.9 / 4),
-    }
+    tiers = capacity_tiers(info["hbm"], host, nvme)
     best = max(tiers.values())
     return {"metric": "max_params_per_chip_B",
             "value": round(best / 1e9, 2),
@@ -395,21 +383,23 @@ def main():
             "vs_baseline": 0.0}), flush=True)
         return 1
 
-    info, err = _probe(probe_timeout)
+    info, probe_err = _probe(probe_timeout)
     if info is None:
-        print(f"[bench] probe failed ({err}); retrying once", file=sys.stderr)
-        info, err = _probe(probe_timeout)
+        print(f"[bench] probe failed ({probe_err}); retrying once",
+              file=sys.stderr)
+        info, probe_err = _probe(probe_timeout)
     if info is None:
         # the chip is unreachable, but host-only cases (CASE_ENV overrides
         # strip the device backend) still produce real numbers
-        print(f"[bench] backend unavailable ({err}); running host-only "
-              f"cases", file=sys.stderr)
+        print(f"[bench] backend unavailable ({probe_err}); running "
+              f"host-only cases", file=sys.stderr)
         cases = [c for c in cases if c in CASE_ENV]
         if not cases:
             print(json.dumps({
                 "metric": "bench_failed", "value": 0.0,
-                "unit": f"backend unavailable ({err}) and no host-only "
-                        f"cases requested", "vs_baseline": 0.0}), flush=True)
+                "unit": f"backend unavailable ({probe_err}) and no "
+                        f"host-only cases requested",
+                "vs_baseline": 0.0}), flush=True)
             return 1
     else:
         print(f"[bench] device: {info['device']} "
@@ -448,7 +438,7 @@ def main():
         return 0
     if FLAGSHIP not in asked:  # explicitly restricted run
         return 0
-    detail = ("backend unavailable: " + err) if info is None \
+    detail = ("backend unavailable: " + str(probe_err)) if info is None \
         else "flagship case failed: " + "; ".join(failures)[:400]
     print(json.dumps({
         "metric": "bench_failed", "value": 0.0, "unit": detail,

@@ -89,6 +89,29 @@ def max_micro_batch_for_budget(budget_bytes: float, *, num_params: int,
     return max(0, int((budget_bytes - states) // per_sample))
 
 
+def capacity_tiers(hbm: float, host_dram: float,
+                   nvme_free: float) -> Dict[str, float]:
+    """Max trainable params/chip per offload tier (single source for
+    bench.py case_max_params and the ds_report capacity table).
+
+    bytes/param: pure-HBM ZeRO-1/2/3 at dp=1 keeps fp32 master+m+v+acc and
+    a bf16 compute copy (18); host offload keeps bf16 params + fp32 acc on
+    device (6) and master+m+v on host (12); NVMe offload mirrors bf16
+    params on disk too (14 on NVMe); layer streaming
+    (runtime/zero/layer_stream.py) removes the device bound — host DRAM
+    holds master+m+v+grads (16), or with NVMe optimizer state only the
+    grad buffers (4) while the disk holds 14. Reference analogue:
+    the 13B/40B-on-one-V100 tables, docs/_posts/2021-03-08-zero3-offload.md:9."""
+    hbm_usable = hbm * 0.92 - 2e9
+    return {
+        "hbm_only": hbm_usable / 18,
+        "host_offload": min(hbm_usable / 6, host_dram * 0.9 / 12),
+        "nvme_offload": min(hbm_usable / 6, nvme_free * 0.9 / 14),
+        "streamed_host": host_dram * 0.9 / 16,
+        "streamed_nvme": min(nvme_free * 0.9 / 14, host_dram * 0.9 / 4),
+    }
+
+
 # Published TPU pod-slice host topology: chips per host and host DRAM.
 # v5p hosts carry 4 chips and ~448GB DRAM; the planner defaults stay
 # conservative (400GB usable) so a plan that "fits" here fits in practice.

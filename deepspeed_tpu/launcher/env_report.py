@@ -30,8 +30,13 @@ def probe_devices(timeout: float = 30.0) -> dict:
     child process with a hard timeout and never blocks the report."""
     code = (
         "import json, jax\n"
+        "devs = jax.devices()\n"
+        "try:\n"
+        "    hbm = devs[0].memory_stats()['bytes_limit']\n"
+        "except Exception:\n"
+        "    hbm = None\n"
         "print(json.dumps({'backend': jax.default_backend(),"
-        " 'devices': [str(d) for d in jax.devices()]}))\n")
+        " 'devices': [str(d) for d in devs], 'hbm': hbm}))\n")
     try:
         out = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -107,6 +112,36 @@ def main() -> int:
         print(f"{name:<24}"
               f"{GREEN_OK if compatible else RED_NO:<25}"
               f"{GREEN_OK if loaded else RED_NO}")
+
+    # capacity estimates (reference: the estimate_zero*_mem_needs helpers
+    # users run to size a job, runtime/zero/utils)
+    print("-" * 64)
+    print("capacity (this host, max trainable params per chip)")
+    print("-" * 64)
+    try:
+        from ..autotuning.memory import capacity_tiers
+        hbm = probe.get("hbm") if isinstance(probe, dict) else None
+        hbm_note = ""
+        if not hbm:
+            hbm, hbm_note = 16e9, " (no chip reachable; HBM ASSUMED 16GB)"
+        with open("/proc/meminfo") as fh:
+            host = int(fh.read().split("MemAvailable:")[1].split()[0]) * 1024
+        import shutil as _sh
+        nvme = _sh.disk_usage("/tmp").free
+        tiers = capacity_tiers(float(hbm), host, nvme)
+        rows = [
+            ("pure HBM (ZeRO-1/2/3, dp=1)", tiers["hbm_only"]),
+            ("+ offload_optimizer=cpu", tiers["host_offload"]),
+            ("+ optimizer state on NVMe", tiers["nvme_offload"]),
+            ("+ layer_streaming (DRAM-bound)", tiers["streamed_host"]),
+            ("+ layer_streaming + NVMe state", tiers["streamed_nvme"]),
+        ]
+        for name, n in rows:
+            print(f"{name:<36} ~{n / 1e9:5.2f}B params")
+        print("(bytes-per-param model: autotuning/memory.py "
+              f"capacity_tiers){hbm_note}")
+    except Exception as e:
+        print(f"capacity estimate unavailable: {e}")
     return 0
 
 

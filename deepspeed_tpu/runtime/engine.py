@@ -961,6 +961,16 @@ class DeepSpeedEngine:
 
     # ---------------------------------------------------------------- eval
     def eval_batch(self, batch):
+        if getattr(self, "_layer_streamer", None) is not None:
+            # capacity tier: eval streams layers too — the full model must
+            # never materialize on device (runtime/zero/layer_stream.py)
+            if not hasattr(self, "_jit_stream_eval"):
+                from .zero.layer_stream import build_streamed_eval
+                self._jit_stream_eval = build_streamed_eval(
+                    self._layer_streamer)
+            res = jax.tree.map(
+                jnp.asarray, self._layer_streamer.resident_host_tree())
+            return self._jit_stream_eval(res, batch)
         if not hasattr(self, "_jit_eval"):
             cast = not self.offload_enabled
             def ev(master, batch, rng):
@@ -1093,7 +1103,11 @@ class DeepSpeedEngine:
                 master_tree=res["master_params"],
                 opt_state=(res["opt_state"] if load_optimizer_states
                            and not load_module_only else None))
-            self.state["params"] = self._offload_restore_params()
+            if self._layer_streamer is None:
+                self.state["params"] = self._offload_restore_params()
+            # layer-streamed tier: params stay host-side; the next step
+            # fetches the restored mirrors per layer (materializing the
+            # full tree here would break the one-block HBM invariant)
             self._host_scale = float(meta["loss_scale"])
         else:
             self.state["master"] = res["master_params"]
@@ -1138,7 +1152,8 @@ class DeepSpeedEngine:
         self.host_optimizer.load_shards(
             ckpt_dir,
             load_optimizer_states=load_optimizer_states and not load_module_only)
-        self.state["params"] = self._offload_restore_params()
+        if self._layer_streamer is None:
+            self.state["params"] = self._offload_restore_params()
         self._host_scale = float(meta["loss_scale"])
         if self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])

@@ -202,15 +202,11 @@ class LayerStreamer:
         return out  # type: ignore[return-value]
 
 
-def build_streamed_step(streamer: LayerStreamer, gas: int):
-    """The jitted streamed train function:
-        (resident_params, batches[gas, ...], scale) ->
-        (resident_grad_flats, metrics)
-    Block grads leave through the emit callback; the engine combines the
-    host-side block grad norm with the returned resident part."""
+def _streamed_fns(streamer: LayerStreamer):
+    """The shared functional pieces (block/embed/head apply + host fetch)
+    used by both the train and eval builders."""
     from ...models.gpt import Block
     cfg = streamer.cfg
-    L = streamer.num_layers
     block_abs = streamer.block_abstract()
     loss_fn = streamer.loss_fn
     compute_dtype = streamer.compute_dtype
@@ -221,7 +217,7 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
     blocks_leaf_paths = [streamer.opt.leaves[i].path
                          for i in streamer.block_idx]
 
-    def _blocks_tree(leaves: List[Any]) -> Dict[str, Any]:
+    def blocks_tree(leaves: List[Any]) -> Dict[str, Any]:
         tree: Dict[str, Any] = {}
         for path, leaf in zip(blocks_leaf_paths, leaves):
             parts = path.split("/")[1:]   # drop "blocks"
@@ -259,6 +255,45 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
     def fetch(i):
         return io_callback(streamer.fetch_layer, block_abs, i,
                            ordered=False)
+
+    return blocks_tree, block_apply, embed_fn, head_fn, fetch
+
+
+def build_streamed_eval(streamer: LayerStreamer):
+    """Forward-only streamed loss: (resident_params, batch) -> loss.
+    Evaluation at capacity scale must not materialize the full model on
+    device any more than training does."""
+    L = streamer.num_layers
+    _blocks_tree, block_apply, embed_fn, head_fn, fetch = \
+        _streamed_fns(streamer)
+
+    def ev(res, batch):
+        ids = batch["input_ids"]
+        b, s = ids.shape
+        positions = jnp.arange(s)[None, :].repeat(b, axis=0)
+
+        def f_body(x, i):
+            return block_apply(_blocks_tree(fetch(i)), x, positions), None
+        x0 = embed_fn(res, ids, positions)
+        x_last, _ = jax.lax.scan(f_body, x0, jnp.arange(L))
+        _scaled, loss = head_fn(res, x_last, batch,
+                                jnp.ones((), jnp.float32))
+        return loss
+
+    return jax.jit(ev)
+
+
+def build_streamed_step(streamer: LayerStreamer, gas: int):
+    """The jitted streamed train function:
+        (resident_params, batches[gas, ...], scale) ->
+        (resident_grad_flats, metrics)
+    Block grads leave through the emit callback; the engine combines the
+    host-side block grad norm with the returned resident part."""
+    cfg = streamer.cfg
+    L = streamer.num_layers
+    compute_dtype = streamer.compute_dtype
+    _blocks_tree, block_apply, embed_fn, head_fn, fetch = \
+        _streamed_fns(streamer)
 
     def micro_grads(res, batch, scale):
         ids = batch["input_ids"]

@@ -74,14 +74,20 @@ def mode_parity(rotary, tie, clip=0.0):
         la = float(jax.device_get(ea.train_batch(_it(s))))
         lb = float(jax.device_get(eb.train_batch(_it(s))))
         diffs.append(abs(la - lb))
+    # eval parity: the streamed forward-only loss equals the plain one
+    ev_batch = {"input_ids": np.random.default_rng(99).integers(
+        0, 128, (2, 32)).astype(np.int32)}
+    ev_a = float(jax.device_get(ea.eval_batch(ev_batch)))
+    ev_b = float(jax.device_get(eb.eval_batch(ev_batch)))
     L, gas, steps = 3, 2, 4
     print(json.dumps({
         "max_diff": max(diffs),
         "fetches": fetches[0], "emits": emits[0],
-        "expect_fetches": 2 * L * gas * steps,
+        "expect_fetches": 2 * L * gas * steps + L,  # +L: eval fwd
         "expect_emits": L * gas * steps,
         "gnorm_a": ea.get_global_grad_norm(),
-        "gnorm_b": eb.get_global_grad_norm()}))
+        "gnorm_b": eb.get_global_grad_norm(),
+        "eval_diff": abs(ev_a - ev_b)}))
 
 
 def mode_nvme(workdir):
@@ -98,6 +104,51 @@ def mode_nvme(workdir):
     print(json.dumps({"max_diff": max(diffs)}))
 
 
+def mode_fp16():
+    """fp16 + dynamic loss scale through the streamed path: finite steps
+    update; an absurd initial scale overflows, skips the step, and halves
+    the scale (reference DynamicLossScaler semantics)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig, lm_loss_fn
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, num_layers=2,
+                    num_heads=2, d_model=32, d_ff=64, dtype=jnp.float16,
+                    param_dtype=jnp.float32, scan_layers=True, remat=False)
+    model = GPT(cfg)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 32)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1, :8])["params"]
+
+    def eng(power):
+        e, *_ = ds.initialize(
+            model=model, model_parameters=params, loss_fn=lm_loss_fn,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 1,
+                    "fp16": {"enabled": True, "initial_scale_power": power},
+                    "zero_optimization": {
+                        "stage": 1,
+                        "offload_optimizer": {"device": "cpu"},
+                        "offload_param": {"layer_streaming": True}},
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "steps_per_print": 10000})
+        return e
+
+    ok = eng(8)
+    l0 = float(jax.device_get(ok.train_batch(iter([{"input_ids": ids}]))))
+    steps0 = ok.host_optimizer.step_count
+
+    bad = eng(40)          # 2^40 scale: certain overflow in fp16
+    s_before = bad.loss_scale
+    # hysteresis budget (default 2) absorbs the first overflow; the second
+    # shrinks the scale (reference DynamicLossScaler)
+    bad.train_batch(iter([{"input_ids": ids}]))
+    bad.train_batch(iter([{"input_ids": ids}]))
+    s_after = bad.loss_scale
+    print(json.dumps({
+        "finite_loss": l0, "stepped": steps0,
+        "scale_before": s_before, "scale_after": s_after,
+        "skipped": bad.skipped_steps,
+        "bad_stepped": bad.host_optimizer.step_count}))
+
+
 def main():
     mode = sys.argv[1]
     if mode == "parity":
@@ -106,6 +157,8 @@ def main():
         mode_parity(rotary=True, tie=False)
     elif mode == "parity_clip":
         mode_parity(rotary=False, tie=True, clip=0.01)
+    elif mode == "fp16":
+        mode_fp16()
     elif mode == "nvme":
         mode_nvme(sys.argv[2])
     else:
