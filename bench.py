@@ -31,9 +31,12 @@ import sys
 import time
 
 FLAGSHIP = "gpt2_125m_zero1"
-ALL_CASES = [FLAGSHIP, "ladder_zero1", "ladder_zero3",
-             "ladder_zero3_offload", "max_params", "decode_microbench",
-             "nvme_overlap"]
+# order: flagship first (the headline number), then the cheap guaranteed
+# cases, then the expensive ladder/capacity/kernel measurements — a budget
+# cut loses the tail, not the essentials
+ALL_CASES = [FLAGSHIP, "max_params", "nvme_overlap", "ladder_zero1",
+             "ladder_zero3", "ladder_zero3_offload", "capacity_streamed",
+             "long_context", "decode_microbench"]
 
 # Per-case env overrides. nvme_overlap is pure host+disk work: run it on
 # the CPU backend with the TPU-relay site hook disabled so a wedged relay
@@ -216,12 +219,10 @@ def case_max_params():
     this host (the bytes-per-param model lives in
     deepspeed_tpu.autotuning.memory.capacity_tiers, shared with the
     ds_report capacity table)."""
-    from deepspeed_tpu.autotuning.memory import capacity_tiers
+    from deepspeed_tpu.autotuning.memory import capacity_tiers, host_resources
     info = _device_info()
-    with open("/proc/meminfo") as f:
-        host = int(f.read().split("MemAvailable:")[1].split()[0]) * 1024
-    import shutil
-    nvme = shutil.disk_usage("/tmp").free
+    res = host_resources()
+    host, nvme = res["host_dram"], res["nvme_free"]
     tiers = capacity_tiers(info["hbm"], host, nvme)
     best = max(tiers.values())
     return {"metric": "max_params_per_chip_B",
@@ -285,6 +286,90 @@ def case_decode_microbench():
             "vs_baseline": round(geo, 3)}
 
 
+def case_long_context():
+    """Dense flash-attention at seq 16384 on one chip (the reference's
+    long-context story is block-sparse attention at ~10x seq;
+    ops/pallas/flash_attention.py holds O(S) activation memory, so 16x the
+    flagship's context trains without sparsity tricks)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import gpt2_125m
+    cfg = gpt2_125m(max_seq_len=16384, dtype=jnp.bfloat16)
+    return _train_case(cfg, batch=1, gas=2, zero_stage=1, offload=False,
+                       metric="long_context_seq16k_mfu")
+
+
+def case_capacity_streamed():
+    """Train a model LARGER than any pure-HBM/offload tier allows on this
+    chip via offload_param.layer_streaming (one block in HBM at a time;
+    runtime/zero/layer_stream.py). The reference's single-GPU capacity
+    headline (13B on one 32GB V100, zero3-offload blog) made concrete on
+    a 16GB v5e. Reports params + measured step time."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.gpt import (GPT, GPTConfig, gpt_neox_6_7b,
+                                          gpt_flops_per_token, lm_loss_fn)
+    from deepspeed_tpu.runtime.zero.partition_params import abstract_init
+    from deepspeed_tpu.autotuning.memory import capacity_tiers, host_resources
+
+    info = _device_info()
+    res = host_resources()
+    host = res["host_dram"]
+    menu = [
+        ("gpt_neox_6.7b", gpt_neox_6_7b(max_seq_len=1024,
+                                        dtype=jnp.bfloat16)),
+        ("gpt_2.7b", GPTConfig(num_layers=32, num_heads=32, d_model=2560,
+                               d_ff=10240, max_seq_len=1024,
+                               dtype=jnp.bfloat16)),
+        ("gpt2_1.3b", GPTConfig(num_layers=24, num_heads=32, d_model=2048,
+                                d_ff=8192, max_seq_len=1024,
+                                dtype=jnp.bfloat16)),
+    ]
+    if os.environ.get("BENCH_TINY") == "1":   # machinery validation on CPU
+        menu = [("gpt_tiny", GPTConfig(num_layers=3, num_heads=2,
+                                       d_model=64, d_ff=256, vocab_size=256,
+                                       max_seq_len=64,
+                                       dtype=jnp.bfloat16))]
+    # host: master+m+v+grad buffers (16 B/param, capacity_tiers); keep a
+    # wide margin — the bench box shares DRAM with everything else
+    name, cfg = next(((n, c) for n, c in menu
+                      if _cfg_params(c) * 16 < host * 0.45), menu[-1])
+    model = GPT(cfg)
+    tree = abstract_init(model, jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=tree, loss_fn=lm_loss_fn,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "bf16": {"enabled": True},
+                "zero_optimization": {
+                    "stage": 1,
+                    "offload_optimizer": {"device": "cpu"},
+                    "offload_param": {"layer_streaming": True}},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "steps_per_print": 100_000})
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, cfg.max_seq_len)).astype(np.int32)
+    dt = _measure_train(engine, lambda: iter([{"input_ids": ids}]),
+                        warmup=1, steps=1)
+    n = _cfg_params(cfg)
+    toks = cfg.max_seq_len / dt
+    achieved = gpt_flops_per_token(cfg, cfg.max_seq_len) * toks
+    # vs_baseline: params trained on one chip vs the best NON-streamed
+    # tier on the same host (the factor layer streaming buys)
+    tiers = capacity_tiers(info["hbm"], host, res["nvme_free"])
+    prev_cap = max(tiers["hbm_only"], tiers["host_offload"],
+                   tiers["nvme_offload"])
+    return {"metric": "capacity_streamed_params_B",
+            "value": round(n / 1e9, 2),
+            "unit": (f"B params trained on one {info['kind']} chip "
+                     f"({name}, step={dt:.1f}s, tokens/s={toks:.0f}, "
+                     f"{achieved / 1e12:.1f} TFLOP/s, layer-streamed, "
+                     f"host={host / 1e9:.0f}GB)"),
+            "vs_baseline": round(n / prev_cap, 2)}
+
+
 def case_nvme_overlap():
     """ZeRO-Infinity optimizer-swap overlap at ~1B params on local NVMe
     (the judge-visible point for the pipelined-swapper claim; reference:
@@ -308,6 +393,8 @@ CASE_FNS = {
     "ladder_zero3": case_ladder_zero3,
     "ladder_zero3_offload": case_ladder_zero3_offload,
     "max_params": case_max_params,
+    "long_context": case_long_context,
+    "capacity_streamed": case_capacity_streamed,
     "decode_microbench": case_decode_microbench,
     "nvme_overlap": case_nvme_overlap,
 }

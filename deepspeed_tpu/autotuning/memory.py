@@ -89,6 +89,16 @@ def max_micro_batch_for_budget(budget_bytes: float, *, num_params: int,
     return max(0, int((budget_bytes - states) // per_sample))
 
 
+def host_resources(nvme_path: str = "/tmp") -> Dict[str, float]:
+    """Available host DRAM and NVMe bytes (the probe behind capacity_tiers,
+    shared by bench.py and ds_report so they can never disagree)."""
+    import shutil
+    with open("/proc/meminfo") as fh:
+        host = int(fh.read().split("MemAvailable:")[1].split()[0]) * 1024
+    return {"host_dram": float(host),
+            "nvme_free": float(shutil.disk_usage(nvme_path).free)}
+
+
 def capacity_tiers(hbm: float, host_dram: float,
                    nvme_free: float) -> Dict[str, float]:
     """Max trainable params/chip per offload tier (single source for

@@ -119,16 +119,14 @@ def main() -> int:
     print("capacity (this host, max trainable params per chip)")
     print("-" * 64)
     try:
-        from ..autotuning.memory import capacity_tiers
+        from ..autotuning.memory import capacity_tiers, host_resources
         hbm = probe.get("hbm") if isinstance(probe, dict) else None
         hbm_note = ""
         if not hbm:
             hbm, hbm_note = 16e9, " (no chip reachable; HBM ASSUMED 16GB)"
-        with open("/proc/meminfo") as fh:
-            host = int(fh.read().split("MemAvailable:")[1].split()[0]) * 1024
-        import shutil as _sh
-        nvme = _sh.disk_usage("/tmp").free
-        tiers = capacity_tiers(float(hbm), host, nvme)
+        res = host_resources()
+        tiers = capacity_tiers(float(hbm), res["host_dram"],
+                               res["nvme_free"])
         rows = [
             ("pure HBM (ZeRO-1/2/3, dp=1)", tiers["hbm_only"]),
             ("+ offload_optimizer=cpu", tiers["host_offload"]),

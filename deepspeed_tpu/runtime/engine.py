@@ -985,6 +985,11 @@ class DeepSpeedEngine:
     def _offload_params_view(self):
         """Device params for eval/export; with offload_param they are
         rebuilt from the mirrors on demand (and consumed by the next step)."""
+        if getattr(self, "_layer_streamer", None) is not None:
+            raise RuntimeError(
+                "the layer-streamed tier never materializes the full model "
+                "on device; use get_params() (host-side numpy) or "
+                "save_16bit_model() instead")
         if self.state["params"] is None:
             self.state["params"] = self._offload_restore_params()
         return self.state["params"]
@@ -993,10 +998,17 @@ class DeepSpeedEngine:
         """Current (compute-dtype) parameters as a pytree. Always a COPY:
         engine state buffers are donated into the next train step, and a
         same-dtype astype would alias them (the caller's tree would read
-        'Array has been deleted' after one more step)."""
+        'Array has been deleted' after one more step).
+
+        Layer-streamed tier: assembled HOST-side (numpy) from the mirrors —
+        the capacity model is larger than HBM by design, so it must never
+        materialize on device."""
+        dt = dtype or self.compute_dtype
+        if getattr(self, "_layer_streamer", None) is not None:
+            tree = self.host_optimizer.mirror_tree()
+            return jax.tree.map(lambda x: np.asarray(x, dtype=dt), tree)
         src = (self._offload_params_view() if self.offload_enabled
                else self.state["master"])
-        dt = dtype or self.compute_dtype
         return jax.tree.map(lambda x: jnp.array(x, dtype=dt, copy=True), src)
 
     # ------------------------------------------------------------ dataloader

@@ -98,6 +98,13 @@ class LayerStreamer:
             bad.append("scan_layers=False")
         if jax.process_count() > 1 or not self.opt.owns_all():
             bad.append("multi-process dp")
+        import jax.numpy as jnp
+        if jnp.dtype(getattr(cfg, "dtype", jnp.float32)) != \
+                jnp.dtype(self.compute_dtype):
+            bad.append(
+                f"model dtype {jnp.dtype(cfg.dtype).name} != engine compute "
+                f"dtype {jnp.dtype(self.compute_dtype).name} (the scan "
+                "carry must keep one dtype across blocks)")
         if bad:
             raise ValueError(
                 "offload_param.layer_streaming does not support: "
@@ -289,7 +296,6 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
         (resident_grad_flats, metrics)
     Block grads leave through the emit callback; the engine combines the
     host-side block grad norm with the returned resident part."""
-    cfg = streamer.cfg
     L = streamer.num_layers
     compute_dtype = streamer.compute_dtype
     _blocks_tree, block_apply, embed_fn, head_fn, fetch = \

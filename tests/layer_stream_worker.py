@@ -79,6 +79,17 @@ def mode_parity(rotary, tie, clip=0.0):
         0, 128, (2, 32)).astype(np.int32)}
     ev_a = float(jax.device_get(ea.eval_batch(ev_batch)))
     ev_b = float(jax.device_get(eb.eval_batch(ev_batch)))
+    # full-model device views are forbidden on the streamed tier; the
+    # host-side export path works and matches the plain engine's params
+    try:
+        eb._offload_params_view()
+        raise AssertionError("_offload_params_view must raise when streamed")
+    except RuntimeError:
+        pass
+    pa = jax.tree.leaves(ea.get_params())
+    pb = jax.tree.leaves(eb.get_params())
+    get_params_diff = max(float(np.abs(np.asarray(x) - np.asarray(y)).max())
+                          for x, y in zip(pa, pb))
     L, gas, steps = 3, 2, 4
     print(json.dumps({
         "max_diff": max(diffs),
@@ -87,7 +98,8 @@ def mode_parity(rotary, tie, clip=0.0):
         "expect_emits": L * gas * steps,
         "gnorm_a": ea.get_global_grad_norm(),
         "gnorm_b": eb.get_global_grad_norm(),
-        "eval_diff": abs(ev_a - ev_b)}))
+        "eval_diff": abs(ev_a - ev_b),
+        "get_params_diff": get_params_diff}))
 
 
 def mode_nvme(workdir):

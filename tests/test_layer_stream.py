@@ -50,6 +50,8 @@ def test_streamed_matches_plain_offload(mode):
     assert np.isclose(r["gnorm_a"], r["gnorm_b"], rtol=1e-5), r
     # streamed eval never materializes the model yet matches exactly
     assert r["eval_diff"] < 1e-6, r
+    # host-side export path equals the plain engine's params
+    assert r["get_params_diff"] < 1e-6, r
 
 
 def test_streamed_clipping_matches():
