@@ -333,8 +333,16 @@ def case_capacity_streamed():
                                        dtype=jnp.bfloat16))]
     # host: master+m+v+grad buffers (16 B/param, capacity_tiers); keep a
     # wide margin — the bench box shares DRAM with everything else
-    name, cfg = next(((n, c) for n, c in menu
-                      if _cfg_params(c) * 16 < host * 0.45), menu[-1])
+    pick = next(((n, c) for n, c in menu
+                 if _cfg_params(c) * 16 < host * 0.45), None)
+    if pick is None:
+        return {"metric": "capacity_streamed_params_B", "value": 0.0,
+                "unit": (f"skipped: host DRAM too small for the smallest "
+                         f"menu model ({host / 1e9:.0f}GB available, "
+                         f"smallest needs "
+                         f"{_cfg_params(menu[-1][1]) * 16 / 1e9:.0f}GB)"),
+                "vs_baseline": 0.0}
+    name, cfg = pick
     model = GPT(cfg)
     tree = abstract_init(model, jax.random.PRNGKey(0),
                          jnp.zeros((1, 8), jnp.int32))

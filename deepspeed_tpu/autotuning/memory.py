@@ -210,3 +210,62 @@ def estimate_zero_model_states_mem_needs(num_params: int,
     return {stage: model_states_memory_per_chip(
         num_params, zero_stage=stage, dp=world)
         for stage in (0, 1, 2, 3)}
+
+
+def _plan_cli(argv=None) -> int:
+    """``python -m deepspeed_tpu.autotuning.memory --model gpt3_175b
+    --chip v5p --chips 64`` — print the per-stage table and the Infinity
+    plan for a named model on a named slice (the reference's
+    estimate_zero3_model_states_mem_needs_all_live UX)."""
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(prog="deepspeed_tpu.autotuning.memory")
+    ap.add_argument("--model", default="gpt3_175b",
+                    help="factory name in deepspeed_tpu.models.gpt "
+                         "(gpt2_125m, gpt2_1_3b, gpt_neox_20b, gpt3_175b...)")
+    ap.add_argument("--chip", default="v5p", choices=sorted(TPU_HBM_BYTES))
+    ap.add_argument("--chips", type=int, default=64)
+    ap.add_argument("--nvme-per-host", type=float, default=3e12)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--micro-batch", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import gpt as gpt_mod
+    from ..runtime.zero.partition_params import abstract_init
+    factory = getattr(gpt_mod, args.model, None)
+    if factory is None:
+        raise SystemExit(f"unknown model {args.model!r}")
+    cfg = factory()
+    tree = abstract_init(gpt_mod.GPT(cfg), jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))
+    numels = [int(np.prod(l.shape)) for l in jax.tree.leaves(tree)]
+    n = sum(numels)
+    host = TPU_HOST.get(args.chip, {"chips_per_host": 4, "host_dram": 256e9})
+    hosts = max(1, args.chips // host["chips_per_host"])
+    print(f"{args.model}: {n / 1e9:.2f}B params on {args.chips}x {args.chip} "
+          f"({hosts} hosts)")
+    print(f"{'stage':<8}{'bytes/chip':>14}")
+    for stage in (0, 1, 2, 3):
+        # dp world = the chips the user asked for, not a rounded host count
+        b = model_states_memory_per_chip(n, zero_stage=stage, dp=args.chips)
+        fits = "OK" if b < TPU_HBM_BYTES[args.chip] * 0.9 else "OOM"
+        print(f"z{stage:<7}{b / 1e9:>11.1f}GB  {fits}")
+    plan = plan_infinity(
+        numels, chips=args.chips, hosts=hosts,
+        hbm_per_chip=TPU_HBM_BYTES[args.chip],
+        host_dram_per_host=host["host_dram"],
+        nvme_per_host=args.nvme_per_host,
+        micro_batch=args.micro_batch, seq_len=args.seq,
+        hidden=cfg.d_model, layers=cfg.num_layers,
+        prefetch_numel=2 * max(-(-x // args.chips) for x in numels))
+    print("infinity plan: " + json.dumps(
+        {k: (round(v / 1e9, 1) if isinstance(v, float) and v > 1e6 else v)
+         for k, v in plan.items()}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_plan_cli())

@@ -1006,7 +1006,10 @@ class DeepSpeedEngine:
         dt = dtype or self.compute_dtype
         if getattr(self, "_layer_streamer", None) is not None:
             tree = self.host_optimizer.mirror_tree()
-            return jax.tree.map(lambda x: np.asarray(x, dtype=dt), tree)
+            # copy=True: mirror() can return views of the live host mirror
+            # buffers, which the next step overwrites in place
+            return jax.tree.map(
+                lambda x: np.array(x, dtype=dt, copy=True), tree)
         src = (self._offload_params_view() if self.offload_enabled
                else self.state["master"])
         return jax.tree.map(lambda x: jnp.array(x, dtype=dt, copy=True), src)

@@ -98,7 +98,6 @@ class LayerStreamer:
             bad.append("scan_layers=False")
         if jax.process_count() > 1 or not self.opt.owns_all():
             bad.append("multi-process dp")
-        import jax.numpy as jnp
         if jnp.dtype(getattr(cfg, "dtype", jnp.float32)) != \
                 jnp.dtype(self.compute_dtype):
             bad.append(

@@ -97,3 +97,14 @@ def test_nvme_swap_overlap(tmp_path, total_params):
     # ~1B-param number)
     assert best["overlap_ratio"] > 0.75, best
     assert np.isfinite(best["windowed_io_gbps"]) and best["windowed_io_gbps"] > 0
+
+
+def test_plan_cli_smoke(capsys):
+    """The estimate CLI (reference estimate_zero*_mem_needs UX) prints the
+    per-stage table and a fitting Infinity plan for a named model."""
+    from deepspeed_tpu.autotuning.memory import _plan_cli
+    rc = _plan_cli(["--model", "gpt2_125m", "--chip", "v5e", "--chips", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "z3" in out and "infinity plan" in out
+    assert '"fits": true' in out.lower()
