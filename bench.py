@@ -336,11 +336,12 @@ def case_capacity_streamed():
     pick = next(((n, c) for n, c in menu
                  if _cfg_params(c) * 16 < host * 0.45), None)
     if pick is None:
+        need = _cfg_params(menu[-1][1]) * 16
         return {"metric": "capacity_streamed_params_B", "value": 0.0,
-                "unit": (f"skipped: host DRAM too small for the smallest "
-                         f"menu model ({host / 1e9:.0f}GB available, "
-                         f"smallest needs "
-                         f"{_cfg_params(menu[-1][1]) * 16 / 1e9:.0f}GB)"),
+                "unit": (f"skipped: smallest menu model needs "
+                         f"{need / 1e9:.0f}GB of host DRAM but only "
+                         f"{host * 0.45 / 1e9:.0f}GB fits the 45% safety "
+                         f"margin ({host / 1e9:.0f}GB available)"),
                 "vs_baseline": 0.0}
     name, cfg = pick
     model = GPT(cfg)

@@ -244,7 +244,7 @@ def _plan_cli(argv=None) -> int:
     numels = [int(np.prod(l.shape)) for l in jax.tree.leaves(tree)]
     n = sum(numels)
     host = TPU_HOST.get(args.chip, {"chips_per_host": 4, "host_dram": 256e9})
-    hosts = max(1, args.chips // host["chips_per_host"])
+    hosts = max(1, -(-args.chips // host["chips_per_host"]))   # ceil
     print(f"{args.model}: {n / 1e9:.2f}B params on {args.chips}x {args.chip} "
           f"({hosts} hosts)")
     print(f"{'stage':<8}{'bytes/chip':>14}")
