@@ -83,6 +83,15 @@ class PipelineEngine:
                  mpu=None, collate_fn=None, config=None, loss_fn=None,
                  rng=None):
         comm.init_distributed()
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "the pipeline engine is single-controller: one host drives "
+                "every stage's sub-mesh programs (runtime/pipe/engine.py "
+                "design note). Multi-process pipelines would need per-rank "
+                "instruction loops (the reference's model, pipe/engine.py:"
+                "1346); on multi-host TPU slices use dp/tp/sp/ep sharding "
+                "from a single controller instead — failing loudly here "
+                "beats an undefined multi-controller dispatch")
         self.module = model
         self.num_stages = model.num_stages
         pre = DeepSpeedConfig(config, dp_world_size=1)

@@ -11,19 +11,22 @@ TPU shape of the same idea: the GPT scan-over-layers structure is driven
 manually —
 
   forward : x_{i+1} = Block(p_i, x_i) with p_i fetched from the host
-            mirror store via ``io_callback`` (one fetch per layer); only
-            the layer INPUTS are kept (remat-style, O(L*B*S*D) bf16)
+            mirror store via ``io_callback``, DOUBLE-BUFFERED: iteration i
+            carries layer i's params and prefetches layer i+1's (the
+            coordinator's prefetch-ahead); only the layer INPUTS are kept
+            (remat-style, O(L*B*S*D) bf16)
   head    : loss + cotangent via vjp of the resident ln_f/lm_head/embed
-  backward: reverse scan re-fetches p_i, replays the block under vjp,
-            EMITS the scaled fp32 param-grads back to host buffers via an
-            ordered ``io_callback``, and carries dx
+  backward: reverse scan (same double buffering) replays the block under
+            vjp, EMITS the scaled fp32 param-grads back to host buffers
+            via an ordered ``io_callback``, and carries dx
   update  : HostOffloadOptimizer steps every leaf on the host (CPU-Adam,
             optionally NVMe-swapped state); next step fetches the updated
             mirrors
 
-Peak HBM = one block's params + one block's grads + the layer-input stack
-+ embeddings — independent of depth. Max trainable params/chip becomes a
-host-DRAM/NVMe bound instead of an HBM bound.
+Peak HBM = TWO blocks' params (current + prefetched) + one block's grads
++ the layer-input stack + embeddings — independent of depth. Max
+trainable params/chip becomes a host-DRAM/NVMe bound instead of an HBM
+bound. Fetch count per scan = L+1 (the prefetch prime).
 
 Restrictions (validated loudly): scan_layers param layout (stacked
 ``blocks`` [L, ...]), dense blocks (no MoE), no progressive layer drop, no
@@ -278,10 +281,23 @@ def build_streamed_eval(streamer: LayerStreamer):
         b, s = ids.shape
         positions = jnp.arange(s)[None, :].repeat(b, axis=0)
 
-        def f_body(x, i):
-            return block_apply(_blocks_tree(fetch(i)), x, positions), None
+        # double-buffered: the carry holds the CURRENT layer's params while
+        # the next layer's fetch rides the same iteration (the coordinator's
+        # prefetch-ahead, partitioned_param_coordinator.py:240 — the fetch
+        # callback is dataflow-independent of the block compute, so the
+        # runtime can overlap the host hop with the MXU work)
+        def f_body(carry, i):
+            x, p_cur = carry
+            # last iteration has nothing to prefetch: reuse p_cur instead
+            # of paying a dead host/NVMe round trip
+            p_next = jax.lax.cond(i + 1 < L,
+                                  lambda: _blocks_tree(fetch(i + 1)),
+                                  lambda: p_cur)
+            y = block_apply(p_cur, x, positions)
+            return (y, p_next), None
         x0 = embed_fn(res, ids, positions)
-        x_last, _ = jax.lax.scan(f_body, x0, jnp.arange(L))
+        p0 = _blocks_tree(fetch(jnp.asarray(0, jnp.int32)))
+        (x_last, _), _ = jax.lax.scan(f_body, (x0, p0), jnp.arange(L))
         _scaled, loss = head_fn(res, x_last, batch,
                                 jnp.ones((), jnp.float32))
         return loss
@@ -306,11 +322,18 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
         positions = jnp.arange(s)[None, :].repeat(b, axis=0)
 
         # ---- forward: stream layers, keep only layer inputs -------------
-        def f_body(x, i):
-            p = _blocks_tree(fetch(i))
-            return block_apply(p, x, positions), x
+        # double-buffered (see build_streamed_eval): fetch(i+1) rides
+        # iteration i, dataflow-independent of the block compute
+        def f_body(carry, i):
+            x, p_cur = carry
+            p_next = jax.lax.cond(i + 1 < L,
+                                  lambda: _blocks_tree(fetch(i + 1)),
+                                  lambda: p_cur)
+            y = block_apply(p_cur, x, positions)
+            return (y, p_next), x
         x0 = embed_fn(res, ids, positions)
-        x_last, xs = jax.lax.scan(f_body, x0, jnp.arange(L))
+        p0 = _blocks_tree(fetch(jnp.asarray(0, jnp.int32)))
+        (x_last, _), xs = jax.lax.scan(f_body, (x0, p0), jnp.arange(L))
 
         # ---- head: loss + cotangents ------------------------------------
         _s_loss, head_vjp, loss = jax.vjp(
@@ -323,11 +346,13 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
         # host from the emit buffers — a per-micro sum of squares here
         # would be the wrong quantity)
         def b_body(carry, inp):
-            dx, finite = carry
+            dx, p_cur, finite = carry
             i, x_i = inp
-            p = _blocks_tree(fetch(i))
+            p_next = jax.lax.cond(i > 0,
+                                  lambda: _blocks_tree(fetch(i - 1)),
+                                  lambda: p_cur)
             _, vjp_fn = jax.vjp(
-                lambda pp, xx: block_apply(pp, xx, positions), p, x_i)
+                lambda pp, xx: block_apply(pp, xx, positions), p_cur, x_i)
             dp, dx_next = vjp_fn(dx.astype(x_i.dtype))
             dp32 = jax.tree.map(lambda g: g.astype(jnp.float32), dp)
             io_callback(streamer.emit_layer, None, i,
@@ -336,10 +361,11 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
                 finite, jnp.all(jnp.asarray(
                     [jnp.all(jnp.isfinite(g))
                      for g in jax.tree.leaves(dp32)])))
-            return (dx_next, finite), None
+            return (dx_next, p_next, finite), None
 
-        (dx0, blocks_finite), _ = jax.lax.scan(
-            b_body, (dx, jnp.asarray(True)),
+        p_last = _blocks_tree(fetch(jnp.asarray(L - 1, jnp.int32)))
+        (dx0, _, blocks_finite), _ = jax.lax.scan(
+            b_body, (dx, p_last, jnp.asarray(True)),
             (jnp.arange(L - 1, -1, -1), xs[::-1]))
 
         # ---- embeddings -------------------------------------------------

@@ -61,7 +61,8 @@ def mode_parity(rotary, tie, clip=0.0):
     ea = _engine(model, params, stream=False, clip=clip)
     eb = _engine(model, params, stream=True, clip=clip)
     assert eb.state["params"] is None and eb.state["acc"] is None
-    # count host round trips: 2L fetches (fwd+bwd) and L emits per micro
+    # count host round trips: L fetches + 1 prefetch prime per scan
+    # (fwd and bwd are each one scan) and L emits per micro
     st = eb._layer_streamer
     fetches, emits = [0], [0]
     orig_fetch, orig_emit = st.fetch_layer, st.emit_layer
@@ -94,7 +95,10 @@ def mode_parity(rotary, tie, clip=0.0):
     print(json.dumps({
         "max_diff": max(diffs),
         "fetches": fetches[0], "emits": emits[0],
-        "expect_fetches": 2 * L * gas * steps + L,  # +L: eval fwd
+        # double-buffered: prime(1) + (L-1) in-scan prefetches = exactly
+        # L fetches per scan (the final iteration's dead prefetch is
+        # cond-skipped); fwd+bwd scans per micro, plus the eval forward
+        "expect_fetches": 2 * L * gas * steps + L,
         "expect_emits": L * gas * steps,
         "gnorm_a": ea.get_global_grad_norm(),
         "gnorm_b": eb.get_global_grad_norm(),

@@ -40,9 +40,10 @@ def _run(mode, *args, timeout=600):
 @pytest.mark.parametrize("mode", ["parity", "parity_rotary_untied"])
 def test_streamed_matches_plain_offload(mode):
     """4 optimizer steps: the streamed path must match the plain offload
-    path bit-for-bit (same grads, same CPU-Adam updates), with exactly
-    2L fetches (forward + backward) and L emits per microbatch, and no
-    full params / grad accumulator on the device between steps."""
+    path bit-for-bit (same grads, same CPU-Adam updates), with the
+    double-buffered fetch count (L per scan + prefetch prime) and L emits
+    per microbatch, and no full params / grad accumulator on the device
+    between steps."""
     r = _run(mode)
     assert r["max_diff"] == 0.0, r
     assert r["fetches"] == r["expect_fetches"], r

@@ -527,3 +527,12 @@ def test_pipeline_tp_matches_tp1():
             if any(ax == "tp" for ax in leaf.sharding.spec if ax is not None):
                 tp_leaves += 1
     assert tp_leaves >= 4, f"expected tp-sharded kernels, got {tp_leaves}"
+
+
+def test_pipeline_rejects_multiprocess(monkeypatch):
+    """Multi-process pipeline dispatch is undefined (single-controller
+    design) — the engine must refuse loudly, not fail deep inside XLA."""
+    from deepspeed_tpu.runtime.pipe import engine as pe
+    monkeypatch.setattr(pe.jax, "process_count", lambda: 2)
+    with pytest.raises(NotImplementedError, match="single-controller"):
+        make_pipe(num_stages=2)
