@@ -90,8 +90,17 @@ def _train_case(cfg, batch, gas, zero_stage, offload, metric,
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu as ds
-    from deepspeed_tpu.models.gpt import GPT, gpt_flops_per_token, lm_loss_fn
+    from deepspeed_tpu.models.gpt import (GPT, GPTConfig,
+                                          gpt_flops_per_token, lm_loss_fn)
 
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    if tiny:
+        # machinery smoke on CPU: same engine/config/measure path, toy size
+        cfg = GPTConfig(num_layers=2, num_heads=2, d_model=64, d_ff=128,
+                        vocab_size=256, max_seq_len=64, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)
+        batch, gas = 2, 2
+        metric = metric + "_TINY_SMOKE"   # never confusable with a real run
     info = _device_info()
     model = GPT(cfg)
     seq = cfg.max_seq_len
@@ -370,7 +379,8 @@ def case_capacity_streamed():
     tiers = capacity_tiers(info["hbm"], host, res["nvme_free"])
     prev_cap = max(tiers["hbm_only"], tiers["host_offload"],
                    tiers["nvme_offload"])
-    return {"metric": "capacity_streamed_params_B",
+    tag = "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
+    return {"metric": "capacity_streamed_params_B" + tag,
             "value": round(n / 1e9, 2),
             "unit": (f"B params trained on one {info['kind']} chip "
                      f"({name}, step={dt:.1f}s, tokens/s={toks:.0f}, "
@@ -416,14 +426,20 @@ CASE_FNS = {
 
 def _run_child(cmd, timeout, want_key, extra_env=None):
     """Run a child, return (last JSON dict containing want_key, error)."""
-    env = None
-    if extra_env:
-        env = dict(os.environ)
-        for k, v in extra_env.items():
-            if v == "":
-                env.pop(k, None)
-            else:
-                env[k] = v
+    env = dict(os.environ)
+    # a lingering smoke-mode flag must never shrink a real driver run's
+    # models (children only see it when a caller passes it via extra_env)
+    if env.pop("BENCH_TINY", None):
+        print("[bench] stripping stray BENCH_TINY from case env",
+              file=sys.stderr)
+    # persistent XLA compilation cache: case retries and later cases reuse
+    # compiled programs instead of paying cold compiles into the budget
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
+    for k, v in (extra_env or {}).items():
+        if v == "":
+            env.pop(k, None)
+        else:
+            env[k] = v
     try:
         p = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=env)

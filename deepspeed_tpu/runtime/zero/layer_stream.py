@@ -26,7 +26,8 @@ manually —
 Peak HBM = TWO blocks' params (current + prefetched) + one block's grads
 + the layer-input stack + embeddings — independent of depth. Max
 trainable params/chip becomes a host-DRAM/NVMe bound instead of an HBM
-bound. Fetch count per scan = L+1 (the prefetch prime).
+bound. Fetch count per scan = exactly L (one prime + L-1 in-scan
+prefetches; the final iteration's dead prefetch is cond-skipped).
 
 Restrictions (validated loudly): scan_layers param layout (stacked
 ``blocks`` [L, ...]), dense blocks (no MoE), no progressive layer drop, no

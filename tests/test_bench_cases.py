@@ -1,0 +1,38 @@
+"""bench.py case machinery smoke (BENCH_TINY=1): every driver-run case
+must construct its engine and produce a metric line on the CPU backend, so
+the one shot on real hardware can't die to plumbing bit-rot."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+import bench  # noqa: E402  (import-safe by design: no jax at module level)
+
+
+def _case(name, timeout=420):
+    obj, err = bench._run_child(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--case", name],
+        timeout, "metric",
+        extra_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+                   "BENCH_TINY": "1",
+                   "PYTHONPATH": REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")})
+    assert obj is not None, f"{name}: {err}"
+    return obj
+
+
+@pytest.mark.parametrize("name,metric_prefix", [
+    ("gpt2_125m_zero1", "gpt2_125m_train_mfu"),
+    ("ladder_zero3", "ladder_"),
+    ("ladder_zero3_offload", "ladder_"),
+    ("capacity_streamed", "capacity_streamed_params_B"),
+    ("long_context", "long_context_"),
+    ("max_params", "max_params_per_chip_B"),
+])
+def test_bench_case_produces_metric(name, metric_prefix):
+    obj = _case(name)
+    assert obj["metric"].startswith(metric_prefix), obj
+    assert "unit" in obj and "vs_baseline" in obj
