@@ -234,7 +234,8 @@ def case_max_params():
     host, nvme = res["host_dram"], res["nvme_free"]
     tiers = capacity_tiers(info["hbm"], host, nvme)
     best = max(tiers.values())
-    return {"metric": "max_params_per_chip_B",
+    tag = "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
+    return {"metric": "max_params_per_chip_B" + tag,
             "value": round(best / 1e9, 2),
             "unit": ("B params ("
                      + ", ".join(f"{k}={v / 1e9:.2f}B"
@@ -346,7 +347,8 @@ def case_capacity_streamed():
                  if _cfg_params(c) * 16 < host * 0.45), None)
     if pick is None:
         need = _cfg_params(menu[-1][1]) * 16
-        return {"metric": "capacity_streamed_params_B", "value": 0.0,
+        tag = "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
+        return {"metric": "capacity_streamed_params_B" + tag, "value": 0.0,
                 "unit": (f"skipped: smallest menu model needs "
                          f"{need / 1e9:.0f}GB of host DRAM but only "
                          f"{host * 0.45 / 1e9:.0f}GB fits the 45% safety "
@@ -434,7 +436,10 @@ def _run_child(cmd, timeout, want_key, extra_env=None):
               file=sys.stderr)
     # persistent XLA compilation cache: case retries and later cases reuse
     # compiled programs instead of paying cold compiles into the budget
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_comp_cache")
+    # (per-user path: a world-shared /tmp dir breaks on multi-user boxes)
+    import tempfile
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        tempfile.gettempdir(), f"jax_comp_cache_{os.getuid()}"))
     for k, v in (extra_env or {}).items():
         if v == "":
             env.pop(k, None)

@@ -205,6 +205,8 @@ class DeepSpeedEngine:
                 "into the offload train path; disable offload_optimizer or "
                 "these features (silently ignoring them would train a "
                 "different model than configured)")
+        self._comm_dtype()   # validate communication_data_type at init,
+        # not at first train step (a typo must not survive expensive setup)
         if zc.offload_param.layer_streaming and not self.offload_enabled:
             raise ValueError(
                 "offload_param.layer_streaming requires offload_optimizer "
@@ -246,6 +248,12 @@ class DeepSpeedEngine:
             f"batch={self.train_batch_size()}={self.train_micro_batch_size_per_gpu()}"
             f"x{self.gradient_accumulation_steps()}x{self.dp_world_size}",
             ranks=[0])
+        if self.config.dump_state:
+            # reference dump_state: print the resolved config (engine.py
+            # dump_state flag)
+            import dataclasses as _dc
+            log_dist("resolved config: "
+                     f"{_dc.asdict(self.config)}", ranks=[0])
 
     # ------------------------------------------------------------------ init
     def _apply_activation_checkpointing_config(self, module):
@@ -660,9 +668,37 @@ class DeepSpeedEngine:
             return (loss.astype(jnp.float32) * scale), loss
 
         (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params)
-        grads = _cast_tree(grads, jnp.float32)
-        grads = jax.lax.with_sharding_constraint(grads, self.grad_shardings)
+        cdt = self._comm_dtype()
+        if cdt is not None:
+            # reference communication_data_type: the dp grad reduction runs
+            # in this dtype (engine.py allreduce dtype override). The
+            # sharding constraint lands while the grads are STILL narrow,
+            # so GSPMD emits the reduce-scatter on the narrow type — half
+            # the ICI bytes for bf16/fp16 — and only the already-reduced
+            # shards widen back to the fp32 accumulator.
+            grads = _cast_tree(grads, cdt)
+            grads = jax.lax.with_sharding_constraint(grads,
+                                                     self.grad_shardings)
+            grads = _cast_tree(grads, jnp.float32)
+        else:
+            grads = _cast_tree(grads, jnp.float32)
+            grads = jax.lax.with_sharding_constraint(grads,
+                                                     self.grad_shardings)
         return loss.astype(jnp.float32), grads
+
+    def _comm_dtype(self):
+        """communication_data_type -> jnp dtype (None = keep fp32)."""
+        cdt = self.config.communication_data_type
+        if not cdt:
+            return None
+        names = {"fp16": jnp.float16, "float16": jnp.float16,
+                 "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                 "fp32": None, "float32": None}
+        if cdt not in names:
+            raise ValueError(
+                f"communication_data_type={cdt!r}: use fp16/bf16/fp32 "
+                "(reference engine.py communication_data_type)")
+        return names[cdt]
 
     def _apply_update(self, state, gas):
         """Unscale+clip+update with overflow guard, all traced."""
@@ -944,6 +980,10 @@ class DeepSpeedEngine:
             self.timers.log(["train_batch_dispatch", "train_batch_device"])
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps, metrics)
+            if self.config.memory_breakdown:
+                # reference memory_breakdown: see_memory_usage at report
+                # boundaries (runtime/utils.py)
+                log_dist("memory: " + self.timers.memory_usage(), ranks=[0])
         if self.monitor.enabled and jax.process_index() == 0:
             evts = [("Train/Samples/train_loss", float(jax.device_get(metrics["loss"])),
                      self.global_samples)]
@@ -1178,6 +1218,22 @@ class DeepSpeedEngine:
         log_dist(f"loaded host-sharded checkpoint tag={tag} "
                  f"step={self.global_steps}", ranks=[0])
         return ckpt_dir, meta.get("client_state", {})
+
+    def consolidated_fp32_state_dict(self):
+        """Full fp32 weights, '/'-path-keyed numpy (the in-process
+        zero_to_fp32; reference _zero3_consolidated_16bit_state_dict /
+        deepspeed.utils.zero_to_fp32, engine.py:3089). Offload tiers
+        consolidate host-side from the master shards."""
+        if self.offload_enabled:
+            return ckpt_saving.consolidated_fp32_state_dict(
+                self.host_optimizer.master_tree())
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "consolidated_fp32_state_dict gathers the FULL tree on this "
+                "host; under multi-host sharding use the sharded checkpoint "
+                "path (save_checkpoint) and consolidate offline with the "
+                "dropped-in zero_to_fp32.py")
+        return ckpt_saving.consolidated_fp32_state_dict(self.state["master"])
 
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
         os.makedirs(save_dir, exist_ok=True)

@@ -103,3 +103,30 @@ def test_zero_with_bf16(stage):
     engine = make_engine(cfg)
     losses = _losses(engine, steps=6)
     assert losses[-1] < losses[0]
+
+
+def test_engine_consolidated_fp32_state_dict():
+    """engine.consolidated_fp32_state_dict(): path-keyed full fp32 weights
+    from any tier (the in-process zero_to_fp32)."""
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from simple_model import SimpleModel, mse_loss, random_batch
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16)))["params"]
+    for extra in ({"zero_optimization": {"stage": 3}},
+                  {"zero_optimization": {
+                      "stage": 1, "offload_optimizer": {"device": "cpu"}}}):
+        cfg = {"train_micro_batch_size_per_gpu": 8,
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 10000}
+        cfg.update(extra)
+        e, *_ = ds.initialize(model=model, model_parameters=params,
+                              loss_fn=mse_loss, config=cfg)
+        e.train_batch(iter([random_batch(8)]))
+        sd = e.consolidated_fp32_state_dict()
+        assert all("/" in k for k in sd), list(sd)[:3]
+        total = sum(v.size for v in sd.values())
+        expect = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+        assert total == expect, (total, expect)
+        assert all(v.dtype == np.float32 for v in sd.values())
