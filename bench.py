@@ -100,7 +100,7 @@ def _train_case(cfg, batch, gas, zero_stage, offload, metric,
                         vocab_size=256, max_seq_len=64, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype)
         batch, gas = 2, 2
-        metric = metric + "_TINY_SMOKE"   # never confusable with a real run
+        metric = metric + _tiny_tag()
     info = _device_info()
     model = GPT(cfg)
     seq = cfg.max_seq_len
@@ -160,6 +160,12 @@ def case_gpt2_125m_zero1():
     cfg = gpt2_125m(max_seq_len=1024, dtype=jnp.bfloat16, scan_unroll=12)
     return _train_case(cfg, batch=8, gas=16, zero_stage=1, offload=False,
                        metric="gpt2_125m_train_mfu")
+
+
+def _tiny_tag() -> str:
+    """Metric suffix in BENCH_TINY smoke mode — a tiny-config measurement
+    must never be confusable with a real run's metric name."""
+    return "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
 
 
 def _cfg_params(cfg) -> int:
@@ -234,8 +240,7 @@ def case_max_params():
     host, nvme = res["host_dram"], res["nvme_free"]
     tiers = capacity_tiers(info["hbm"], host, nvme)
     best = max(tiers.values())
-    tag = "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
-    return {"metric": "max_params_per_chip_B" + tag,
+    return {"metric": "max_params_per_chip_B" + _tiny_tag(),
             "value": round(best / 1e9, 2),
             "unit": ("B params ("
                      + ", ".join(f"{k}={v / 1e9:.2f}B"
@@ -347,8 +352,8 @@ def case_capacity_streamed():
                  if _cfg_params(c) * 16 < host * 0.45), None)
     if pick is None:
         need = _cfg_params(menu[-1][1]) * 16
-        tag = "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
-        return {"metric": "capacity_streamed_params_B" + tag, "value": 0.0,
+        return {"metric": "capacity_streamed_params_B" + _tiny_tag(),
+                "value": 0.0,
                 "unit": (f"skipped: smallest menu model needs "
                          f"{need / 1e9:.0f}GB of host DRAM but only "
                          f"{host * 0.45 / 1e9:.0f}GB fits the 45% safety "
@@ -381,8 +386,7 @@ def case_capacity_streamed():
     tiers = capacity_tiers(info["hbm"], host, res["nvme_free"])
     prev_cap = max(tiers["hbm_only"], tiers["host_offload"],
                    tiers["nvme_offload"])
-    tag = "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
-    return {"metric": "capacity_streamed_params_B" + tag,
+    return {"metric": "capacity_streamed_params_B" + _tiny_tag(),
             "value": round(n / 1e9, 2),
             "unit": (f"B params trained on one {info['kind']} chip "
                      f"({name}, step={dt:.1f}s, tokens/s={toks:.0f}, "

@@ -207,6 +207,17 @@ class DeepSpeedEngine:
                 "different model than configured)")
         self._comm_dtype()   # validate communication_data_type at init,
         # not at first train step (a typo must not survive expensive setup)
+        if self.config.amp and self.config.amp.get("enabled"):
+            raise ValueError(
+                "amp is the reference's NVIDIA-Apex integration and has no "
+                "TPU analogue; use the fp16 or bf16 config blocks (same "
+                "mixed-precision semantics, in-graph loss scaling)")
+        if self.config.disable_allgather:
+            log_dist(
+                "disable_allgather is inert here: GSPMD emits the ZeRO "
+                "step-tail collectives from shardings (the reference knob "
+                "swaps allgather for broadcasts as a perf workaround, "
+                "engine.py disable_allgather)", ranks=[0])
         if zc.offload_param.layer_streaming and not self.offload_enabled:
             raise ValueError(
                 "offload_param.layer_streaming requires offload_optimizer "
@@ -327,6 +338,17 @@ class DeepSpeedEngine:
         if optimizer is not None and not isinstance(optimizer, optax.GradientTransformation):
             raise TypeError("optimizer must be an optax.GradientTransformation")
         if optimizer is not None:
+            if self.zero_stage >= 1 and \
+                    not self.config.zero_allow_untested_optimizer:
+                # reference _do_sanity_check: an arbitrary client optimizer
+                # under ZeRO is unvalidated (sharded-state semantics depend
+                # on the optimizer's state tree mirroring params); opt in
+                # explicitly (engine.py ZERO_ALLOW_UNTESTED_OPTIMIZER)
+                raise ValueError(
+                    "a client optimizer with ZeRO >= 1 is untested: set "
+                    "zero_optimization + zero_allow_untested_optimizer: "
+                    "true to accept sharded-state behavior for it, or use "
+                    "a config-named optimizer")
             self._client_optimizer = optimizer
             self._opt_factory = lambda lr: optimizer
             return
@@ -1063,9 +1085,35 @@ class DeepSpeedEngine:
                                    drop_last=self.config.dataloader_drop_last)
 
     # ----------------------------------------------------------- checkpoints
+    def _validate_checkpoint_tag(self, tag: str) -> None:
+        """All ranks must save under the SAME tag (reference
+        _checkpoint_tag_validation, engine.py:2750: a compare guard,
+        warn|fail|ignore per config)."""
+        mode = (self.config.checkpoint_tag_validation or "warn").lower()
+        if mode not in ("warn", "fail", "ignore"):
+            raise ValueError(
+                f"checkpoint_tag_validation={mode!r}: use warn|fail|ignore")
+        if mode == "ignore" or jax.process_count() == 1:
+            return
+        import zlib
+        from jax.experimental import multihost_utils
+        mine = np.asarray([zlib.crc32(tag.encode())], np.uint32)
+        # SYMMETRIC check: every rank sees every hash, so on mismatch ALL
+        # ranks take the same branch — a one-sided raise would leave the
+        # passing ranks deadlocked at the save collectives
+        all_hashes = np.asarray(
+            multihost_utils.process_allgather(mine)).reshape(-1)
+        if len(set(int(h) for h in all_hashes)) > 1:
+            msg = (f"checkpoint tags differ across processes (this rank: "
+                   f"{tag!r}) — mixed-tag checkpoints cannot be loaded back")
+            if mode == "fail":
+                raise ValueError(msg)
+            log_dist("WARNING: " + msg, ranks=None)
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         tag = tag or f"global_step{self.global_steps}"
+        self._validate_checkpoint_tag(tag)
         meta = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,

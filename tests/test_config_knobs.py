@@ -267,3 +267,38 @@ def test_communication_data_type_changes_program_and_validates():
     with pytest.raises(ValueError, match="communication_data_type"):
         e = eng("int7")
         e.train_batch(iter([random_batch(8)]))
+
+
+def test_amp_rejected_and_untested_optimizer_gated():
+    """amp (Apex) has no TPU analogue -> reject; a client optax optimizer
+    under ZeRO needs the explicit zero_allow_untested_optimizer opt-in
+    (reference _do_sanity_check)."""
+    import optax
+    import deepspeed_tpu as ds
+    from simple_model import SimpleModel, mse_loss
+
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16)))["params"]
+    base = {"train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10000}
+
+    with pytest.raises(ValueError, match="amp"):
+        ds.initialize(model=model, model_parameters=params, loss_fn=mse_loss,
+                      config=dict(base, amp={"enabled": True}))
+
+    with pytest.raises(ValueError, match="untested"):
+        ds.initialize(model=model, model_parameters=params, loss_fn=mse_loss,
+                      config=dict(base, zero_optimization={"stage": 1}),
+                      optimizer=optax.sgd(1e-2))
+
+    # the opt-in accepts it and it trains
+    e, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=mse_loss,
+        config=dict(base, zero_optimization={"stage": 1},
+                    zero_allow_untested_optimizer=True),
+        optimizer=optax.sgd(1e-2))
+    from simple_model import random_batch
+    loss = float(jax.device_get(e.train_batch(iter([random_batch(8)]))))
+    assert np.isfinite(loss)
