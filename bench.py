@@ -8,19 +8,26 @@ process with a hard timeout, every case runs in its own child process with
 a timeout and ONE retry, and total failure still emits a clear JSON line
 with diagnostics instead of a traceback.
 
-Cases (north-star ladder, BASELINE.md):
+Cases (north-star ladder, BASELINE.md), in run order:
   gpt2_125m_zero1       flagship MFU (round-over-round comparable)
+  max_params            max params/chip per offload tier (measured HBM +
+                        host DRAM + NVMe free; model in
+                        autotuning/memory.py capacity_tiers)
+  nvme_overlap          ~1B-param windowed-vs-sync optimizer swap sweep
+                        (host+disk only; runs even with the chip dead)
   ladder_zero1          largest pure-HBM model, ZeRO-1
   ladder_zero3          same model, ZeRO-3 machinery overhead at dp=1
   ladder_zero3_offload  ~1.3B, ZeRO-3 + host-offloaded optimizer
                         (reference claim to beat: 50 TFlops/GPU,
                         docs/_posts/2021-03-08-zero3-offload.md:65)
-  max_params            max params/chip per offload tier (measured HBM +
-                        host DRAM + NVMe free, documented bytes/param)
+  capacity_streamed     largest host-holdable GPT trained on one chip via
+                        layer streaming
+  long_context          dense flash attention at seq 16384
   decode_microbench     pallas vs xla decode attention across cache fills
 
 Env knobs: BENCH_PROBE_TIMEOUT (600s), BENCH_CASE_TIMEOUT (1800s),
-BENCH_BUDGET_S (7200s), BENCH_CASES (comma list).
+BENCH_BUDGET_S (7200s), BENCH_CASES (comma list), BENCH_TINY=1 (toy-size
+machinery smoke; metrics get a _TINY_SMOKE suffix).
 """
 
 import argparse
@@ -84,8 +91,13 @@ def _measure_train(engine, batch_iter_factory, warmup=2, steps=5):
     return (time.perf_counter() - t0) / steps
 
 
-def _train_case(cfg, batch, gas, zero_stage, offload, metric,
-                scan_unroll=None, vs="mfu"):
+def _tiny_tag() -> str:
+    """Metric suffix in BENCH_TINY smoke mode — a tiny-config measurement
+    must never be confusable with a real run's metric name."""
+    return "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
+
+
+def _train_case(cfg, batch, gas, zero_stage, offload, metric, vs="mfu"):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -109,7 +121,6 @@ def _train_case(cfg, batch, gas, zero_stage, offload, metric,
     zcfg = {"stage": zero_stage}
     if offload:
         zcfg["offload_optimizer"] = {"device": "cpu"}
-    if offload:
         # stream shard fills instead of materializing a replicated init
         from deepspeed_tpu.runtime.zero.partition_params import abstract_init
         params = abstract_init(model, jax.random.PRNGKey(0),
@@ -160,12 +171,6 @@ def case_gpt2_125m_zero1():
     cfg = gpt2_125m(max_seq_len=1024, dtype=jnp.bfloat16, scan_unroll=12)
     return _train_case(cfg, batch=8, gas=16, zero_stage=1, offload=False,
                        metric="gpt2_125m_train_mfu")
-
-
-def _tiny_tag() -> str:
-    """Metric suffix in BENCH_TINY smoke mode — a tiny-config measurement
-    must never be confusable with a real run's metric name."""
-    return "_TINY_SMOKE" if os.environ.get("BENCH_TINY") == "1" else ""
 
 
 def _cfg_params(cfg) -> int:

@@ -36,7 +36,7 @@ sequence parallelism, deterministic compute (dropout 0), single-process.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
