@@ -175,7 +175,7 @@ def plan_infinity(leaf_numels, *, chips: int, hosts: int,
     max_shard = max(shard_lens)
 
     depth = NVMeLeafSwapper.window_depth(max_shard, prefetch_numel)
-    slots = 1 + depth
+    slots = NVMeLeafSwapper.slot_count(depth)
     nvme = local_numel * 12.0 + (local_numel * 2.0 if mirror_on_nvme else 0.0)
     dram = (slots * 3 * max_shard * 4.0      # swapper slot windows
             + local_numel * 4.0              # D2H grad shards (fp32)

@@ -27,10 +27,18 @@ import numpy as np
 
 
 def measure_nvme_overlap(nvme_path: str, total_params: int = int(1e9),
-                         num_leaves: int = 32, prefetch_depth: int = 2,
-                         lr: float = 1e-3, keep_files: bool = False) -> dict:
+                         num_leaves: int = 32, prefetch_depth: int = 4,
+                         lr: float = 1e-3, keep_files: bool = False,
+                         reps: int = 3) -> dict:
     """Build a synthetic master+moments set of ``total_params`` on NVMe and
-    time one windowed optimizer sweep vs one synchronous sweep."""
+    time windowed optimizer sweeps against synchronous sweeps.
+
+    Cloud block devices throttle and burst (single-run numbers on the bench
+    host swing ~2x), so the two sweeps are measured as ``reps`` interleaved
+    (sync, windowed) pairs and the reported ratio is median/median. The sync
+    sweep carries per-phase timers, so the result also states how IO-bound
+    the configuration is — the quantity that bounds what overlap can buy:
+    best_ratio <= 1 + compute/(read+write)."""
     from ..runtime.zero.offload import HostOffloadOptimizer
 
     leaf_numel = total_params // num_leaves
@@ -46,30 +54,60 @@ def measure_nvme_overlap(nvme_path: str, total_params: int = int(1e9),
         assert sw is not None
         grads = [np.full(l.numel, 0.01, np.float32) for l in opt.leaves]
 
-        # windowed (production) sweep — warm once so file cache state is
-        # comparable between the two timed sweeps
-        opt.step(grads, lr=lr)
-        t0 = time.perf_counter()
-        opt.step(grads, lr=lr)
-        windowed_s = time.perf_counter() - t0
+        # first-touch the window buffers (aligned_empty is uninitialized)
+        # without a full warm sweep: a throttled cloud disk has a finite
+        # burst budget and a 2x-traffic warm step starves the timed trials
+        for slot in sw.slots:
+            slot[:] = 0.0
 
-        # synchronous comparator over the same files: read leaf i, step
-        # leaf i, write leaf i, nothing in flight
-        opt.step_count += 1
-        t0 = time.perf_counter()
-        for i, leaf in enumerate(opt.leaves):
-            master, m, v = sw.read_sync(i, leaf.numel)
-            opt._step_arrays(leaf, master, m, v, grads[i], lr, None)
-            sw.write_sync(i, leaf.numel)
-        sync_s = time.perf_counter() - t0
+        def sync_sweep():
+            opt.step_count += 1
+            phases = [0.0, 0.0, 0.0]
+            t0 = time.perf_counter()
+            for i, leaf in enumerate(opt.leaves):
+                t = time.perf_counter()
+                master, m, v = sw.read_sync(i, leaf.numel)
+                phases[0] += time.perf_counter() - t
+                t = time.perf_counter()
+                opt._step_arrays(leaf, master, m, v, grads[i], lr, None)
+                phases[1] += time.perf_counter() - t
+                t = time.perf_counter()
+                sw.write_sync(i, leaf.numel)
+                phases[2] += time.perf_counter() - t
+            return time.perf_counter() - t0, phases
 
+        sync_ts, windowed_ts, all_phases = [], [], []
+        for _ in range(max(1, reps)):
+            s, phases = sync_sweep()
+            sync_ts.append(s)
+            all_phases.append(phases)
+            t0 = time.perf_counter()
+            opt.step(grads, lr=lr)
+            windowed_ts.append(time.perf_counter() - t0)
+
+        med = lambda xs: float(np.median(xs))
+        sync_s, windowed_s = med(sync_ts), med(windowed_ts)
+        read_s, compute_s, write_s = (med([p[i] for p in all_phases])
+                                      for i in range(3))
+        io_bound = (read_s + write_s) / max(compute_s, 1e-9)
         io_bytes = 2 * 12 * sum(l.numel for l in opt.leaves)  # r+w, 3xfp32
         return {
             "params": int(sum(l.numel for l in opt.leaves)),
             "leaves": num_leaves,
             "prefetch_depth": sw.prefetch_depth,
+            "reps": max(1, reps),
             "windowed_s": round(windowed_s, 3),
             "sync_s": round(sync_s, 3),
+            "windowed_trials_s": [round(x, 3) for x in windowed_ts],
+            "sync_trials_s": [round(x, 3) for x in sync_ts],
+            "sync_read_s": round(read_s, 3),
+            "sync_compute_s": round(compute_s, 3),
+            "sync_write_s": round(write_s, 3),
+            "io_bound_ratio": round(io_bound, 2),
+            # what hiding compute alone buys at this io:compute ratio;
+            # measured ratios above it mean the pipeline is also duplexing
+            # read and write streams on top of hiding compute
+            "compute_hiding_bound": round(1.0 + 1.0 / max(io_bound, 1e-9), 3),
             "overlap_ratio": round(sync_s / windowed_s, 3),
             "windowed_io_gbps": round(io_bytes / windowed_s / 1e9, 2),
             "native_adam": bool(opt.native),
@@ -84,10 +122,11 @@ def main(argv=None):
     ap.add_argument("--params", type=float, default=1e9)
     ap.add_argument("--leaves", type=int, default=32)
     ap.add_argument("--path", default=tempfile.gettempdir())
-    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args(argv)
     r = measure_nvme_overlap(args.path, int(args.params), args.leaves,
-                             args.depth)
+                             args.depth, reps=args.reps)
     print(json.dumps(r))
     return r
 

@@ -228,14 +228,25 @@ class NVMeLeafSwapper:
     ``num_slots`` buffers of 3x the largest leaf: slot count = 1 (the leaf
     being stepped) + the prefetch depth derived from
     ``stage3_prefetch_bucket_size`` (reference zero/config.py — how far
-    ahead, in elements, the coordinator may stage). Each slot owns its own
+    ahead, in elements, the coordinator may stage) + 1 draining slot so the
+    three-way overlap read(i+depth) ∥ step(i) ∥ write(i-1) never stalls:
+    without the extra slot, the slot a new read claims is the one whose
+    write was issued just ONE iteration earlier, serializing every read
+    behind the previous leaf's write-back (measured 0.96x vs the sync
+    sweep; with it the pipeline genuinely duplexes). Each slot owns its own
     read/write aio handle so waiting for leaf i's data never blocks on the
     deeper prefetches still in flight."""
 
     @staticmethod
+    def slot_count(depth: int) -> int:
+        """Buffers allocated for a given prefetch depth (shared with the
+        Infinity capacity planner, autotuning/memory.py)."""
+        return depth + 2
+
+    @staticmethod
     def window_depth(max_numel: int, prefetch_numel: int = 0) -> int:
         """Prefetch depth for a given budget: how many leaves ride ahead of
-        the one being stepped (1 when no budget; capped at 7 = 8 slots).
+        the one being stepped (1 when no budget; capped at 7 = 9 slots).
         Shared with the Infinity capacity planner (autotuning/memory.py) so
         planned DRAM windows match what this class actually allocates."""
         if not prefetch_numel:
@@ -259,8 +270,9 @@ class NVMeLeafSwapper:
             log_dist(
                 f"stage3_prefetch_bucket_size={prefetch_numel:,} asks for a "
                 f"deeper window than the 7-leaf cap; clamping (DRAM bound: "
-                f"8 buffers of the largest leaf)", ranks=[0])
-        self.num_slots = 1 + depth
+                f"9 buffers of the largest leaf)", ranks=[0])
+        self._depth = depth
+        self.num_slots = self.slot_count(depth)
         # one op in flight per handle -> a single IO thread each (the
         # window, not the thread count, is what the budget sizes)
         self.read_handles = [AsyncIOHandle(block_size=bs, queue_depth=qd,
@@ -283,7 +295,7 @@ class NVMeLeafSwapper:
 
     @property
     def prefetch_depth(self) -> int:
-        return self.num_slots - 1
+        return self._depth
 
     def _file(self, idx: int) -> str:
         return os.path.join(self.dir, f"leaf_{idx}.bin")

@@ -80,16 +80,11 @@ def test_nvme_swap_overlap(tmp_path, total_params):
     (The driver-run bench measures the ~1B-param point via
     ``python -m deepspeed_tpu.benchmarks.nvme_overlap``.)"""
     from deepspeed_tpu.benchmarks.nvme_overlap import measure_nvme_overlap
-    # shared-disk timing: take the best of three attempts before judging
-    best = None
-    for _ in range(3):
-        r = measure_nvme_overlap(str(tmp_path), total_params=total_params,
-                                 num_leaves=16, prefetch_depth=2)
-        print(f"\nnvme overlap: {r}")
-        best = r if best is None or r["overlap_ratio"] > best["overlap_ratio"] \
-            else best
-        if best["overlap_ratio"] > 0.9:
-            break
+    # shared-disk timing noise is handled INSIDE measure_nvme_overlap now
+    # (interleaved pairs + median), so one call suffices
+    best = measure_nvme_overlap(str(tmp_path), total_params=total_params,
+                                num_leaves=16, prefetch_depth=2, reps=2)
+    print(f"\nnvme overlap: {best}")
     assert best["params"] == total_params
     assert best["prefetch_depth"] == 2
     # correctness smoke bound only: windowed must not lose CATASTROPHICALLY
