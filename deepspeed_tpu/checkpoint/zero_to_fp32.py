@@ -31,6 +31,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 
 import numpy as np
@@ -56,10 +57,19 @@ def _from_npz(tag_dir):
         return {k: f[k].astype(np.float32) for k in f.files}
 
 
+def _shard_index(path):
+    """Numeric pN suffix, so shard 10 sorts after shard 2 (lexicographic
+    glob order would interleave them; harmless while host slices are
+    disjoint, but merge order should be deterministic by rank regardless)."""
+    m = re.search(r"_p(\d+)\.json$", path)
+    return int(m.group(1)) if m else 1 << 30
+
+
 def _from_host_shards(tag_dir):
     metas = []
     for jpath in sorted(glob.glob(
-            os.path.join(tag_dir, "zero_host_shard_p*.json"))):
+            os.path.join(tag_dir, "zero_host_shard_p*.json")),
+            key=_shard_index):
         with open(jpath) as fh:
             m = json.load(fh)
         m["_npz"] = jpath[:-5] + ".npz"

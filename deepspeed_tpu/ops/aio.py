@@ -44,10 +44,36 @@ def aligned_empty(n: int, dtype=np.float32) -> np.ndarray:
     return view
 
 
-def _is_direct_ok(array: np.ndarray, nbytes: int, offset: int) -> bool:
-    return (array.ctypes.data % DIRECT_ALIGN == 0
-            and nbytes % DIRECT_ALIGN == 0
-            and offset % DIRECT_ALIGN == 0)
+def _check_direct(array: np.ndarray, nbytes: int, offset: int) -> None:
+    """ValueError (not assert: ``python -O`` must not disable this) when a
+    direct-I/O request isn't fully DIRECT_ALIGN-aligned."""
+    if (array.ctypes.data % DIRECT_ALIGN != 0
+            or nbytes % DIRECT_ALIGN != 0
+            or offset % DIRECT_ALIGN != 0):
+        raise ValueError(
+            f"direct I/O requires DIRECT_ALIGN({DIRECT_ALIGN})-aligned "
+            f"buffer/len/offset; got data%align="
+            f"{array.ctypes.data % DIRECT_ALIGN}, "
+            f"len%align={nbytes % DIRECT_ALIGN}, "
+            f"off%align={offset % DIRECT_ALIGN}")
+
+
+_warned_direct_fallback = False
+
+
+def _warn_direct_fallback() -> None:
+    """``direct=True`` without the native engine degrades to buffered
+    Python I/O — exactly the page-cache behavior O_DIRECT exists to avoid.
+    Warn once, loudly, instead of silently re-enabling it."""
+    global _warned_direct_fallback
+    if not _warned_direct_fallback:
+        _warned_direct_fallback = True
+        import warnings
+        warnings.warn(
+            "AsyncIOHandle: direct=True requested but the native aio "
+            "engine is unavailable; falling back to BUFFERED I/O (page "
+            "cache will absorb all swap traffic). Build csrc/aio.cpp for "
+            "O_DIRECT behavior.", RuntimeWarning, stacklevel=3)
 
 
 class AsyncIOHandle:
@@ -82,13 +108,12 @@ class AsyncIOHandle:
         """``direct=True`` bypasses the page cache (O_DIRECT; the reference
         aio engine always runs this way): the caller must pass an
         ``aligned_empty`` buffer sliced to a ``padded_nbytes`` length and an
-        aligned offset — asserted, because silent fallback would re-enable
+        aligned offset — enforced with ValueError, because silent fallback would re-enable
         cache pollution at Infinity scale without anyone noticing."""
         array = np.ascontiguousarray(array)
         if self._handle is not None:
             if direct:
-                assert _is_direct_ok(array, array.nbytes, offset), \
-                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+                _check_direct(array, array.nbytes, offset)
             fd = self._lib.aio_open(path.encode(), 1, 1 if direct else 0)
             if fd < 0:
                 raise OSError(f"aio_open failed for {path}")
@@ -99,6 +124,8 @@ class AsyncIOHandle:
             self._keepalive = getattr(self, "_keepalive", [])
             self._keepalive.append(array)
         else:
+            if direct:
+                _warn_direct_fallback()
             self._pending_py.append(("w", array, path, offset))
         return 1
 
@@ -107,8 +134,7 @@ class AsyncIOHandle:
         assert array.flags["C_CONTIGUOUS"]
         if self._handle is not None:
             if direct:
-                assert _is_direct_ok(array, array.nbytes, offset), \
-                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+                _check_direct(array, array.nbytes, offset)
             fd = self._lib.aio_open(path.encode(), 0, 1 if direct else 0)
             if fd < 0:
                 raise OSError(f"aio_open failed for {path}")
@@ -117,6 +143,8 @@ class AsyncIOHandle:
                                 array.ctypes.data_as(ctypes.c_void_p),
                                 array.nbytes, offset)
         else:
+            if direct:
+                _warn_direct_fallback()
             self._pending_py.append(("r", array, path, offset))
         return 1
 
@@ -145,8 +173,7 @@ class AsyncIOHandle:
         array = np.ascontiguousarray(array)
         if self._lib is not None:
             if direct:
-                assert _is_direct_ok(array, array.nbytes, offset), \
-                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+                _check_direct(array, array.nbytes, offset)
             fd = self._lib.aio_open(path.encode(), 1, 1 if direct else 0)
             try:
                 rc = self._lib.aio_sync_pwrite(
@@ -157,6 +184,8 @@ class AsyncIOHandle:
             if rc != array.nbytes:
                 raise OSError(f"short write to {path}: {rc}")
             return rc
+        if direct:
+            _warn_direct_fallback()
         with open(path, "r+b" if os.path.exists(path) else "wb") as f:
             f.seek(offset)
             f.write(array.tobytes())
@@ -167,8 +196,7 @@ class AsyncIOHandle:
         assert array.flags["C_CONTIGUOUS"]
         if self._lib is not None:
             if direct:
-                assert _is_direct_ok(array, array.nbytes, offset), \
-                    "direct I/O requires DIRECT_ALIGN-aligned buffer/len/off"
+                _check_direct(array, array.nbytes, offset)
             fd = self._lib.aio_open(path.encode(), 0, 1 if direct else 0)
             try:
                 rc = self._lib.aio_sync_pread(
@@ -179,6 +207,8 @@ class AsyncIOHandle:
             if rc != array.nbytes:
                 raise OSError(f"short read from {path}: {rc}")
             return rc
+        if direct:
+            _warn_direct_fallback()
         with open(path, "rb") as f:
             f.seek(offset)
             data = f.read(array.nbytes)

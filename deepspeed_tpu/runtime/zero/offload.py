@@ -541,9 +541,15 @@ class HostOffloadOptimizer:
         the writing world used — ranges are clamped there and intersected."""
         import glob as _glob
         import json as _json
+        from ...checkpoint.zero_to_fp32 import _shard_index
         metas = []
-        for jpath in sorted(_glob.glob(
-                os.path.join(ckpt_dir, "zero_host_shard_p*.json"))):
+        # numeric rank order (p10 after p2): ranges are intersected so any
+        # order yields the same result today, but merges stay deterministic
+        # if shard layouts ever overlap
+        for jpath in sorted(
+                _glob.glob(os.path.join(ckpt_dir,
+                                        "zero_host_shard_p*.json")),
+                key=_shard_index):
             with open(jpath) as fh:
                 m = _json.load(fh)
             m["_npz"] = jpath[:-5] + ".npz"

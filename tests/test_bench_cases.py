@@ -31,6 +31,7 @@ def _case(name, timeout=420):
     ("capacity_streamed", "capacity_streamed_params_B"),
     ("long_context", "long_context_"),
     ("max_params", "max_params_per_chip_B"),
+    ("nvme_overlap", "nvme_swap_overlap_ratio"),
 ])
 def test_bench_case_produces_metric(name, metric_prefix):
     obj = _case(name)

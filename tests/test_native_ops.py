@@ -198,5 +198,6 @@ def test_aio_direct_rejects_misaligned(tmp_path):
     if not h.native:
         pytest.skip("native aio unavailable")
     bad = np.empty(1000, np.float32)              # unpadded length
-    with pytest.raises(AssertionError):
+    # ValueError, not assert: `python -O` must not disable the guard
+    with pytest.raises(ValueError, match="DIRECT_ALIGN"):
         h.sync_pwrite(bad, str(tmp_path / "x.bin"), direct=True)
