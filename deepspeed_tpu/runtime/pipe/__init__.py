@@ -1,0 +1,13 @@
+"""Pipeline parallelism (reference analogue: ``deepspeed/pipe`` +
+``deepspeed/runtime/pipe``).
+
+Two engines, by controller model:
+  * ``PipelineEngine`` (engine.py) — single-controller 1F1B over per-stage
+    sub-meshes; composes with dp/ZeRO-1/2/ep/tp/sp on one host.
+  * ``GPipeSpmdEngine`` (spmd.py) — the whole pipeline as ONE SPMD program
+    over a global (pp, dp) mesh; pp crosses hosts like dp/tp do.
+"""
+
+from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .spmd import (GPipeSpmdEngine, StackedPipeSpec,  # noqa: F401
+                   gpt_pipe_spec)

@@ -85,13 +85,14 @@ class PipelineEngine:
         comm.init_distributed()
         if jax.process_count() > 1:
             raise NotImplementedError(
-                "the pipeline engine is single-controller: one host drives "
-                "every stage's sub-mesh programs (runtime/pipe/engine.py "
-                "design note). Multi-process pipelines would need per-rank "
-                "instruction loops (the reference's model, pipe/engine.py:"
-                "1346); on multi-host TPU slices use dp/tp/sp/ep sharding "
-                "from a single controller instead — failing loudly here "
-                "beats an undefined multi-controller dispatch")
+                "this 1F1B engine is single-controller: one host drives "
+                "every stage's sub-mesh programs. For pipeline parallelism "
+                "ACROSS hosts use runtime.pipe.spmd.GPipeSpmdEngine — the "
+                "whole pipeline as one SPMD program over a global (pp, dp) "
+                "mesh (stacked stage params + ppermute activation hops), "
+                "which every process runs identically, the same way "
+                "dp/tp/sp cross hosts (proven by "
+                "tests/test_multiprocess_pipe.py)")
         self.module = model
         self.num_stages = model.num_stages
         pre = DeepSpeedConfig(config, dp_world_size=1)

@@ -1,0 +1,320 @@
+"""Multi-host pipeline parallelism: one SPMD program over a (pp, dp) mesh.
+
+The reference pipeline spans nodes with per-rank instruction loops and
+NCCL p2p (``deepspeed/runtime/pipe/engine.py:1346`` exec schedule,
+``pipe/p2p.py:21-86`` send/recv) — a multi-controller design. The
+TPU-native shape of the same capability is a SINGLE jitted program every
+process runs: the scanned transformer stack's ``[L, ...]`` parameters
+reshape to ``[S, L/S, ...]`` and shard over the mesh's ``pp`` axis, a
+``lax.scan`` over ``M + S - 1`` ticks moves microbatch activations from
+stage to stage with ``lax.ppermute``, and ``jax.grad`` through the scan
+derives the reverse pipeline automatically (the GPipe schedule). Because
+it is plain SPMD over a global mesh, pp crosses hosts exactly the way
+dp/tp/sp already do — XLA collectives over ICI/DCN, no bespoke p2p layer,
+no single-controller restriction (cf. ``runtime/pipe/engine.py``'s
+per-stage sub-mesh design, which remains the 1F1B single-host engine).
+
+Bubble: (S-1)/(M+S-1) of tick-compute is warm-up/drain, the GPipe ratio.
+Memory: activations for all M microbatches live across the fwd->bwd span;
+``remat`` on the stage body keeps that to one carry per microbatch-stage.
+
+The engine is model-agnostic through ``StackedPipeSpec`` (prefix / block /
+suffix callables over a stacked block-parameter tree); ``gpt_pipe_spec``
+adapts ``models/gpt.py`` (scan_layers=True) to it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...utils.logging import log_dist
+
+
+@dataclasses.dataclass(frozen=True)
+class StackedPipeSpec:
+    """A model, factored for SPMD pipelining.
+
+    prefix(params, input_ids) -> x            embedding / preamble [B, T, D]
+    block(block_params, x, positions) -> x    ONE layer from the stacked
+                                              tree (leaves carry a leading
+                                              layer axis; ``block`` receives
+                                              one layer's slice)
+    suffix_loss(params, x, batch) -> loss     final norm / head / loss
+    blocks_key                                key of the stacked block tree
+                                              inside ``params``
+    num_layers                                total stacked layers L
+    """
+    prefix: Callable[[Dict, jnp.ndarray], jnp.ndarray]
+    block: Callable[[Dict, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+    suffix_loss: Callable[[Dict, jnp.ndarray, Dict], jnp.ndarray]
+    blocks_key: str
+    num_layers: int
+
+
+def gpt_pipe_spec(cfg) -> StackedPipeSpec:
+    """Adapt ``models/gpt.py`` (scan_layers=True params layout) to the
+    stacked-pipe interface. Requires the dense scanned configuration (the
+    same constraint the reference puts on pipelined GPT: uniform
+    transformer layers partitioned over stages, pipe/module.py)."""
+    import flax.linen as nn
+    from ...models.gpt import Block
+
+    if not cfg.scan_layers:
+        raise ValueError("gpt_pipe_spec needs scan_layers=True (stacked "
+                         "[L, ...] block params)")
+    if cfg.partition_activations or cfg.sequence_parallel:
+        raise ValueError("tp/sp sharding constraints inside the pp "
+                         "shard_map region are not supported; disable "
+                         "partition_activations/sequence_parallel for the "
+                         "SPMD pipeline")
+    if cfg.dropout:
+        raise ValueError("the SPMD pipeline block runs deterministic "
+                         "(no dropout rng plumbing through the tick scan "
+                         "yet); train with dropout=0.0 or use the 1F1B "
+                         "engine — silently disabling dropout would "
+                         "change training semantics")
+    if cfg.moe:
+        raise ValueError("MoE blocks return a load-balancing aux loss the "
+                         "tick scan does not carry yet; an SPMD pipeline "
+                         "that silently dropped it would collapse the "
+                         "router — use the 1F1B engine's pp x ep path")
+
+    def prefix(params, input_ids):
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        x = emb.apply({"params": params["wte"]}, input_ids)
+        if not cfg.rotary:
+            pos = jnp.arange(input_ids.shape[1])
+            x = x + params["wpe"][pos][None].astype(cfg.dtype)
+        return x
+
+    block_mod = Block(cfg)
+
+    def block(p, x, positions):
+        y, _aux = block_mod.apply({"params": p}, x, positions, True)
+        return y
+
+    def suffix_loss(params, x, batch):
+        from ...models.gpt import lm_loss_fn
+        ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype)
+        x = ln.apply({"params": params["ln_f"]}, x)
+        if cfg.tie_embeddings:
+            wte = params["wte"]["embedding"]
+            logits = x @ wte.astype(cfg.dtype).T
+        else:
+            logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+        return lm_loss_fn(logits, batch)
+
+    return StackedPipeSpec(prefix=prefix, block=block,
+                           suffix_loss=suffix_loss, blocks_key="blocks",
+                           num_layers=cfg.num_layers)
+
+
+def _stage_restack(tree, num_stages: int):
+    """[L, ...] stacked leaves -> [S, L/S, ...]."""
+    def re(leaf):
+        L = leaf.shape[0]
+        if L % num_stages:
+            raise ValueError(
+                f"stacked layer count {L} not divisible by pp={num_stages}")
+        return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
+    return jax.tree.map(re, tree)
+
+
+def _stage_unstack(tree):
+    return jax.tree.map(
+        lambda l: l.reshape((l.shape[0] * l.shape[1],) + l.shape[2:]), tree)
+
+
+class GPipeSpmdEngine:
+    """Pipeline training engine as one SPMD program (multi-host capable).
+
+    ``params`` is the plain model param tree (stacked blocks under
+    ``spec.blocks_key``). The engine reshapes blocks to [S, L/S, ...],
+    shards them over ``pp``, keeps everything else replicated, and runs
+    AdamW on an fp32 master with grads averaged over dp by GSPMD.
+    """
+
+    def __init__(self, spec: StackedPipeSpec, params, *, num_stages: int,
+                 micro_batches: int, dp: int = 1, lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, remat: bool = True,
+                 mesh: Optional[Mesh] = None):
+        if micro_batches < 1:
+            raise ValueError("micro_batches must be >= 1")
+        self.spec = spec
+        self.num_stages = int(num_stages)
+        self.micro_batches = int(micro_batches)
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay = weight_decay
+        self.remat = remat
+        if mesh is None:
+            devs = np.asarray(jax.devices()[:num_stages * dp]).reshape(
+                num_stages, dp)
+            mesh = Mesh(devs, ("pp", "dp"))
+        self.mesh = mesh
+
+        params = jax.tree.map(jnp.asarray, params)
+        blocks = _stage_restack(params[spec.blocks_key], self.num_stages)
+        rest = {k: v for k, v in params.items() if k != spec.blocks_key}
+        stage_sh = NamedSharding(self.mesh, P("pp"))
+        self._repl_sh = repl_sh = NamedSharding(self.mesh, P())
+        blocks = jax.device_put(blocks, stage_sh)
+        rest = jax.device_put(rest, repl_sh)
+        # compute dtypes are all the engine needs past init — keeping the
+        # full compute-dtype copies would pin an extra half-model of HBM
+        self._blocks_dtype = jax.tree.map(lambda l: l.dtype, blocks)
+        self._rest_dtype = jax.tree.map(lambda l: l.dtype, rest)
+        # fp32 master + moments, sharded like their params (pp for blocks).
+        # Materialized through jit: outputs never alias inputs, so donating
+        # the master each step can never delete the caller's param tree
+        # (astype/device_put no-op aliasing would)
+        f32 = lambda t, sh: jax.jit(
+            lambda x: jax.tree.map(lambda l: l.astype(jnp.float32), x),
+            out_shardings=jax.tree.map(lambda _: sh, t))(t)
+        self.master = {"blocks": f32(blocks, stage_sh),
+                       "rest": f32(rest, repl_sh)}
+        del blocks, rest
+        # the runtime's fused AdamW (ops/adam.py): mu/nu inherit each
+        # master leaf's sharding, so blocks' optimizer state is pp-sharded
+        from ...ops.adam import fused_adam
+        self._tx = fused_adam(learning_rate=lr, betas=betas, eps=eps,
+                              weight_decay=weight_decay, adam_w_mode=True)
+        self.opt_state = self._tx.init(self.master)
+        self.opt_state = self.opt_state._replace(
+            count=jax.device_put(self.opt_state.count, repl_sh))
+        self.step_count = 0
+        self._jit_step = None
+        log_dist(
+            f"SPMD pipeline: {spec.num_layers} layers over "
+            f"{self.num_stages} stages x dp={self.mesh.shape['dp']} "
+            f"({jax.process_count()} process(es)), GPipe "
+            f"M={self.micro_batches}, bubble="
+            f"{(self.num_stages - 1) / (self.micro_batches + self.num_stages - 1):.2f}",
+            ranks=[0])
+
+    # ------------------------------------------------------------ forward
+    def _trunk(self, blocks_local, xs_local):
+        """Per-device GPipe tick loop (inside shard_map over (pp, dp)).
+
+        blocks_local: this stage's [1, L/S, ...] slice; xs_local: all M
+        microbatch embeddings [M, mb/dp, T, D] (replicated over pp)."""
+        S, M = self.num_stages, self.micro_batches
+        blocks_local = jax.tree.map(lambda l: l[0], blocks_local)
+        stage = jax.lax.axis_index("pp")
+        positions = jnp.arange(xs_local.shape[2])[None, :].repeat(
+            xs_local.shape[1], axis=0)
+
+        def stage_fwd(x):
+            def body(c, layer_p):
+                return self.spec.block(layer_p, c, positions), None
+            if self.remat:
+                body = jax.checkpoint(body, prevent_cse=False)
+            y, _ = jax.lax.scan(body, x, blocks_local)
+            return y
+
+        def tick(y_prev, t):
+            # stage s receives stage s-1's previous-tick output (cyclic:
+            # stage 0 gets S-1's, masked out below)
+            x_in = jax.lax.ppermute(
+                y_prev, "pp", [(i, (i + 1) % S) for i in range(S)])
+            idx = t - stage                       # microbatch at this stage
+            safe = jnp.clip(idx, 0, M - 1)
+            x0 = jax.lax.dynamic_index_in_dim(xs_local, safe, 0,
+                                              keepdims=False)
+            x_st = jnp.where(stage == 0, x0, x_in)
+            y = stage_fwd(x_st)
+            # y doubles as next carry AND stacked per-tick output: stage
+            # S-1 finishes microbatch m exactly at tick m + S - 1, so the
+            # valid outputs are ys[S-1:] in order — no [M, ...] carry (a
+            # dynamic_update carry would copy O(M) per tick, O(M^2) total)
+            return y, y
+
+        # the carry varies per stage from tick 1 on; mark the (zero) init
+        # as pp-varying so scan's carry type is stable
+        init = jax.lax.pcast(jnp.zeros_like(xs_local[0]), ("pp",),
+                             to="varying")
+        _, ys = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
+        outs = ys[S - 1:]
+        # broadcast the last stage's outputs to every stage so the suffix
+        # runs replicated over pp (one D-wide hop per step; params dwarf it)
+        outs = jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), "pp")
+        return outs
+
+    def _loss(self, blocks, rest, ids3):
+        """ids3: [M, mb_global, T]."""
+        M, mbg, T = ids3.shape
+        params = dict(rest)
+        params[self.spec.blocks_key] = blocks  # stacked [S, L/S, ...]
+        ids = ids3.reshape(M * mbg, T)
+        x = self.spec.prefix(params, ids)
+        xs = x.reshape(M, mbg, T, x.shape[-1])
+        outs = shard_map(
+            self._trunk, mesh=self.mesh,
+            in_specs=(P("pp"), P(None, "dp")),
+            out_specs=P(None, "dp"))(blocks, xs)
+        h = outs.reshape(M * mbg, T, outs.shape[-1])
+        return self.spec.suffix_loss(params, h, {"input_ids": ids})
+
+    # ------------------------------------------------------------- update
+    def _cast(self, tree, dtypes):
+        return jax.tree.map(lambda l, d: l.astype(d), tree, dtypes)
+
+    def _build_step(self):
+        import optax
+
+        def step(master, opt_state, ids3):
+            loss, grads = jax.value_and_grad(self._loss, argnums=(0, 1))(
+                self._cast(master["blocks"], self._blocks_dtype),
+                self._cast(master["rest"], self._rest_dtype), ids3)
+            gb, gr = grads
+            updates, new_state = self._tx.update(
+                {"blocks": gb, "rest": gr}, opt_state, master)
+            return loss, optax.apply_updates(master, updates), new_state
+
+        sh_of = lambda t: jax.tree.map(lambda a: a.sharding, t)
+        return jax.jit(
+            step,
+            in_shardings=(sh_of(self.master), sh_of(self.opt_state),
+                          NamedSharding(self.mesh, P(None, "dp"))),
+            out_shardings=(None, sh_of(self.master),
+                           sh_of(self.opt_state)),
+            donate_argnums=(0, 1))
+
+    # ---------------------------------------------------------------- API
+    def train_batch(self, data_iter: Iterator[Any]):
+        """Consume ``micro_batches`` microbatches ({"input_ids": [mb, T]})
+        and run one pipelined optimizer step. Returns the scalar loss."""
+        mbs = [next(data_iter) for _ in range(self.micro_batches)]
+        ids3 = jnp.stack([jnp.asarray(b["input_ids"]) for b in mbs])
+        ids3 = jax.device_put(
+            ids3, NamedSharding(self.mesh, P(None, "dp")))
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        self.step_count += 1
+        loss, self.master, self.opt_state = self._jit_step(
+            self.master, self.opt_state, ids3)
+        return loss
+
+    def eval_loss(self, ids3) -> jnp.ndarray:
+        """Pipelined forward + loss only (no update)."""
+        return self._loss(
+            self._cast(self.master["blocks"], self._blocks_dtype),
+            self._cast(self.master["rest"], self._rest_dtype),
+            jnp.asarray(ids3))
+
+    def params_tree(self):
+        """Current weights as the plain (unstacked) model tree."""
+        params = dict(self.master["rest"])
+        params[self.spec.blocks_key] = _stage_unstack(
+            self.master["blocks"])
+        return params

@@ -41,7 +41,7 @@ _SLOW_MODULES = {
     "test_moe", "test_bert_and_autotp", "test_bert_sparse",
     "test_features", "test_zero_init", "test_engine", "test_gpt_model",
     "test_zero", "test_launcher", "test_175b_plan", "test_pipe_overlap",
-    "test_layer_stream", "test_bench_cases",
+    "test_layer_stream", "test_bench_cases", "test_multiprocess_pipe",
 }
 
 

@@ -1,0 +1,36 @@
+"""Shared 2-process launch harness for the real multi-process tests
+(reference tests/unit/common.py:67 — forked workers stand in for a
+cluster). One home for the launcher env contract (COORDINATOR_ADDRESS /
+NUM_PROCESSES / PROCESS_ID / LOCAL_RANK — the variables
+launcher/launch.py writes), so worker scripts and tests can't drift."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def launch_workers(script: str, n: int = 2, port: int = 29765,
+                   timeout: int = 420):
+    """Run ``tests/<script>`` as n coordinated processes; returns
+    [(returncode, combined_output), ...] in process order."""
+    procs = []
+    for pid in range(n):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NUM_PROCESSES"] = str(n)
+        env["PROCESS_ID"] = str(pid)
+        env["LOCAL_RANK"] = "0"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", script)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append((p.returncode, out))
+    return outs

@@ -1,0 +1,56 @@
+"""Worker body for the 2-process PIPELINE test: pp=2 spans the two
+processes (stage 0 on process 0's devices, stage 1 on process 1's), dp=4
+within each stage. Launched by test_multiprocess_pipe.py with the launcher
+env contract — the reference's pipeline crosses nodes the same way
+(deepspeed/runtime/pipe/p2p.py over NCCL; here ppermute over the
+distributed CPU backend)."""
+
+import json
+import os
+import sys
+
+
+def main():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.comm import comm as dist
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    from deepspeed_tpu.runtime.pipe.spmd import (GPipeSpmdEngine,
+                                                 gpt_pipe_spec)
+
+    dist.init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8
+
+    cfg = GPTConfig(num_layers=4, num_heads=2, d_model=32, d_ff=64,
+                    vocab_size=128, max_seq_len=16, dtype=jnp.float32,
+                    param_dtype=jnp.float32, remat=False)
+    model = GPT(cfg)
+    ids = np.random.default_rng(3).integers(0, 128, (8, 16)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(ids[:1]))["params"]
+
+    eng = GPipeSpmdEngine(gpt_pipe_spec(cfg), params, num_stages=2,
+                          micro_batches=2, dp=4, lr=1e-3, remat=False)
+    # stage 0 must live entirely on process 0, stage 1 on process 1 — i.e.
+    # the pp axis really crosses the host boundary
+    mesh_devs = np.asarray(eng.mesh.devices)
+    stage_procs = [{d.process_index for d in row} for row in mesh_devs]
+    assert stage_procs[0] == {0} and stage_procs[1] == {1}, stage_procs
+
+    losses = []
+    for _ in range(3):
+        loss = eng.train_batch(iter([{"input_ids": ids[:4]},
+                                     {"input_ids": ids[4:]}]))
+        losses.append(float(jax.device_get(loss)))
+    report = {"process": jax.process_index(), "losses": losses}
+    print("REPORT " + json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,37 +4,15 @@ devices form one 8-device world over Gloo)."""
 
 import json
 import os
-import subprocess
 import sys
 
 import numpy as np
-import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from mp_harness import REPO, launch_workers
 
 
 def _launch_workers(n=2, port=29765):
-    procs = []
-    for pid in range(n):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.pop("XLA_FLAGS", None)
-        # the launcher env contract (launcher/launch.py writes these)
-        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-        env["NUM_PROCESSES"] = str(n)
-        env["PROCESS_ID"] = str(pid)
-        env["LOCAL_RANK"] = "0"
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests",
-                                          "multiproc_worker.py")],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=420)
-        outs.append((p.returncode, out))
-    return outs
+    return launch_workers("multiproc_worker.py", n=n, port=port)
 
 
 def test_two_process_engine_matches_single_process():
