@@ -303,17 +303,19 @@ class DeepSpeedEngine:
                 "activation_checkpointing.profile is not wired; use "
                 "wall_clock_breakdown or the flops_profiler block for "
                 "per-phase timing")
-        model_cfg_ckpt = bool(getattr(getattr(module, "cfg", None),
-                                      "cpu_checkpointing", False))
-        if (ac.cpu_checkpointing or model_cfg_ckpt) and self.mesh.size > 1:
-            raise ValueError(
-                "cpu_checkpointing on a multi-chip mesh: this XLA version's "
-                "SPMD partitioner rejects the memory-placement annotations "
-                "the host-offload remat policy emits on replicated "
-                "residuals (spmd_partitioner RET_CHECK on "
-                "annotate_device_placement). Use it single-chip, or use "
-                "partition_activations / remat_policy='nothing' to cut "
-                "activation HBM under SPMD")
+        # cpu_checkpointing now composes with multi-chip SPMD — with one
+        # compiler quirk: when jit is given explicit out_shardings, XLA's
+        # sharding propagation leaves the host-offload
+        # annotate_device_placement custom-calls unsharded and the SPMD
+        # partitioner RET_CHECKs ("Side-effect HLO must have sharding").
+        # The engine therefore records offload mode and its state-jits
+        # constrain outputs INSIDE the program (with_sharding_constraint)
+        # instead of via out_shardings (see _jit_state_step). Proven
+        # multi-mesh by tests/test_engine.py::test_cpu_checkpointing_multichip.
+        self._ckpt_offload = bool(
+            ac.cpu_checkpointing
+            or getattr(getattr(module, "cfg", None), "cpu_checkpointing",
+                       False))
         if not (ac.partition_activations or ac.cpu_checkpointing):
             return module
         import dataclasses as _dc
@@ -791,8 +793,27 @@ class DeepSpeedEngine:
             return new_state, {"loss": loss_sum / gas, "grad_norm": gnorm,
                                "finite": finite}
 
-        return jax.jit(train_step, donate_argnums=(0,),
-                       out_shardings=(self._state_shardings, None))
+        return self._jit_state_step(train_step)
+
+    def _jit_state_step(self, fn):
+        """jit a ``(state, ...) -> (new_state, aux)`` step with state
+        donation. Output shardings normally ride out_shardings; under
+        cpu_checkpointing they are constrained INSIDE the program instead —
+        explicit out_shardings flips XLA into a propagation mode that
+        leaves the host-offload placement custom-calls unsharded and the
+        SPMD partitioner rejects the module (RET_CHECK, spmd_partitioner
+        .cc: "Side-effect HLO must have sharding")."""
+        if not getattr(self, "_ckpt_offload", False):
+            return jax.jit(fn, donate_argnums=(0,),
+                           out_shardings=(self._state_shardings, None))
+
+        def constrained(state, *args, **kwargs):
+            new_state, aux = fn(state, *args, **kwargs)
+            new_state = jax.lax.with_sharding_constraint(
+                new_state, self._state_shardings)
+            return new_state, aux
+
+        return jax.jit(constrained, donate_argnums=(0,))
 
     def _forward_extras(self):
         """Traced per-step model kwargs (PLD theta etc.) — passed as jit
@@ -957,8 +978,7 @@ class DeepSpeedEngine:
                     state["master"], state["scale"].cur_scale, batch, sub)
                 acc = jax.tree.map(jnp.add, state["acc"], grads)
                 return dict(state, acc=acc, rng=rng), loss
-            self._jit_micro = jax.jit(micro, donate_argnums=(0,),
-                                      out_shardings=(self._state_shardings, None))
+            self._jit_micro = self._jit_state_step(micro)
         batch = self._shard_batch(batch)
         self.state, loss = self._jit_micro(self.state, batch)
         self._pending_loss = loss
@@ -987,8 +1007,7 @@ class DeepSpeedEngine:
                 new_state, gnorm, finite = self._apply_update(state, gas)
                 return new_state, {"grad_norm": gnorm, "finite": finite,
                                    "loss": jnp.zeros((), jnp.float32)}
-            self._jit_apply = jax.jit(apply_only, donate_argnums=(0,),
-                                      out_shardings=(self._state_shardings, None))
+            self._jit_apply = self._jit_state_step(apply_only)
         self.state, metrics = self._jit_apply(self.state)
         self.global_steps += 1
         self._last_grad_norm = metrics["grad_norm"]
@@ -1503,10 +1522,25 @@ class DeepSpeedEngine:
                                       "grad_norm": gnorm,
                                       "finite": finite}, params
 
-        return jax.jit(train_grads, donate_argnums=(0, 1),
-                       out_shardings=(self._off_state_shardings,
-                                      [self._flat_sh] * len(self._off_meta),
-                                      None, self.param_shardings))
+        out_sh = (self._off_state_shardings,
+                  [self._flat_sh] * len(self._off_meta),
+                  None, self.param_shardings)
+        if getattr(self, "_ckpt_offload", False):
+            # same XLA quirk as _jit_state_step: explicit out_shardings +
+            # host-offload placement custom-calls -> SPMD partitioner
+            # RET_CHECK; constrain inside the program instead
+            def constrained(state, params, *args, **kwargs):
+                new_state, flats, aux, out_params = train_grads(
+                    state, params, *args, **kwargs)
+                new_state = jax.lax.with_sharding_constraint(
+                    new_state, self._off_state_shardings)
+                flats = [jax.lax.with_sharding_constraint(f, self._flat_sh)
+                         for f in flats]
+                out_params = jax.lax.with_sharding_constraint(
+                    out_params, self.param_shardings)
+                return new_state, flats, aux, out_params
+            return jax.jit(constrained, donate_argnums=(0, 1))
+        return jax.jit(train_grads, donate_argnums=(0, 1), out_shardings=out_sh)
 
     def _host_update_scale(self, finite: bool):
         """Host mirror of fp16/loss_scaler.update_scale dynamics — same

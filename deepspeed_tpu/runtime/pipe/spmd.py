@@ -166,7 +166,7 @@ class GPipeSpmdEngine:
         blocks = _stage_restack(params[spec.blocks_key], self.num_stages)
         rest = {k: v for k, v in params.items() if k != spec.blocks_key}
         stage_sh = NamedSharding(self.mesh, P("pp"))
-        self._repl_sh = repl_sh = NamedSharding(self.mesh, P())
+        repl_sh = NamedSharding(self.mesh, P())
         blocks = jax.device_put(blocks, stage_sh)
         rest = jax.device_put(rest, repl_sh)
         # compute dtypes are all the engine needs past init — keeping the
@@ -193,6 +193,7 @@ class GPipeSpmdEngine:
             count=jax.device_put(self.opt_state.count, repl_sh))
         self.step_count = 0
         self._jit_step = None
+        self._jit_eval = None
         log_dist(
             f"SPMD pipeline: {spec.num_layers} layers over "
             f"{self.num_stages} stages x dp={self.mesh.shape['dp']} "
@@ -306,15 +307,24 @@ class GPipeSpmdEngine:
         return loss
 
     def eval_loss(self, ids3) -> jnp.ndarray:
-        """Pipelined forward + loss only (no update)."""
-        return self._loss(
-            self._cast(self.master["blocks"], self._blocks_dtype),
-            self._cast(self.master["rest"], self._rest_dtype),
-            jnp.asarray(ids3))
+        """Pipelined forward + loss only (no update). Jitted: eager
+        shard_map cannot execute over the pp-sharded master when stages
+        live on other processes (the engine's whole point)."""
+        if self._jit_eval is None:
+            def ev(master, ids3):
+                return self._loss(
+                    self._cast(master["blocks"], self._blocks_dtype),
+                    self._cast(master["rest"], self._rest_dtype), ids3)
+            self._jit_eval = jax.jit(ev)
+        ids3 = jax.device_put(jnp.asarray(ids3),
+                              NamedSharding(self.mesh, P(None, "dp")))
+        return self._jit_eval(self.master, ids3)
 
     def params_tree(self):
-        """Current weights as the plain (unstacked) model tree."""
-        params = dict(self.master["rest"])
-        params[self.spec.blocks_key] = _stage_unstack(
-            self.master["blocks"])
-        return params
+        """Current weights as the plain (unstacked) model tree, in the
+        caller's original param dtypes (the fp32 master stays internal)."""
+        return {
+            self.spec.blocks_key: _stage_unstack(
+                self._cast(self.master["blocks"], self._blocks_dtype)),
+            **self._cast(self.master["rest"], self._rest_dtype),
+        }

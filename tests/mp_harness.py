@@ -30,7 +30,15 @@ def launch_workers(script: str, n: int = 2, port: int = 29765,
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        outs.append((p.returncode, out))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out))
+    finally:
+        # a deadlocked worker must not outlive the test holding the
+        # coordinator port — later multi-process tests would hang too
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     return outs
