@@ -182,6 +182,13 @@ class BertForMaskedLM(nn.Module):
     """MLM head over the encoder (tied decoder on the word embedding)."""
     cfg: BertConfig
 
+    @nn.nowrap
+    def stacked_spec(self, loss_fn):
+        """prefix/block/suffix factoring for the structure-driving
+        runtimes (SPMD pipeline, layer-streamed capacity tier)."""
+        from ..runtime.pipe.spmd import bert_mlm_pipe_spec
+        return bert_mlm_pipe_spec(self.cfg, loss_fn)
+
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  deterministic=True):

@@ -504,6 +504,13 @@ class GPT(nn.Module):
     """Decoder-only LM. __call__(input_ids [B,S]) -> logits [B,S,V]."""
     cfg: GPTConfig
 
+    @nn.nowrap
+    def stacked_spec(self, loss_fn=None):
+        """prefix/block/suffix factoring for the structure-driving
+        runtimes (SPMD pipeline, layer-streamed capacity tier)."""
+        from ..runtime.pipe.spmd import gpt_pipe_spec
+        return gpt_pipe_spec(self.cfg, loss_fn)
+
     @nn.compact
     def __call__(self, input_ids, deterministic=True, positions=None,
                  pld_theta=None):

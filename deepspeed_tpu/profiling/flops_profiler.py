@@ -18,8 +18,12 @@ from ..utils.logging import log_dist
 
 
 def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
-    """Exact flops of jit(fn)(*args) per XLA cost analysis (None if the
-    backend does not report)."""
+    """Flops of jit(fn)(*args) per XLA cost analysis (None if the backend
+    does not report). CAVEAT: XLA counts a ``lax.scan`` body ONCE, not
+    trip-count times — for scanned-layer models this undercounts by ~L;
+    ``jaxpr_module_flops`` multiplies trip counts and agrees with XLA to
+    ~1% on unrolled graphs (tests/test_features.py profiler tests), so
+    prefer it for totals on scanned models."""
     try:
         compiled = jax.jit(fn).lower(*args, **kwargs).compile()
         ca = compiled.cost_analysis()
@@ -76,50 +80,137 @@ def profile_model_flops(apply_fn, *example_args) -> Dict[str, Any]:
 # params/MACs/latency per module, profiler.py:330-430)
 # ---------------------------------------------------------------------------
 
+def _dot_flops(eqn) -> float:
+    """2*batch*M*N*K for one dot_general (XLA's own accounting for dots)."""
+    import numpy as np
+    (contract_l, _contract_r), (batch_l, _batch_r) = \
+        eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval.shape, eqn.invars[1].aval.shape
+    batch = int(np.prod([lhs[i] for i in batch_l]) or 1)
+    k = int(np.prod([lhs[i] for i in contract_l]) or 1)
+    m = int(np.prod([d for i, d in enumerate(lhs)
+                     if i not in contract_l and i not in batch_l]) or 1)
+    n_free = [d for i, d in enumerate(rhs)
+              if i not in _contract_r and i not in _batch_r]
+    n = int(np.prod(n_free) or 1)
+    return 2.0 * batch * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    import numpy as np
+    out = eqn.outvars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape  # kernel [spatial..., in, out]
+    return 2.0 * float(np.prod(out)) * float(np.prod(rhs[:-1]))
+
+
+def jaxpr_module_flops(fn, *args, **kwargs) -> Dict[str, float]:
+    """Matmul/conv flops per flax module path, from the jaxpr.
+
+    The reference attributes per-op counts to modules via torch hooks
+    (profiler.py:17-430); hooks don't exist under jit, but the jaxpr
+    carries the same structure: flax wraps every module method in
+    jax.named_scope, so each dot_general/conv eqn's source name stack IS
+    its module path. Sub-jaxprs are walked recursively — scan bodies
+    multiply by trip count (that is what makes attention inside a scanned
+    block visible, which the old kernel-shape heuristic missed), remat /
+    pjit / custom-vjp bodies recurse transparently, cond takes its first
+    branch. Flops land on every prefix of the path, so parents aggregate
+    children."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    acc: Dict[str, float] = {}
+
+    def add(path_parts, flops):
+        for i in range(len(path_parts) + 1):
+            key = "/".join(path_parts[:i]) or "<root>"
+            acc[key] = acc.get(key, 0.0) + flops
+
+    def scope_parts(eqn):
+        parts = []
+        for frame in getattr(eqn.source_info.name_stack, "stack", ()):
+            name = getattr(frame, "name", None)
+            if name:
+                parts.append(str(name))
+        return parts
+
+    def visit(jxp, mult):
+        for eqn in jxp.eqns:
+            prim = eqn.primitive.name
+            if prim == "dot_general":
+                add(scope_parts(eqn), mult * _dot_flops(eqn))
+            elif prim == "conv_general_dilated":
+                add(scope_parts(eqn), mult * _conv_flops(eqn))
+            elif prim == "scan":
+                visit(eqn.params["jaxpr"].jaxpr,
+                      mult * eqn.params["length"])
+            elif prim == "while":
+                # unknown trip count: count one iteration (documented)
+                visit(eqn.params["body_jaxpr"].jaxpr, mult)
+            elif prim == "cond":
+                visit(eqn.params["branches"][0].jaxpr, mult)
+            else:
+                for p in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                    sub = eqn.params.get(p) if eqn.params else None
+                    if sub is not None:
+                        visit(getattr(sub, "jaxpr", sub), mult)
+                        break
+
+    visit(closed.jaxpr, 1.0)
+    return acc
+
+
 def module_profile_tree(model, params, *example_args, depth: int = -1,
                         top: int = 0, **example_kwargs):
-    """Per-module profile rows for a flax model: (path, #params, MACs).
-
-    The reference hooks torch modules at runtime; under jit that's
-    impossible, so this walks the captured per-module INTERMEDIATES from an
-    ``eval_shape`` apply (zero memory, any size): each module's parameter
-    count comes from its params subtree and its MACs from the Dense/Embed
-    kernels it owns times the tokens that flowed through it (output shapes
-    from the capture)."""
+    """Per-module profile rows for a flax model: (path, #params, MACs,
+    flops). Parameter counts come from the params subtree; flops come from
+    the jaxpr's dot/conv eqns attributed by module name stack
+    (``jaxpr_module_flops``) — exact for the GEMM-dominated total the
+    flagship MFU is computed from, and inclusive of attention scores/MoE
+    dispatch einsums that parameter-shape heuristics cannot see."""
     import numpy as np
-    import flax.linen as nn
-    import jax.numpy as jnp
 
-    _, state = jax.eval_shape(
-        lambda p, *a, **k: model.apply(
-            {"params": p}, *a, capture_intermediates=True, mutable=["intermediates"],
-            **k),
+    flops_by_path = jaxpr_module_flops(
+        lambda p, *a, **k: model.apply({"params": p}, *a, **k),
         params, *example_args, **example_kwargs)
-    inter = state["intermediates"]
+
+    # Normalize name-stack paths onto params-tree paths: method scopes
+    # render as "module.method" (strip the method), the model's own class
+    # name roots some paths (drop it), nn.scan bodies repeat the carrier
+    # segment (dedup consecutive). Because jaxpr_module_flops already
+    # aggregates every child into every prefix, colliding normalized keys
+    # resolve by max — the shortest original key holds the superset.
+    cls = type(model).__name__
+    norm: Dict[str, float] = {}
+    for key, val in flops_by_path.items():
+        if key == "<root>":
+            norm[""] = max(norm.get("", 0.0), val)
+            continue
+        segs = [s.split(".")[0] for s in key.split("/")]
+        if segs and segs[0] == cls:
+            segs = segs[1:]
+        dedup = [s for i, s in enumerate(segs) if i == 0 or s != segs[i - 1]]
+        nk = "/".join(dedup)
+        norm[nk] = max(norm.get(nk, 0.0), val)
+
+    def flops_for(path_parts):
+        return norm.get("/".join(path_parts))
 
     rows = []
 
-    def walk(ptree, itree, path):
+    def walk(ptree, path):
         n_params = sum(int(np.prod(l.shape))
                        for l in jax.tree.leaves(ptree))
-        out_shape = None
-        if isinstance(itree, dict) and "__call__" in itree:
-            outs = itree["__call__"]
-            leaf = jax.tree.leaves(outs)
-            if leaf:
-                out_shape = tuple(leaf[0].shape)
-        macs = _module_macs(ptree, out_shape)
+        fl = flops_for(path)
         rows.append({"module": "/".join(path) or "<root>",
-                     "params": n_params, "macs": macs,
-                     "output_shape": out_shape,
+                     "params": n_params,
+                     "flops": fl,
+                     "macs": int(fl / 2) if fl else None,
                      "depth": len(path)})
         if isinstance(ptree, dict):
             for key in sorted(ptree):
-                sub_i = itree.get(key, {}) if isinstance(itree, dict) else {}
                 if isinstance(ptree[key], dict):
-                    walk(ptree[key], sub_i, path + [key])
+                    walk(ptree[key], path + [key])
 
-    walk(params, inter, [])
+    walk(params, [])
     if depth >= 0:
         rows = [r for r in rows if r["depth"] <= depth]
     if top:
@@ -129,33 +220,17 @@ def module_profile_tree(model, params, *example_args, depth: int = -1,
     return rows
 
 
-def _module_macs(ptree, out_shape):
-    """MACs for the GEMMs this module owns: kernel [..., in, out] applied
-    to `tokens` rows (from the module's output shape)."""
-    import numpy as np
-    if out_shape is None or len(out_shape) < 2:
-        return None
-    tokens = int(np.prod(out_shape[:-1]))
-    macs = 0
-    leaves = jax.tree_util.tree_flatten_with_path(ptree)[0]
-    for path, leaf in leaves:
-        last = getattr(path[-1], "key", "")
-        if last in ("kernel", "w") and len(leaf.shape) >= 2:
-            macs += tokens * int(np.prod(leaf.shape[-2:])) * (
-                int(np.prod(leaf.shape[:-2])) or 1)
-    return macs
-
-
 def print_module_profile(model, params, *example_args, depth: int = -1,
                          **example_kwargs):
     """Reference-style tree printout."""
     rows = module_profile_tree(model, params, *example_args, depth=depth,
                                **example_kwargs)
-    log_dist(f"{'module':<40} {'params':>12} {'MACs':>14} output", ranks=[0])
+    log_dist(f"{'module':<40} {'params':>12} {'MACs':>14} {'GFLOPs':>9}",
+             ranks=[0])
     for r in rows:
         indent = "  " * r["depth"]
         macs = f"{r['macs']:,}" if r["macs"] else "-"
+        gf = f"{r['flops'] / 1e9:.2f}" if r["flops"] else "-"
         log_dist(f"{indent + r['module'].split('/')[-1]:<40} "
-                 f"{r['params']:>12,} {macs:>14} "
-                 f"{r['output_shape'] or ''}", ranks=[0])
+                 f"{r['params']:>12,} {macs:>14} {gf:>9}", ranks=[0])
     return rows

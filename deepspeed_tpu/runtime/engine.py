@@ -1396,12 +1396,15 @@ class DeepSpeedEngine:
         self._layer_streamer = None
         if op.layer_streaming:
             from .zero.layer_stream import LayerStreamer
-            gpt_cfg = getattr(self.module, "cfg", None)
-            if gpt_cfg is None or not hasattr(gpt_cfg, "num_layers"):
+            make_spec = getattr(self.module, "stacked_spec", None)
+            if make_spec is None:
                 raise ValueError(
-                    "offload_param.layer_streaming drives the GPT scan-"
-                    "over-layers structure directly and needs a model with "
-                    "a .cfg (models/gpt.py GPT)")
+                    "offload_param.layer_streaming drives the model's "
+                    "stacked-trunk structure directly and needs a module "
+                    "exposing .stacked_spec(loss_fn) -> StackedPipeSpec "
+                    "(models.GPT and models.BertForMaskedLM do; see "
+                    "runtime/pipe/spmd.py StackedPipeSpec for the "
+                    "prefix/block/suffix contract)")
             if any(v > 1 for v in dict(self.mesh.shape).values()):
                 raise ValueError(
                     "offload_param.layer_streaming is the SINGLE-chip "
@@ -1409,7 +1412,7 @@ class DeepSpeedEngine:
                     "program); at mesh sizes > 1 use ZeRO-3 sharding for "
                     "capacity instead")
             self._layer_streamer = LayerStreamer(
-                self.host_optimizer, gpt_cfg, self.loss_fn,
+                self.host_optimizer, make_spec(self.loss_fn),
                 self.compute_dtype)
             # no full device params, no device grad accumulator: between
             # steps HBM holds nothing of the model (the capacity tier)

@@ -236,6 +236,16 @@ class OnebitRunner:
             return new_state, {"loss": loss, "grad_norm": gnorm,
                                "finite": finite}
 
+        if getattr(self.engine, "_ckpt_offload", False):
+            # same XLA quirk as engine._jit_state_step: explicit
+            # out_shardings + host-offload placement custom-calls -> SPMD
+            # partitioner RET_CHECK; constrain inside the program instead
+            def constrained(state, *args, **kwargs):
+                new_state, aux = step_fn(state, *args, **kwargs)
+                new_state = jax.lax.with_sharding_constraint(
+                    new_state, self._state_shardings)
+                return new_state, aux
+            return jax.jit(constrained, donate_argnums=(0,))
         return jax.jit(step_fn, donate_argnums=(0,),
                        out_shardings=(self._state_shardings, None))
 

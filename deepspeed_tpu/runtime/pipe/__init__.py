@@ -10,4 +10,4 @@ Two engines, by controller model:
 
 from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
 from .spmd import (GPipeSpmdEngine, StackedPipeSpec,  # noqa: F401
-                   gpt_pipe_spec)
+                   bert_mlm_pipe_spec, gpt_pipe_spec)

@@ -29,9 +29,12 @@ trainable params/chip becomes a host-DRAM/NVMe bound instead of an HBM
 bound. Fetch count per scan = exactly L (one prime + L-1 in-scan
 prefetches; the final iteration's dead prefetch is cond-skipped).
 
-Restrictions (validated loudly): scan_layers param layout (stacked
-``blocks`` [L, ...]), dense blocks (no MoE), no progressive layer drop, no
-sequence parallelism, deterministic compute (dropout 0), single-process.
+Model-agnostic through ``StackedPipeSpec`` (runtime/pipe/spmd.py): any
+model factored as prefix / stacked-scanned-trunk / suffix streams —
+GPT (``gpt_pipe_spec``) and BERT MLM (``bert_mlm_pipe_spec``) are proven
+by tests/test_layer_stream.py. Restrictions (validated loudly):
+scan_layers param layout (stacked blocks [L, ...]), deterministic
+compute, single-process (adapters reject MoE/dropout/sp themselves).
 """
 
 from __future__ import annotations
@@ -45,32 +48,25 @@ from jax.experimental import io_callback
 
 from ...utils.logging import log_dist
 
-BLOCKS_KEY = "blocks"
-
-
-def _is_block_path(path: str) -> bool:
-    return path == BLOCKS_KEY or path.startswith(BLOCKS_KEY + "/")
-
 
 class LayerStreamer:
     """Host side: per-layer mirror fetches and grad-emit buffers over the
     HostOffloadOptimizer's leaves."""
 
-    def __init__(self, host_optimizer, gpt_cfg, loss_fn,
-                 compute_dtype) -> None:
+    def __init__(self, host_optimizer, spec, compute_dtype) -> None:
         self.opt = host_optimizer
-        self.cfg = gpt_cfg
-        self.loss_fn = loss_fn
+        self.spec = spec
         self.compute_dtype = compute_dtype
         self._validate()
-        L = gpt_cfg.num_layers
+        L = spec.num_layers
         self.num_layers = L
+        bk = spec.blocks_key
 
         # leaf bookkeeping in treedef order
         self.block_idx: List[int] = []
         self.resident_idx: List[int] = []
         for i, leaf in enumerate(self.opt.leaves):
-            if _is_block_path(leaf.path):
+            if leaf.path == bk or leaf.path.startswith(bk + "/"):
                 if not leaf.shape or leaf.shape[0] != L:
                     raise ValueError(
                         f"layer streaming needs stacked [L, ...] block "
@@ -80,7 +76,8 @@ class LayerStreamer:
             else:
                 self.resident_idx.append(i)
         if not self.block_idx:
-            raise ValueError("layer streaming: no 'blocks/...' leaves found")
+            raise ValueError(
+                f"layer streaming: no '{bk}/...' leaves found")
         # scaled fp32 grad accumulators for the streamed leaves (host DRAM;
         # the analogue of the reference's pinned grad partitions,
         # stage_1_and_2.py:1014). Sized to leaf.numel (padded) so they feed
@@ -90,31 +87,24 @@ class LayerStreamer:
             for i in self.block_idx}
 
     def _validate(self) -> None:
-        cfg = self.cfg
+        # model-structure constraints (MoE / dropout / sp) are enforced by
+        # the spec adapters at construction; here only the runtime ones
         bad = []
-        if getattr(cfg, "moe", False):
-            bad.append("moe")
-        if getattr(cfg, "sequence_parallel", False):
-            bad.append("sequence_parallel")
-        if getattr(cfg, "dropout", 0.0):
-            bad.append("dropout>0")
-        if not getattr(cfg, "scan_layers", True):
-            bad.append("scan_layers=False")
         if jax.process_count() > 1 or not self.opt.owns_all():
             bad.append("multi-process dp")
-        if jnp.dtype(getattr(cfg, "dtype", jnp.float32)) != \
-                jnp.dtype(self.compute_dtype):
+        if self.spec.dtype is not None and \
+                jnp.dtype(self.spec.dtype) != jnp.dtype(self.compute_dtype):
             bad.append(
-                f"model dtype {jnp.dtype(cfg.dtype).name} != engine compute "
-                f"dtype {jnp.dtype(self.compute_dtype).name} (the scan "
-                "carry must keep one dtype across blocks)")
+                f"model dtype {jnp.dtype(self.spec.dtype).name} != engine "
+                f"compute dtype {jnp.dtype(self.compute_dtype).name} (the "
+                "scan carry must keep one dtype across blocks)")
         if bad:
             raise ValueError(
                 "offload_param.layer_streaming does not support: "
                 + ", ".join(bad)
-                + " (the streamed step drives the scan-over-layers GPT "
-                "structure directly; reference analogue trains dense "
-                "models the same way, zero3-offload blog)")
+                + " (the streamed step drives the stacked-trunk structure "
+                "directly; reference analogue trains dense models the same "
+                "way, zero3-offload blog)")
 
     # -------------------------------------------------------- layer slices
     def _layer_numel(self, leaf) -> int:
@@ -213,13 +203,12 @@ class LayerStreamer:
 
 
 def _streamed_fns(streamer: LayerStreamer):
-    """The shared functional pieces (block/embed/head apply + host fetch)
-    used by both the train and eval builders."""
-    from ...models.gpt import Block
-    cfg = streamer.cfg
+    """The shared functional pieces (block/prefix/suffix apply + host
+    fetch) used by both the train and eval builders — all model structure
+    comes from the StackedPipeSpec."""
+    spec = streamer.spec
     block_abs = streamer.block_abstract()
-    loss_fn = streamer.loss_fn
-    compute_dtype = streamer.compute_dtype
+    n_prefix = len(spec.blocks_key.split("/"))
 
     # single-layer params subtree structure: strip the leading layer axis
     # from the blocks subtree. Fetched leaves arrive in leaf order, which
@@ -230,36 +219,20 @@ def _streamed_fns(streamer: LayerStreamer):
     def blocks_tree(leaves: List[Any]) -> Dict[str, Any]:
         tree: Dict[str, Any] = {}
         for path, leaf in zip(blocks_leaf_paths, leaves):
-            parts = path.split("/")[1:]   # drop "blocks"
+            parts = path.split("/")[n_prefix:]   # drop the blocks prefix
             node = tree
             for p in parts[:-1]:
                 node = node.setdefault(p, {})
             node[parts[-1]] = leaf
         return tree
 
-    def block_apply(p_tree, x, positions):
-        y, _aux = Block(cfg).apply({"params": p_tree}, x, positions, True)
-        return y
+    block_apply = spec.block
 
-    def embed_fn(res, ids, positions):
-        wte = res["wte"]
-        x = jnp.take(wte["embedding"].astype(compute_dtype), ids, axis=0)
-        if not cfg.rotary:
-            x = x + res["wpe"][positions].astype(compute_dtype)
-        return x
+    def embed_fn(res, batch):
+        return spec.prefix(res, batch)          # -> (x, aux)
 
     def head_fn(res, x, batch, scale):
-        import flax.linen as nn
-        ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=compute_dtype,
-                          param_dtype=cfg.param_dtype, name="ln_f")
-        x = ln.apply({"params": res["ln_f"]}, x)
-        if cfg.tie_embeddings:
-            logits = x.astype(compute_dtype) @ \
-                res["wte"]["embedding"].astype(compute_dtype).T
-        else:
-            logits = x.astype(compute_dtype) @ \
-                res["lm_head"]["kernel"].astype(compute_dtype)
-        loss = loss_fn(logits, batch)
+        loss = spec.suffix_loss(res, x, batch)
         return loss.astype(jnp.float32) * scale, loss
 
     def fetch(i):
@@ -278,15 +251,13 @@ def build_streamed_eval(streamer: LayerStreamer):
         _streamed_fns(streamer)
 
     def ev(res, batch):
-        ids = batch["input_ids"]
-        b, s = ids.shape
-        positions = jnp.arange(s)[None, :].repeat(b, axis=0)
-
         # double-buffered: the carry holds the CURRENT layer's params while
         # the next layer's fetch rides the same iteration (the coordinator's
         # prefetch-ahead, partitioned_param_coordinator.py:240 — the fetch
         # callback is dataflow-independent of the block compute, so the
         # runtime can overlap the host hop with the MXU work)
+        x0, aux = embed_fn(res, batch)
+
         def f_body(carry, i):
             x, p_cur = carry
             # last iteration has nothing to prefetch: reuse p_cur instead
@@ -294,9 +265,8 @@ def build_streamed_eval(streamer: LayerStreamer):
             p_next = jax.lax.cond(i + 1 < L,
                                   lambda: _blocks_tree(fetch(i + 1)),
                                   lambda: p_cur)
-            y = block_apply(p_cur, x, positions)
+            y = block_apply(p_cur, x, aux)
             return (y, p_next), None
-        x0 = embed_fn(res, ids, positions)
         p0 = _blocks_tree(fetch(jnp.asarray(0, jnp.int32)))
         (x_last, _), _ = jax.lax.scan(f_body, (x0, p0), jnp.arange(L))
         _scaled, loss = head_fn(res, x_last, batch,
@@ -318,21 +288,18 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
         _streamed_fns(streamer)
 
     def micro_grads(res, batch, scale):
-        ids = batch["input_ids"]
-        b, s = ids.shape
-        positions = jnp.arange(s)[None, :].repeat(b, axis=0)
-
         # ---- forward: stream layers, keep only layer inputs -------------
         # double-buffered (see build_streamed_eval): fetch(i+1) rides
         # iteration i, dataflow-independent of the block compute
+        x0, aux = embed_fn(res, batch)
+
         def f_body(carry, i):
             x, p_cur = carry
             p_next = jax.lax.cond(i + 1 < L,
                                   lambda: _blocks_tree(fetch(i + 1)),
                                   lambda: p_cur)
-            y = block_apply(p_cur, x, positions)
+            y = block_apply(p_cur, x, aux)
             return (y, p_next), x
-        x0 = embed_fn(res, ids, positions)
         p0 = _blocks_tree(fetch(jnp.asarray(0, jnp.int32)))
         (x_last, _), xs = jax.lax.scan(f_body, (x0, p0), jnp.arange(L))
 
@@ -353,7 +320,7 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
                                   lambda: _blocks_tree(fetch(i - 1)),
                                   lambda: p_cur)
             _, vjp_fn = jax.vjp(
-                lambda pp, xx: block_apply(pp, xx, positions), p_cur, x_i)
+                lambda pp, xx: block_apply(pp, xx, aux), p_cur, x_i)
             dp, dx_next = vjp_fn(dx.astype(x_i.dtype))
             dp32 = jax.tree.map(lambda g: g.astype(jnp.float32), dp)
             io_callback(streamer.emit_layer, None, i,
@@ -369,8 +336,8 @@ def build_streamed_step(streamer: LayerStreamer, gas: int):
             b_body, (dx, p_last, jnp.asarray(True)),
             (jnp.arange(L - 1, -1, -1), xs[::-1]))
 
-        # ---- embeddings -------------------------------------------------
-        _, embed_vjp = jax.vjp(lambda r: embed_fn(r, ids, positions), res)
+        # ---- prefix (embeddings etc.) -----------------------------------
+        _, embed_vjp = jax.vjp(lambda r: embed_fn(r, batch)[0], res)
         (d_res_embed,) = embed_vjp(dx0.astype(compute_dtype))
         d_res = jax.tree.map(
             lambda a, b_: a.astype(jnp.float32) + b_.astype(jnp.float32),
