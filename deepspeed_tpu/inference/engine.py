@@ -44,6 +44,7 @@ class InferenceEngine:
                  checkpoint: Optional[str] = None,
                  replace_with_kernel_inject: bool = False,
                  injection_policy=None, quantize_bits: Optional[int] = None,
+                 quantize_mode: str = "symmetric",
                  max_tokens: Optional[int] = None,
                  replace_method: Optional[str] = None):
         """``ep_size``: expert-parallel degree for MoE models (reference
@@ -115,7 +116,11 @@ class InferenceEngine:
             # The int8 tree itself is placed TP-sharded at rest (q8 leaves
             # inherit the fp leaf's spec, per-group scales follow), so
             # mp_size>1 actually divides the HBM footprint
-            q = quantize_tree(params)
+            # mode: "symmetric" (absmax) or "asymmetric" (min/max range +
+            # per-column zero point, reference ds_quantize_asym) — asym
+            # buys accuracy on skewed weight distributions for one extra
+            # f32 per output column
+            q = quantize_tree(params, mode=quantize_mode)
             self.params = self._place(
                 q, quantize_shardings(q, self.param_shardings, self.mesh))
             self.quantized = True

@@ -40,17 +40,108 @@ def dequantize(q: jnp.ndarray, scales: jnp.ndarray,
     return (flat * scales[:, None]).astype(dtype).reshape(q.shape)
 
 
+def _asym_range(flat: jnp.ndarray, bits: int):
+    """Per-group (min, scale) of the reference's min/max-range scheme
+    (quantizer.cu:565: scale=(max-min+1e-5)/2^bits) — the single home of
+    that formula for both the int8-at-rest path and ds_quantize."""
+    mn = jnp.min(flat, axis=1, keepdims=True)
+    mx = jnp.max(flat, axis=1, keepdims=True)
+    return mn, ((mx - mn) + 1e-5) / float(1 << bits)
+
+
+def quantize_asym(x: jnp.ndarray, num_groups: int = 1
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Asymmetric per-group int8: per-group min/max range (reference
+    ``ds_quantize_asym``, csrc/quantization/quantizer.cu:565 —
+    scale=(max-min)/2^bits, values rebased to the group minimum). Returns
+    (q int8, scales f32 [G], mins f32 [G]); dequant is q*scale + min
+    with q rebased to [0, 255] via +128."""
+    flat = x.reshape(num_groups, -1).astype(jnp.float32)
+    mn, scale = _asym_range(flat, 8)
+    q = jnp.clip(jnp.round((flat - mn) / scale), 0, 255) - 128
+    return (q.astype(jnp.int8).reshape(x.shape), scale[:, 0], mn[:, 0])
+
+
+def dequantize_asym(q: jnp.ndarray, scales: jnp.ndarray, mins: jnp.ndarray,
+                    dtype=jnp.bfloat16) -> jnp.ndarray:
+    num_groups = scales.shape[0]
+    flat = (q.reshape(num_groups, -1).astype(jnp.float32) + 128.0)
+    return (flat * scales[:, None] + mins[:, None]).astype(dtype).reshape(
+        q.shape)
+
+
+def ds_quantize(vals: jnp.ndarray, groups: int, bits: int = 8,
+                asymmetric: bool = False, stochastic: bool = False,
+                key=None) -> jnp.ndarray:
+    """Fake quantization (quantize -> dequantize, original dtype/shape) with
+    the reference kernel family's exact semantics
+    (csrc/quantization/pt_binding.cpp:64-74 ``ds_quantize`` /
+    ``ds_sr_quantize`` / ``ds_quantize_asym`` / ``ds_sr_quantize_asym``;
+    kernels in quantizer.cu):
+
+      sym       : q_scale = 2^bits / (2*absmax + 1e-5); round(v*q_scale),
+                  dequant /q_scale                       (quantizer.cu:64)
+      sym + sr  : truncate toward zero, bump by sign(v) with probability
+                  |fractional error|, clamped inside (low_q, high_q)
+                  (quantizer.cu:405-450)
+      asym      : q_scale = (max-min+1e-5)/2^bits; round((v-min)/q_scale),
+                  dequant *q_scale + min                 (quantizer.cu:565)
+      asym + sr : floor instead of round, +1 with probability equal to the
+                  fractional remainder
+
+    ``stochastic=True`` requires a ``key`` (jax PRNG); traced and jit-safe,
+    usable both for MoQ-style quantize-aware training and for low-precision
+    stochastic-rounded training steps (the reference's
+    StochasticTransformerBuilder training mode analogue,
+    csrc/transformer/ds_transformer_cuda.cpp:1031-1046)."""
+    if stochastic and key is None:
+        raise ValueError("stochastic=True needs a jax PRNG `key`")
+    flat = vals.reshape(groups, -1).astype(jnp.float32)
+    if asymmetric:
+        mn, scale = _asym_range(flat, bits)
+        t = (flat - mn) / scale
+        if stochastic:
+            low = jnp.floor(t)
+            r = jax.random.uniform(key, flat.shape)
+            q = low + (r < (t - low)).astype(jnp.float32)
+        else:
+            q = jnp.round(t)
+        out = q * scale + mn
+    else:
+        absmax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
+        q_scale = float(1 << bits) / (2.0 * absmax + 1e-5)
+        t = flat * q_scale
+        if stochastic:
+            ti = jnp.trunc(t)
+            high_q = float((1 << (bits - 1)) - 1)
+            low_q = float(-(1 << (bits - 1)))
+            err = jnp.abs(t - ti)
+            r = jax.random.uniform(key, flat.shape)
+            bump = ((r < err) & (ti > low_q) & (ti < high_q)
+                    ).astype(jnp.float32)
+            q = ti + jnp.sign(t) * bump
+        else:
+            q = jnp.round(t)
+        out = q / q_scale
+    return out.reshape(vals.shape).astype(vals.dtype)
+
+
 def _is_qleaf(x) -> bool:
     return isinstance(x, dict) and "q8" in x and "scale" in x
 
 
-def quantize_tree(params) -> Any:
+def quantize_tree(params, mode: str = "symmetric") -> Any:
     """Quantize GEMM weights of a param tree to ``{"q8": int8 [out, ...in],
     "scale": f32 [out]}`` (one scale group per output column —
-    matmul-friendly). Biases/norms stay as-is, and so do embedding tables
-    — the predicate is path-based, not rank-based (reference
-    WeightQuantization quantizes only the GEMM weights and skips
-    embeddings)."""
+    matmul-friendly); ``mode="asymmetric"`` adds a per-column ``"zmin"``
+    (min/max range quantization, reference ``ds_quantize_asym``).
+    Biases/norms stay as-is, and so do embedding tables — the predicate is
+    path-based, not rank-based (reference WeightQuantization quantizes
+    only the GEMM weights and skips embeddings)."""
+    if mode not in ("symmetric", "asymmetric"):
+        raise ValueError(f"quantize mode {mode!r}: use 'symmetric' or "
+                         f"'asymmetric'")
+
     def q(path, leaf):
         leaf = jnp.asarray(leaf)
         key = jax.tree_util.keystr(path)
@@ -62,6 +153,11 @@ def quantize_tree(params) -> Any:
                 and not _EMBED_PAT.search(key):
             moved = jnp.moveaxis(leaf, -1, 0)        # (out, ...)
             g = moved.shape[0]
+            if mode == "asymmetric":
+                vals, scales, mins = quantize_asym(moved.reshape(g, -1),
+                                                   num_groups=g)
+                return {"q8": vals.reshape(moved.shape), "scale": scales,
+                        "zmin": mins}
             vals, scales = quantize(moved.reshape(g, -1), num_groups=g)
             return {"q8": vals.reshape(moved.shape), "scale": scales}
         return leaf
@@ -81,10 +177,13 @@ def quantize_shardings(qtree, fp_shardings, mesh) -> Any:
         nd = qleaf["q8"].ndim
         spec = spec + [None] * (nd - len(spec))
         moved = [spec[-1]] + spec[:-1]               # moveaxis(-1, 0)
-        return {
+        out = {
             "q8": NamedSharding(mesh, P(*moved)),
             "scale": NamedSharding(mesh, P(moved[0])),
         }
+        if "zmin" in qleaf:
+            out["zmin"] = NamedSharding(mesh, P(moved[0]))
+        return out
 
     return jax.tree.map(sh, qtree, fp_shardings, is_leaf=_is_qleaf)
 
@@ -97,7 +196,11 @@ def dequantize_tree(qtree, dtype=jnp.bfloat16):
         if _is_qleaf(leaf):
             q8 = leaf["q8"]
             g = q8.shape[0]
-            flat = dequantize(q8.reshape(g, -1), leaf["scale"], dtype)
+            if "zmin" in leaf:
+                flat = dequantize_asym(q8.reshape(g, -1), leaf["scale"],
+                                       leaf["zmin"], dtype)
+            else:
+                flat = dequantize(q8.reshape(g, -1), leaf["scale"], dtype)
             return jnp.moveaxis(flat.reshape(q8.shape), 0, -1)
         return leaf
 

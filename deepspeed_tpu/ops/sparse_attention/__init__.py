@@ -1,5 +1,6 @@
 """Block-sparse attention (reference: deepspeed/ops/sparse_attention/)."""
 
+from .matmul import MatMul
 from .sparse_self_attention import sparse_attention
 from .sparsity_config import (BigBirdSparsityConfig,
                               BSLongformerSparsityConfig,

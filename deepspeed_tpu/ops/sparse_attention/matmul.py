@@ -1,0 +1,185 @@
+"""Standalone block-sparse matmul: SDD / DSD / DDS.
+
+Reference analogue: ``deepspeed/ops/sparse_attention/matmul.py:214-995``
+(triton-backed ``MatMul`` usable outside attention — the building block
+users compose into custom sparse kernels). The TPU formulation is
+gather/scatter over the static block layout expressed in XLA: nonzero
+block coordinates are extracted from the (static, host-side) layout at
+construction, the hot loop is one batched [nnz, block, block] einsum that
+XLA tiles onto the MXU, and DSD/DDS row-accumulation is a segment-sum
+over the static row ids. The fused attention path keeps its dedicated
+Pallas kernels (sparse_self_attention.py) — this op exists for everything
+else the reference's generic matmul serves (sparse MLPs, block-sparse
+routing, custom attention variants).
+
+Sparse operands travel in the reference's packed value layout:
+``[batch, nnz, block, block]`` where ``nnz`` enumerates the layout's
+nonzero (head, row, col) blocks in ``np.nonzero`` order (row-major per
+head) — the same convention the reference's triton kernels use, so
+packed tensors port across.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MatMul:
+    """Block-sparse matmul over a static block ``layout``.
+
+    layout: [H, M_blocks, N_blocks] 0/1 (numpy or array-like; static).
+    block:  square block size (TPU-friendly multiples of 8; 128 rides the
+            MXU tile exactly).
+    mode:   'sdd' — dense @ dense -> sparse (packed [B, nnz, blk, blk])
+            'dsd' — sparse @ dense -> dense
+            'dds' — dense @ sparse -> dense
+    trans_a / trans_b transpose the last two dims of the respective
+    operand before the multiply (reference MatMul flags).
+
+    Dense operands are [B, H, R, C]; a batch whose H dim is 1 broadcasts
+    over the layout's H.
+    """
+
+    def __init__(self, layout, block: int, mode: str,
+                 trans_a: bool = False, trans_b: bool = False):
+        if mode not in ("sdd", "dsd", "dds"):
+            raise ValueError(f"mode must be sdd/dsd/dds, got {mode!r}")
+        layout = np.asarray(layout)
+        if layout.ndim != 3:
+            raise ValueError(f"layout must be [H, M_blocks, N_blocks]; "
+                             f"got shape {layout.shape}")
+        if (mode == "dsd" and trans_a) or (mode == "dds" and trans_b):
+            raise NotImplementedError(
+                "transposing the PACKED sparse operand needs a transposed "
+                "layout (blocks move (i,j)->(j,i)), not just per-block "
+                "transposes — construct a MatMul over layout.transpose("
+                "0, 2, 1) with swapped operand roles instead")
+        if block < 1:
+            raise ValueError("block must be positive")
+        self.layout = (layout != 0)
+        self.block = int(block)
+        self.mode = mode
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+        h, i, j = np.nonzero(self.layout)
+        if h.size == 0:
+            raise ValueError("layout has no nonzero blocks")
+        self.nnz = int(h.size)
+        self._h = jnp.asarray(h, jnp.int32)
+        self._i = jnp.asarray(i, jnp.int32)
+        self._j = jnp.asarray(j, jnp.int32)
+        self._mblocks = int(self.layout.shape[1])
+        self._nblocks = int(self.layout.shape[2])
+        self._heads = int(self.layout.shape[0])
+
+    # ------------------------------------------------------------- helpers
+    def _dense_blocks(self, x, rows: jnp.ndarray, heads: jnp.ndarray,
+                      n_blocks: int, what: str) -> jnp.ndarray:
+        """[B, H, R, C] -> per-nnz row-blocks [B, nnz, block, C].
+
+        The row dim is validated against the layout: XLA clamps
+        out-of-range gather indices, so an undersized or wrongly-oriented
+        operand would otherwise produce finite-but-wrong numbers."""
+        b, hh, r, c = x.shape
+        blk = self.block
+        if r != n_blocks * blk:
+            raise ValueError(
+                f"{what}: dense operand dim {r} does not match the "
+                f"layout's {n_blocks} blocks of {blk} "
+                f"(= {n_blocks * blk}); check operand orientation")
+        if hh not in (1, self._heads):
+            raise ValueError(
+                f"{what}: operand has {hh} heads, layout has "
+                f"{self._heads}")
+        xb = x.reshape(b, hh, n_blocks, blk, c)
+        heads = jnp.zeros_like(heads) if hh == 1 else heads
+        return xb[:, heads, rows]                    # [B, nnz, blk, C]
+
+    @staticmethod
+    def _t(x, do):
+        return jnp.swapaxes(x, -1, -2) if do else x
+
+    # ---------------------------------------------------------------- call
+    def __call__(self, a, b):
+        blk, mode = self.block, self.mode
+        if mode == "sdd":
+            A = self._t(a, self.trans_a)
+            B = self._t(b, self.trans_b)
+            if A.shape[-2] != self._mblocks * blk \
+                    or B.shape[-1] != self._nblocks * blk:
+                raise ValueError(
+                    f"sdd: operands {A.shape} x {B.shape} do not match "
+                    f"layout [{self._mblocks}x{self._nblocks}] blocks of "
+                    f"{blk}")
+            ab = self._dense_blocks(A, self._i, self._h,
+                                    self._mblocks, "sdd lhs")
+            bt = jnp.swapaxes(B, -1, -2)                  # [B,H,N,K]
+            bb = self._dense_blocks(bt, self._j, self._h,
+                                    self._nblocks, "sdd rhs")
+            return jnp.einsum("znik,znjk->znij", ab, bb)
+
+        if mode == "dsd":
+            # packed a [B, nnz, blk, blk] @ dense b [B, H, K, N]
+            A = self._t(a, self.trans_a)
+            B = self._t(b, self.trans_b)
+            if A.shape[1] != self.nnz:
+                raise ValueError(
+                    f"dsd: packed operand has {A.shape[1]} blocks, layout "
+                    f"has {self.nnz}")
+            bb = self._dense_blocks(B, self._j, self._h,
+                                    self._nblocks, "dsd rhs")
+            prod = jnp.einsum("znij,znjc->znic", A, bb)   # [B,nnz,blk,N]
+            seg = self._h * self._mblocks + self._i
+            out = jax.ops.segment_sum(
+                jnp.swapaxes(prod, 0, 1), seg,
+                num_segments=self._heads * self._mblocks)
+            out = jnp.swapaxes(out, 0, 1)  # [B, H*Mb, blk, N]
+            bsz, _, _, n = out.shape
+            return out.reshape(bsz, self._heads, self._mblocks * blk, n)
+
+        # dds: dense a [B, H, M, K] @ packed b [B, nnz, blk, blk]
+        A = self._t(a, self.trans_a)
+        B = self._t(b, self.trans_b)
+        if B.shape[1] != self.nnz:
+            raise ValueError(
+                f"dds: packed operand has {B.shape[1]} blocks, layout has "
+                f"{self.nnz}")
+        at = jnp.swapaxes(A, -1, -2)                      # [B,H,K,M]
+        ab = self._dense_blocks(at, self._i, self._h,
+                                self._mblocks, "dds lhs")
+        prod = jnp.einsum("znkm,znkj->znmj", ab, B)       # [B,nnz,M,blk]
+        seg = self._h * self._nblocks + self._j
+        out = jax.ops.segment_sum(
+            jnp.swapaxes(prod, 0, 1), seg,
+            num_segments=self._heads * self._nblocks)
+        out = jnp.swapaxes(out, 0, 1)  # [B, H*Nb, M, blk]
+        bsz, _, m, _ = out.shape
+        out = out.reshape(bsz, self._heads, self._nblocks, m, blk)
+        return jnp.swapaxes(out, 2, 3).reshape(
+            bsz, self._heads, m, self._nblocks * blk)
+
+    # ------------------------------------------------------------ packing
+    def pack(self, dense) -> jnp.ndarray:
+        """Dense [B, H, M, N] -> packed [B, nnz, blk, blk] (layout order)."""
+        blk = self.block
+        bsz, hh, m, n = dense.shape
+        xb = dense.reshape(bsz, hh, m // blk, blk, n // blk, blk)
+        xb = jnp.moveaxis(xb, 4, 3)    # [B, H, Mb, Nb, blk, blk]
+        heads = (jnp.zeros_like(self._h) if hh == 1 else self._h)
+        return xb[:, heads, self._i, self._j]
+
+    def unpack(self, packed, dtype=None) -> jnp.ndarray:
+        """Packed [B, nnz, blk, blk] -> dense [B, H, M, N] with zeros in
+        the empty blocks."""
+        blk = self.block
+        bsz = packed.shape[0]
+        out = jnp.zeros((bsz, self._heads, self._mblocks, self._nblocks,
+                         blk, blk), packed.dtype if dtype is None else dtype)
+        out = out.at[:, self._h, self._i, self._j].set(packed)
+        out = jnp.moveaxis(out, 3, 4)
+        return out.reshape(bsz, self._heads, self._mblocks * blk,
+                           self._nblocks * blk)

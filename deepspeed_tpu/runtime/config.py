@@ -22,6 +22,69 @@ from typing import Any, Dict, List, Optional
 from .constants import OFFLOAD_CPU, OFFLOAD_NONE, OFFLOAD_NVME
 
 
+def _tolerant_json_load(text: str, path: str) -> Dict[str, Any]:
+    """Parse a config file, tolerating hjson-style relaxations the
+    reference ecosystem uses in its shipped configs (// and /* */ and #
+    comments, trailing commas). Strict JSON parses unchanged; only on a
+    strict failure is the comment-stripped form tried, so no valid JSON
+    document can change meaning (string literals are respected while
+    stripping)."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as strict_err:
+        out, i, n = [], 0, len(text)
+        in_str = False
+        while i < n:
+            c = text[i]
+            if in_str:
+                out.append(c)
+                if c == "\\" and i + 1 < n:
+                    out.append(text[i + 1])
+                    i += 2
+                    continue
+                if c == '"':
+                    in_str = False
+                i += 1
+            elif c == '"':
+                in_str = True
+                out.append(c)
+                i += 1
+            elif c == "/" and i + 1 < n and text[i + 1] == "/":
+                while i < n and text[i] != "\n":
+                    i += 1
+            elif c == "#":
+                while i < n and text[i] != "\n":
+                    i += 1
+            elif c == "/" and i + 1 < n and text[i + 1] == "*":
+                i += 2
+                while i + 1 < n and not (text[i] == "*"
+                                         and text[i + 1] == "/"):
+                    i += 1
+                i += 2
+            elif c in "}]":
+                # trailing comma: drop a comma whose next non-space char
+                # closes the container (done HERE, outside strings — a
+                # whole-document regex would mangle string values
+                # containing ",}" / ",]")
+                k = len(out) - 1
+                while k >= 0 and out[k] in " \t\r\n":
+                    k -= 1
+                if k >= 0 and out[k] == ",":
+                    del out[k]
+                out.append(c)
+                i += 1
+            else:
+                out.append(c)
+                i += 1
+        try:
+            return json.loads("".join(out))
+        except json.JSONDecodeError:
+            raise DeepSpeedConfigError(
+                f"could not parse {path!r} as JSON (also tried "
+                f"comment/trailing-comma-tolerant mode): {strict_err}"
+            ) from strict_err
+
+
 class DeepSpeedConfigError(Exception):
     pass
 
@@ -432,7 +495,7 @@ class DeepSpeedConfig:
         raw: Dict[str, Any] = {}
         if isinstance(config, str):
             with open(config) as fh:
-                raw = json.load(fh)
+                raw = _tolerant_json_load(fh.read(), config)
         elif isinstance(config, dict):
             raw = dict(config)
         elif config is None:

@@ -109,3 +109,46 @@ def test_compute_dtype():
     assert DeepSpeedConfig({"bf16": {"enabled": True}}).compute_dtype == jnp.bfloat16
     assert DeepSpeedConfig({"fp16": {"enabled": True}}).compute_dtype == jnp.float16
     assert DeepSpeedConfig({}).compute_dtype == jnp.float32
+
+
+def test_commented_config_file_parses(tmp_path):
+    """Drop-in reference configs carry // and /* */ comments and trailing
+    commas (hjson-tolerant parsing, reference runtime/config.py); strict
+    JSON must parse unchanged and garbage must still fail loudly."""
+    p = tmp_path / "ds_config.json"
+    p.write_text("""
+{
+  // per-chip micro batch
+  "train_micro_batch_size_per_gpu": 4,
+  /* ZeRO block */
+  "zero_optimization": {"stage": 2},
+  # even shell-style comments
+  "gradient_accumulation_steps": 2,
+  "steps_per_print": 10,   // trailing comment
+  "bf16": {"enabled": true},
+}
+""")
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig(str(p), dp_world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.zero_config.stage == 2
+    assert cfg.train_batch_size == 16
+    # a string VALUE containing "//" must survive untouched
+    p2 = tmp_path / "url.json"
+    p2.write_text('{"train_micro_batch_size_per_gpu": 1, '
+                  '"wandb": {"enabled": false, "project": "http://x//y"}}')
+    cfg2 = DeepSpeedConfig(str(p2))
+    assert cfg2.wandb.project == "http://x//y"
+    # a string VALUE containing ",}" must survive tolerant mode (comment
+    # forces the tolerant pass; a naive whole-document regex would eat it)
+    p4 = tmp_path / "commas.json"
+    p4.write_text('{"train_micro_batch_size_per_gpu": 1, // c\n'
+                  '"wandb": {"enabled": false, "project": "a,}b,]c"},}')
+    assert DeepSpeedConfig(str(p4)).wandb.project == "a,}b,]c"
+    # garbage still fails loudly
+    p3 = tmp_path / "bad.json"
+    p3.write_text("{not json at all")
+    import pytest
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+    with pytest.raises(DeepSpeedConfigError, match="could not parse"):
+        DeepSpeedConfig(str(p3))

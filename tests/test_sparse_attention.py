@@ -217,3 +217,68 @@ def test_sparse_attention_in_gpt():
     logits = model.apply({"params": params}, ids)
     assert logits.shape == (2, 64, 64)
     assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_block_sparse_matmul_modes():
+    """Standalone SDD/DSD/DDS block-sparse matmul (reference
+    ops/sparse_attention/matmul.py:214-995 exposes the same three modes
+    outside attention). Every mode must agree with the dense computation
+    masked by the layout, including trans flags and packed round-trips."""
+    from deepspeed_tpu.ops.sparse_attention.matmul import MatMul
+    rng = np.random.default_rng(0)
+    H, Mb, Nb, blk = 2, 4, 3, 16
+    layout = (rng.random((H, Mb, Nb)) < 0.5).astype(np.int64)
+    layout[:, 0, 0] = 1                      # never empty
+    B, K = 2, 32
+    M, N = Mb * blk, Nb * blk
+    a = jnp.asarray(rng.normal(size=(B, H, M, K)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(B, H, K, N)), jnp.float32)
+
+    # SDD: (a @ b) sampled at the layout's blocks
+    sdd = MatMul(layout, blk, "sdd")
+    packed = sdd(a, b)
+    assert packed.shape == (B, sdd.nnz, blk, blk)
+    dense_ref = jnp.einsum("bhmk,bhkn->bhmn", a, b)
+    np.testing.assert_allclose(np.asarray(sdd.unpack(packed)),
+                               np.asarray(dense_ref)
+                               * sdd.unpack(sdd.pack(
+                                   jnp.ones_like(dense_ref))),
+                               rtol=2e-5, atol=2e-5)
+
+    # SDD with trans_b (the attention q @ k^T shape)
+    kt = jnp.swapaxes(b, -1, -2)             # [B, H, N, K]
+    packed_t = MatMul(layout, blk, "sdd", trans_b=True)(a, kt)
+    np.testing.assert_allclose(np.asarray(packed_t), np.asarray(packed),
+                               rtol=2e-5, atol=2e-5)
+
+    # DSD: sparse a (packed) @ dense b2  == masked-dense a @ b2
+    w_dense = jnp.asarray(rng.normal(size=(B, H, M, N)), jnp.float32)
+    w_masked = sdd.unpack(sdd.pack(w_dense))  # dense with layout zeros
+    dsd = MatMul(layout, blk, "dsd")
+    b2 = jnp.asarray(rng.normal(size=(B, H, N, K)), jnp.float32)
+    out = dsd(dsd.pack(w_dense), b2)
+    ref = jnp.einsum("bhmn,bhnk->bhmk", w_masked, b2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # DDS: dense a2 @ sparse w == a2 @ masked-dense w
+    a2 = jnp.asarray(rng.normal(size=(B, H, K, M)), jnp.float32)
+    dds = MatMul(layout, blk, "dds")
+    out = dds(a2, dds.pack(w_dense))
+    ref = jnp.einsum("bhkm,bhmn->bhkn", a2, w_masked)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    # jit-compatible (static layout baked in)
+    jout = jax.jit(lambda x, y: MatMul(layout, blk, "sdd")(x, y))(a, b)
+    np.testing.assert_allclose(np.asarray(jout), np.asarray(packed),
+                               rtol=2e-5)
+
+    # sharp-edge validation
+    import pytest
+    with pytest.raises(ValueError, match="sdd/dsd/dds"):
+        MatMul(layout, blk, "xyz")
+    with pytest.raises(ValueError, match="no nonzero"):
+        MatMul(np.zeros((1, 2, 2)), blk, "sdd")
+    with pytest.raises(ValueError, match="do not match"):
+        sdd(a[:, :, :blk], b)
