@@ -108,6 +108,14 @@ class InferenceEngine:
                         f"has no expert banks whose expert dim divides by "
                         f"{ep_size} (check num_experts % ep_size == 0, or "
                         f"drop ep_size)")
+        if quantize_mode not in ("symmetric", "asymmetric"):
+            raise ValueError(
+                f"quantize_mode {quantize_mode!r}: use 'symmetric' or "
+                f"'asymmetric'")
+        if quantize_mode != "symmetric" and quantize_bits != 8:
+            raise ValueError(
+                "quantize_mode='asymmetric' without quantize_bits=8 would "
+                "silently run unquantized; pass quantize_bits=8")
         if quantize_bits == 8:
             from ..ops.quantizer import quantize_shardings, quantize_tree
             # int8 weights live in HBM; dequant happens INSIDE the jitted

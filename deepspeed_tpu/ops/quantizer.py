@@ -126,6 +126,26 @@ def ds_quantize(vals: jnp.ndarray, groups: int, bits: int = 8,
     return out.reshape(vals.shape).astype(vals.dtype)
 
 
+def stochastic_round_bf16(x: jnp.ndarray, key) -> jnp.ndarray:
+    """fp32 -> bf16 with STOCHASTIC rounding: add a uniform 16-bit value
+    below the truncation point, then truncate the mantissa — unbiased in
+    expectation, so repeated master->compute casts don't accumulate a
+    rounding drift. This is the training-mode rounding the reference's
+    StochasticTransformerBuilder kernels apply when writing fp16 outputs
+    from fp32 accumulators (csrc/transformer/ds_transformer_cuda.cpp:
+    1031-1046); here it is a traced cast usable on any fp32 tree (the
+    engine's bf16.stochastic_rounding knob routes the per-step
+    master->bf16 param cast through it). Non-finite values pass through
+    the deterministic cast (bit-noise on inf lands in NaN space)."""
+    x32 = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    sr = jax.lax.bitcast_convert_type(
+        (bits + noise) & jnp.uint32(0xFFFF0000), jnp.float32)
+    out = jnp.where(jnp.isfinite(x32), sr, x32)
+    return out.astype(jnp.bfloat16)
+
+
 def _is_qleaf(x) -> bool:
     return isinstance(x, dict) and "q8" in x and "scale" in x
 

@@ -124,7 +124,8 @@ class MatMul:
 
         if mode == "dsd":
             # packed a [B, nnz, blk, blk] @ dense b [B, H, K, N]
-            A = self._t(a, self.trans_a)
+            # (trans_a on the packed side was rejected at construction)
+            A = a
             B = self._t(b, self.trans_b)
             if A.shape[1] != self.nnz:
                 raise ValueError(
@@ -142,8 +143,9 @@ class MatMul:
             return out.reshape(bsz, self._heads, self._mblocks * blk, n)
 
         # dds: dense a [B, H, M, K] @ packed b [B, nnz, blk, blk]
+        # (trans_b on the packed side was rejected at construction)
         A = self._t(a, self.trans_a)
-        B = self._t(b, self.trans_b)
+        B = b
         if B.shape[1] != self.nnz:
             raise ValueError(
                 f"dds: packed operand has {B.shape[1]} blocks, layout has "
@@ -167,6 +169,13 @@ class MatMul:
         """Dense [B, H, M, N] -> packed [B, nnz, blk, blk] (layout order)."""
         blk = self.block
         bsz, hh, m, n = dense.shape
+        if m != self._mblocks * blk or n != self._nblocks * blk:
+            raise ValueError(
+                f"pack: dense [{m}x{n}] does not match layout "
+                f"[{self._mblocks}x{self._nblocks}] blocks of {blk}")
+        if hh not in (1, self._heads):
+            raise ValueError(f"pack: operand has {hh} heads, layout has "
+                             f"{self._heads}")
         xb = dense.reshape(bsz, hh, m // blk, blk, n // blk, blk)
         xb = jnp.moveaxis(xb, 4, 3)    # [B, H, Mb, Nb, blk, blk]
         heads = (jnp.zeros_like(self._h) if hh == 1 else self._h)

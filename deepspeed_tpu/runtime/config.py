@@ -131,6 +131,11 @@ class FP16Config:
 @dataclass
 class BF16Config:
     enabled: bool = False
+    # stochastic rounding for the per-step fp32-master -> bf16 compute
+    # cast (the reference's StochasticTransformerBuilder training mode,
+    # csrc/transformer/ds_transformer_cuda.cpp:1031-1046): unbiased casts
+    # remove the systematic round-to-nearest drift at low precision
+    stochastic_rounding: bool = False
 
 
 @dataclass

@@ -183,6 +183,12 @@ class DeepSpeedEngine:
         self.compute_dtype = self.config.compute_dtype
         self.fp16_enabled = self.config.fp16.enabled
         self.bfloat16_enabled = self.config.bf16.enabled
+        self._sr_cast = bool(self.config.bf16.stochastic_rounding)
+        if self._sr_cast and not self.bfloat16_enabled:
+            raise ValueError(
+                "bf16.stochastic_rounding rounds the fp32-master -> bf16 "
+                "compute cast and requires bf16.enabled=true (fp16 keeps "
+                "the loss-scaler path; fp32 has no cast to round)")
         self.dynamic_loss_scale = self.config.fp16.dynamic_loss_scale if self.fp16_enabled else False
 
         # ---- ZeRO sharding rules ------------------------------------------
@@ -197,6 +203,13 @@ class DeepSpeedEngine:
         zc = self.config.zero_config
         self.offload_device = zc.offload_optimizer.device
         self.offload_enabled = self.offload_device in ("cpu", "nvme")
+        if self._sr_cast and self.offload_enabled:
+            raise NotImplementedError(
+                "bf16.stochastic_rounding with offload_optimizer: the "
+                "compute-dtype mirror is produced by the host CPU-Adam "
+                "(csrc/cpu_adam.cpp, round-to-nearest-even) rather than a "
+                "device cast, so the knob would silently not apply — "
+                "rejecting loudly instead")
         self._offload_nvme_path = zc.offload_optimizer.nvme_path
         if self.offload_enabled and (self.progressive_layer_drop is not None
                                      or self.quantizer is not None):
@@ -678,14 +691,31 @@ class DeepSpeedEngine:
             return out
         raise ValueError("model output is not a scalar loss; pass loss_fn")
 
+    def _cast_params(self, master, rng):
+        """fp32 master -> compute-dtype params, sharded. Under
+        bf16.stochastic_rounding the cast is unbiased (per-leaf PRNG
+        streams), removing round-to-nearest drift from the training
+        trajectory; returns (params, advanced rng)."""
+        if getattr(self, "_sr_cast", False):
+            from ..ops.quantizer import stochastic_round_bf16
+            rng, k = jax.random.split(rng)
+            leaves, treedef = jax.tree_util.tree_flatten(master)
+            keys = jax.random.split(k, len(leaves))
+            params = jax.tree_util.tree_unflatten(
+                treedef, [stochastic_round_bf16(l, kk)
+                          for l, kk in zip(leaves, keys)])
+        else:
+            params = _cast_tree(master, self.compute_dtype)
+        return (jax.lax.with_sharding_constraint(
+            params, self.param_shardings), rng)
+
     def _micro_grads(self, master, scale, batch, rng, params=None,
                      model_kwargs=None):
         if params is None:
             # compute-dtype copy of the master weights; callers that loop over
             # microbatches pass a pre-cast tree so the cast runs once per
             # train step, not once per micro step
-            params = _cast_tree(master, self.compute_dtype)
-            params = jax.lax.with_sharding_constraint(params, self.param_shardings)
+            params, rng = self._cast_params(master, rng)
 
         def scaled_loss(p):
             loss = self._loss_of(p, batch, rng, model_kwargs=model_kwargs)
@@ -772,8 +802,9 @@ class DeepSpeedEngine:
         def train_step(state, batches, extras):
             # fp32->compute cast hoisted out of the micro loop (the scan body
             # would otherwise re-cast the full master tree every micro step)
-            params = _cast_tree(state["master"], self.compute_dtype)
-            params = jax.lax.with_sharding_constraint(params, self.param_shardings)
+            params, step_rng = self._cast_params(state["master"],
+                                                 state["rng"])
+            state = dict(state, rng=step_rng)
 
             def body(carry, batch):
                 acc, loss_sum, rng = carry

@@ -123,6 +123,12 @@ class PipelineEngine:
         self.global_steps = 0
         self.micro_batches = self.config.gradient_accumulation_steps
         self.compute_dtype = self.config.compute_dtype
+        if self.config.bf16.stochastic_rounding:
+            raise NotImplementedError(
+                "bf16.stochastic_rounding is wired into the data-parallel "
+                "engine's master->compute cast; the pipeline engines cast "
+                "per stage without an rng stream yet — the knob would "
+                "silently not apply, so it rejects loudly here")
 
         # ZeRO inside the pipeline (reference: ZeRO-1 + the BF16 optimizer
         # compose with pipelines, runtime/pipe/engine.py:270

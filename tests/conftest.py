@@ -51,6 +51,46 @@ def pytest_collection_modifyitems(items):
             item.add_marker(pytest.mark.slow)
 
 
+# ---- per-module wall-clock budget (the slow tier grows every round; a
+# module that quietly balloons past the budget starts failing its TAIL
+# tests with an explicit budget message instead of making the whole tier
+# unrunnable unnoticed). Override with DS_TEST_MODULE_BUDGET_S; 0 disables.
+_MODULE_BUDGET_S = float(os.environ.get("DS_TEST_MODULE_BUDGET_S", "600"))
+_module_spent: dict = {}
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    import time
+    t0 = time.perf_counter()
+    try:
+        return (yield)
+    finally:
+        mod = item.module.__name__
+        _module_spent[mod] = (_module_spent.get(mod, 0.0)
+                              + time.perf_counter() - t0)
+
+
+def pytest_runtest_setup(item):
+    mod = item.module.__name__
+    spent = _module_spent.get(mod, 0.0)
+    if _MODULE_BUDGET_S and spent > _MODULE_BUDGET_S:
+        pytest.fail(
+            f"test module {mod} has spent {spent:.0f}s, over its "
+            f"{_MODULE_BUDGET_S:.0f}s wall-clock budget — split the "
+            f"module, shrink its cases, or raise "
+            f"DS_TEST_MODULE_BUDGET_S (0 disables)", pytrace=False)
+
+
+def pytest_terminal_summary(terminalreporter):
+    rows = sorted(_module_spent.items(), key=lambda kv: -kv[1])[:8]
+    if rows and rows[0][1] > 30:
+        terminalreporter.write_line("")
+        terminalreporter.write_line(
+            f"slowest modules (budget {_MODULE_BUDGET_S:.0f}s each): "
+            + ", ".join(f"{m}={t:.0f}s" for m, t in rows if t > 10))
+
+
 @pytest.fixture(autouse=True)
 def _reset_mesh():
     from deepspeed_tpu.parallel import mesh as mesh_lib

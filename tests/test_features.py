@@ -248,3 +248,64 @@ def test_moq_engine_trains_and_quantizes():
     leaf = next(l for l in jax.tree.leaves(master)
                 if hasattr(l, "ndim") and l.ndim >= 2)
     assert len(np.unique(np.asarray(leaf))) <= 256
+
+
+def test_stochastic_rounding_bf16_cast():
+    """bf16.stochastic_rounding (reference StochasticTransformerBuilder
+    training mode, ds_transformer_cuda.cpp:1031-1046): the fp32->bf16
+    cast must be grid-adjacent and unbiased, the engine must train with
+    it, and the knob must reject configs without bf16."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.quantizer import stochastic_round_bf16
+
+    # unbiasedness: mean over draws converges on the fp32 value; each
+    # draw is one of the two neighboring bf16 grid points
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(512,)) * 3,
+                    jnp.float32)
+    draws = np.stack([
+        np.asarray(stochastic_round_bf16(x, jax.random.PRNGKey(k)),
+                   np.float32) for k in range(128)])
+    lo = np.asarray(x.astype(jnp.bfloat16), np.float32)   # nearest grid
+    step = np.abs(np.spacing(lo.astype(np.dtype("float32")))) * 2 ** 16
+    assert np.all(np.abs(draws - np.asarray(x)[None]) <= 0.01 * np.abs(
+        np.asarray(x)[None]) + 1e-6)
+    mean_err = np.abs(draws.mean(0) - np.asarray(x))
+    near_err = np.abs(lo - np.asarray(x))
+    # the stochastic mean beats always-nearest on aggregate bias
+    assert mean_err.mean() < near_err.mean(), (mean_err.mean(),
+                                               near_err.mean())
+    # non-finite passthrough
+    bad = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+    out = np.asarray(stochastic_round_bf16(bad, jax.random.PRNGKey(0)),
+                     np.float32)
+    assert np.isinf(out[0]) and np.isinf(out[1]) and np.isnan(out[2])
+
+    # engine trains under SR; knob without bf16 rejects
+    import deepspeed_tpu as ds
+    from simple_model import SimpleModel, mse_loss
+    model = SimpleModel(hidden_dim=16)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 16)))["params"]
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params, loss_fn=mse_loss,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 1,
+                "bf16": {"enabled": True, "stochastic_rounding": True},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 10000})
+    W = np.random.default_rng(0).normal(size=(16, 16)).astype(np.float32)
+    xb = np.random.default_rng(1).normal(size=(64, 16)).astype(np.float32)
+    losses = [float(jax.device_get(engine.train_batch(
+        iter([{"input_ids": xb, "labels": xb @ W}])))) for _ in range(6)]
+    assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
+
+    import pytest
+    with pytest.raises(ValueError, match="stochastic_rounding"):
+        ds.initialize(
+            model=model, model_parameters=params, loss_fn=mse_loss,
+            config={"train_micro_batch_size_per_gpu": 8,
+                    "gradient_accumulation_steps": 1,
+                    "bf16": {"enabled": False, "stochastic_rounding": True},
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "steps_per_print": 10000})
