@@ -1,12 +1,17 @@
 """Namespace parity with the reference's ``deepspeed/ops/transformer``
-kernel package — on TPU the fused transformer building blocks are the
-Pallas kernels plus the fused cross-entropy; XLA fuses the rest of the
-block body, so there is no monolithic "DeepSpeedTransformerLayer" here.
+kernel package. The fused building blocks are the Pallas kernels plus
+the fused cross-entropy (XLA fuses the rest of the block body); the
+user-facing layer API (``DeepSpeedTransformerLayer``/``Config``,
+reference transformer.py:39,460) lives in ``transformer.py`` as a flax
+module with the same config surface.
 """
 
 from ..pallas import (bias_gelu, flash_attention, fused_softmax, gelu,
                       layer_norm, masked_softmax)
 from ..pallas.decode_attention import decode_attention
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer)
 
 __all__ = ["flash_attention", "decode_attention", "layer_norm",
-           "fused_softmax", "masked_softmax", "bias_gelu", "gelu"]
+           "fused_softmax", "masked_softmax", "bias_gelu", "gelu",
+           "DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer"]

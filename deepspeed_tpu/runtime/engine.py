@@ -430,6 +430,12 @@ class DeepSpeedEngine:
                     "progressive_layer_drop / quantize_training are not "
                     "wired into the 1-bit train path; disable them or use a "
                     "dense optimizer")
+            if self._sr_cast:
+                raise NotImplementedError(
+                    "bf16.stochastic_rounding with 1-bit optimizers: the "
+                    "OnebitRunner casts master->compute inside its fused "
+                    "step without an SR rng stream yet — the knob would "
+                    "silently not apply, so it rejects loudly")
             from .fp16.onebit.integration import OnebitRunner
             self._onebit = OnebitRunner(self, otype, dict(oc.params),
                                         model_parameters, rng)

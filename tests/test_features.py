@@ -268,7 +268,6 @@ def test_stochastic_rounding_bf16_cast():
         np.asarray(stochastic_round_bf16(x, jax.random.PRNGKey(k)),
                    np.float32) for k in range(128)])
     lo = np.asarray(x.astype(jnp.bfloat16), np.float32)   # nearest grid
-    step = np.abs(np.spacing(lo.astype(np.dtype("float32")))) * 2 ** 16
     assert np.all(np.abs(draws - np.asarray(x)[None]) <= 0.01 * np.abs(
         np.asarray(x)[None]) + 1e-6)
     mean_err = np.abs(draws.mean(0) - np.asarray(x))
