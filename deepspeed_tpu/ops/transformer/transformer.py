@@ -108,6 +108,13 @@ class DeepSpeedTransformerLayer(nn.Module):
         cfg = self.config
         if deterministic is None:
             deterministic = not cfg.training
+        if attention_mask is not None and attention_mask.ndim != 2:
+            raise ValueError(
+                f"attention_mask must be a [batch, seq] binary key-padding "
+                f"mask (1 = attend); got rank {attention_mask.ndim}. "
+                f"BERT-style extended additive masks ([B,1,1,S] with "
+                f"0/-10000) are a framework-internal encoding — pass the "
+                f"original binary mask instead")
         dt = cfg.compute_dtype
         sr_active = (cfg.stochastic_mode
                      and jnp.dtype(cfg.compute_dtype) == jnp.bfloat16)

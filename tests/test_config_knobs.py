@@ -188,17 +188,23 @@ def test_cpu_checkpointing_requires_remat():
         GPTConfig(cpu_checkpointing=True, remat=False)
 
 
-def test_cpu_checkpointing_engine_rejects_multichip():
-    """This XLA version's SPMD partitioner rejects host-offload placement
-    annotations on replicated residuals; the engine must say so loudly on a
-    >1-chip mesh instead of crashing inside the partitioner (single-chip
-    programs — e.g. the real-hardware bench — take the feature fine, as the
-    model-level parity test above shows)."""
+def test_cpu_checkpointing_engine_multichip_trains():
+    """Rounds 1-4 hard-rejected cpu_checkpointing on mesh.size > 1 (the
+    SPMD partitioner RET_CHECKed the host-offload placement annotations
+    under explicit out_shardings). The engine now constrains state
+    shardings in-program instead (engine._jit_state_step), so the SAME
+    config that used to raise must train; the deeper multi-mesh +
+    memory-savings evidence lives in
+    tests/test_engine.py::test_cpu_checkpointing_multichip."""
     model, params, ids, loss_fn = _tiny(remat=True)
-    with pytest.raises(ValueError, match="cpu_checkpointing on a multi"):
-        ds.initialize(model=model, model_parameters=params,
-                      config=_engine_cfg(ac={"cpu_checkpointing": True}),
-                      loss_fn=loss_fn)
+    engine, *_ = ds.initialize(
+        model=model, model_parameters=params,
+        config=_engine_cfg(ac={"cpu_checkpointing": True}),
+        loss_fn=loss_fn)
+    assert engine._ckpt_offload
+    loss = engine.train_batch(iter([{"input_ids": ids}]
+                                   * engine.gradient_accumulation_steps()))
+    assert np.isfinite(float(jax.device_get(loss)))
 
 
 # --------------------------------------------------- prefetch_bucket_size
@@ -303,3 +309,17 @@ def test_amp_rejected_and_untested_optimizer_gated():
     from simple_model import random_batch
     loss = float(jax.device_get(e.train_batch(iter([random_batch(8)]))))
     assert np.isfinite(loss)
+
+
+def test_stochastic_rounding_rejects_onebit():
+    """bf16.stochastic_rounding cannot apply on the 1-bit path (the
+    OnebitRunner casts master->compute inside its fused step) — the knob
+    must reject loudly, not silently round-to-nearest."""
+    model, params, ids, loss_fn = _tiny()
+    cfg = _engine_cfg()
+    cfg["bf16"] = {"enabled": True, "stochastic_rounding": True}
+    cfg["optimizer"] = {"type": "OneBitAdam",
+                       "params": {"lr": 1e-3, "freeze_step": 2}}
+    with pytest.raises(NotImplementedError, match="1-bit"):
+        ds.initialize(model=model, model_parameters=params, config=cfg,
+                      loss_fn=loss_fn)

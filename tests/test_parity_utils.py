@@ -350,7 +350,7 @@ def test_deepspeed_transformer_layer():
                                np.asarray(out2[:, :12]), atol=1e-5)
 
     # dropout: training draws differ per rng, eval is deterministic
-    cfgd, layerd, paramsd = build(hidden_dropout_ratio=0.2,
+    _, layerd, paramsd = build(hidden_dropout_ratio=0.2,
                                   attn_dropout_ratio=0.1, training=True)
     d1 = layerd.apply({"params": paramsd}, x, mask,
                       rngs={"dropout": jax.random.PRNGKey(1)})
@@ -360,7 +360,7 @@ def test_deepspeed_transformer_layer():
     assert not np.allclose(np.asarray(d1), np.asarray(d2))
 
     # stochastic_mode (bf16): per-rng draws differ, both near the fp32 out
-    cfgs, layers, paramss = build(stochastic_mode=True, bf16=True,
+    _, layers, paramss = build(stochastic_mode=True, bf16=True,
                                   training=True)
     s1 = layers.apply({"params": paramss}, x, mask,
                       rngs={"sr": jax.random.PRNGKey(1)})
@@ -375,6 +375,10 @@ def test_deepspeed_transformer_layer():
 
     # config validation
     import pytest
+    with pytest.raises(ValueError, match="binary key-padding"):
+        layer.apply({"params": params},
+                    x, jnp.zeros((2, 1, 1, 16), jnp.float32),
+                    deterministic=True)
     with pytest.raises(ValueError, match="divisible"):
         DeepSpeedTransformerConfig(hidden_size=65, heads=4)
     with pytest.raises(ValueError, match="required"):
@@ -382,7 +386,7 @@ def test_deepspeed_transformer_layer():
     # memory-toggle mapping: any of the three toggles remats the body —
     # same VALUES as the plain layer (recompute, not re-architecture),
     # and gradients still flow through the checkpoint
-    cfgr, layer_r, params_r = build(gelu_checkpoint=True)
+    cfgr, layer_r, _ = build(gelu_checkpoint=True)
     assert cfgr.remat and not cfg.remat
     out_r = layer_r.apply({"params": params}, x, mask, deterministic=True)
     np.testing.assert_allclose(np.asarray(out_r), np.asarray(out),
