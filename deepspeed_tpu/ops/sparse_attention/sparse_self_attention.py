@@ -239,10 +239,13 @@ class _CompiledLayout:
         self.cb, self.bq, self.bk = cb, bq, bk
         fq, fk = bq // cb, bk // cb
         nq, nk = nb // fq, nb // fk
+        # LUTs/tiles stay NUMPY: the layout cache outlives any one trace,
+        # and a jnp constant created inside a jitted first call would be a
+        # staged tracer — reusing it from the cache in the next trace
+        # raises UnexpectedTracerError. Call sites convert per trace.
         # fine tiles: [H, nq, nk, fq, fk]
-        self.fine_tiles = jnp.asarray(
-            fine.reshape(h, nq, fq, nk, fk).transpose(0, 1, 3, 2, 4)
-                .astype(np.int32))
+        self.fine_tiles = (fine.reshape(h, nq, fq, nk, fk)
+                           .transpose(0, 1, 3, 2, 4).astype(np.int32))
         coarse = fine.reshape(h, nq, fq, nk, fk).max(axis=(2, 4))
         # row-major LUT (fwd, dq): live k-tiles per (h, qi)
         self.lut_k, self.cnt_k = self._build_lut(coarse)
@@ -260,7 +263,7 @@ class _CompiledLayout:
             for i in range(n):
                 live = np.nonzero(coarse[hh, i])[0]
                 lut[hh, i, :len(live)] = live
-        return jnp.asarray(lut), jnp.asarray(counts)
+        return lut, counts
 
 
 def _sparse_fwd(q, k, v, layout: _CompiledLayout, causal, scale, kvm=None):

@@ -32,6 +32,7 @@ def _case(name, timeout=420):
     ("long_context", "long_context_"),
     ("max_params", "max_params_per_chip_B"),
     ("nvme_overlap", "nvme_swap_overlap_ratio"),
+    ("long_context_sparse", "long_context_sparse_"),
 ])
 def test_bench_case_produces_metric(name, metric_prefix):
     obj = _case(name)

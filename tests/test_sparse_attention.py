@@ -282,3 +282,34 @@ def test_block_sparse_matmul_modes():
         MatMul(np.zeros((1, 2, 2)), blk, "sdd")
     with pytest.raises(ValueError, match="do not match"):
         sdd(a[:, :, :blk], b)
+
+
+def test_sparse_attention_layout_cache_survives_retracing():
+    """The per-config layout cache is built on first use — which can be
+    INSIDE a jit trace (the engine path). Cached LUTs must be host arrays:
+    a staged-constant tracer cached from trace #1 crashes trace #2 with
+    UnexpectedTracerError (this was a real latent bug: eager tests passed
+    while any jitted engine using sparse attention died on re-trace)."""
+    from deepspeed_tpu.ops.sparse_attention import sparse_attention
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        FixedSparsityConfig)
+    sc = FixedSparsityConfig(num_heads=2, block=16)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 64, 2, 16)), jnp.float32)
+
+    @jax.jit
+    def f1(q):
+        return sparse_attention(q, q, q, sc, causal=True)
+
+    @jax.jit
+    def f2(q):  # second, distinct trace reusing sc's layout cache
+        return sparse_attention(q, q, q, sc, causal=True) * 2.0
+
+    a = f1(q)
+    b = f2(q)
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a) * 2.0,
+                               rtol=1e-6)
+    # grads through the cached layout in yet another trace
+    g = jax.jit(jax.grad(lambda x: jnp.sum(
+        sparse_attention(x, x, x, sc, causal=True))))(q)
+    assert np.isfinite(np.asarray(g)).all()
