@@ -8,6 +8,7 @@ distributed CPU backend)."""
 import json
 import os
 import sys
+import tempfile
 
 
 def main():
@@ -43,12 +44,31 @@ def main():
     stage_procs = [{d.process_index for d in row} for row in mesh_devs]
     assert stage_procs[0] == {0} and stage_procs[1] == {1}, stage_procs
 
+    def batches():
+        return iter([{"input_ids": ids[:4]}, {"input_ids": ids[4:]}])
+
     losses = []
     for _ in range(3):
-        loss = eng.train_batch(iter([{"input_ids": ids[:4]},
-                                     {"input_ids": ids[4:]}]))
-        losses.append(float(jax.device_get(loss)))
-    report = {"process": jax.process_index(), "losses": losses}
+        losses.append(float(jax.device_get(eng.train_batch(batches()))))
+
+    # distributed checkpoint round-trip: every process writes its own
+    # pp-shards; a FRESH engine on the same 2-process mesh restores and
+    # continues with the exact trajectory the original engine would take
+    # default must be DETERMINISTIC across the two processes (they share
+    # the coordinator port, not a tmpdir)
+    port = os.environ.get("COORDINATOR_ADDRESS", "0:0").rsplit(":", 1)[-1]
+    ckpt_dir = os.environ.get(
+        "PIPE_CKPT_DIR",
+        os.path.join(tempfile.gettempdir(), f"pipe_ckpt_{port}"))
+    eng.save_checkpoint(ckpt_dir, tag="step3")
+    cont = float(jax.device_get(eng.train_batch(batches())))
+    eng2 = GPipeSpmdEngine(gpt_pipe_spec(cfg), params, num_stages=2,
+                           micro_batches=2, dp=4, lr=1e-3, remat=False)
+    eng2.load_checkpoint(ckpt_dir)
+    assert eng2.step_count == 3, eng2.step_count
+    resumed = float(jax.device_get(eng2.train_batch(batches())))
+    report = {"process": jax.process_index(), "losses": losses,
+              "cont": cont, "resumed": resumed}
     print("REPORT " + json.dumps(report), flush=True)
 
 

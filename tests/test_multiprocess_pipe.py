@@ -15,8 +15,13 @@ import numpy as np
 from mp_harness import launch_workers
 
 
-def test_two_process_pipeline_matches_single_process():
-    outs = launch_workers("multiproc_pipe_worker.py", port=29781)
+def test_two_process_pipeline_matches_single_process(tmp_path):
+    import os
+    os.environ["PIPE_CKPT_DIR"] = str(tmp_path / "pipe_ckpt")
+    try:
+        outs = launch_workers("multiproc_pipe_worker.py", port=29781)
+    finally:
+        os.environ.pop("PIPE_CKPT_DIR", None)
     reports = {}
     for rc, out in outs:
         assert rc == 0, out[-2000:]
@@ -27,6 +32,10 @@ def test_two_process_pipeline_matches_single_process():
     # both processes observe the identical pipelined loss trajectory
     np.testing.assert_allclose(reports[0]["losses"], reports[1]["losses"],
                                rtol=0)
+    # distributed checkpoint round-trip: the restored engine's next step
+    # equals the original engine's next step, on both processes
+    for rep in reports.values():
+        np.testing.assert_allclose(rep["resumed"], rep["cont"], rtol=1e-6)
 
     # single-process same pipeline (8 virtual devices, pp2xdp4)
     import jax
