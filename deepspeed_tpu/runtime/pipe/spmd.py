@@ -267,8 +267,8 @@ class GPipeSpmdEngine:
     def __init__(self, spec: StackedPipeSpec, params, *, num_stages: int,
                  micro_batches: int, dp: int = 1, lr: float = 1e-3,
                  betas=(0.9, 0.999), eps: float = 1e-8,
-                 weight_decay: float = 0.0, remat: bool = True,
-                 mesh: Optional[Mesh] = None):
+                 weight_decay: float = 0.0, gradient_clipping: float = 0.0,
+                 remat: bool = True, mesh: Optional[Mesh] = None):
         if micro_batches < 1:
             raise ValueError("micro_batches must be >= 1")
         self.spec = spec
@@ -308,6 +308,7 @@ class GPipeSpmdEngine:
         # the runtime's fused AdamW (ops/adam.py): mu/nu inherit each
         # master leaf's sharding, so blocks' optimizer state is pp-sharded
         from ...ops.adam import fused_adam
+        self._clip = float(gradient_clipping)
         self._tx = fused_adam(learning_rate=lr, betas=betas, eps=eps,
                               weight_decay=weight_decay, adam_w_mode=True)
         self.opt_state = self._tx.init(self.master)
@@ -399,9 +400,19 @@ class GPipeSpmdEngine:
             loss, grads = jax.value_and_grad(self._loss, argnums=(0, 1))(
                 self._cast(master["blocks"], self._blocks_dtype),
                 self._cast(master["rest"], self._rest_dtype), ids3)
-            gb, gr = grads
-            updates, new_state = self._tx.update(
-                {"blocks": gb, "rest": gr}, opt_state, master)
+            grads = {"blocks": grads[0], "rest": grads[1]}
+            if self._clip > 0:
+                # global-norm clip before the moments, with the SAME norm
+                # helper and factor formula as the data-parallel engine
+                # (engine.py _apply_update) so one gradient_clipping value
+                # means one thing framework-wide
+                from ..engine import _global_norm
+                gn = _global_norm(grads)
+                factor = self._clip / jnp.maximum(gn, self._clip)
+                grads = jax.tree.map(
+                    lambda g: (g.astype(jnp.float32) * factor).astype(
+                        g.dtype), grads)
+            updates, new_state = self._tx.update(grads, opt_state, master)
             return loss, optax.apply_updates(master, updates), new_state
 
         sh_of = lambda t: jax.tree.map(lambda a: a.sharding, t)
