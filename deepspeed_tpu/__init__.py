@@ -76,3 +76,46 @@ def add_config_arguments(parser):
 def argparse_suppress():
     import argparse
     return argparse.SUPPRESS
+
+
+# Reference top-level names (deepspeed/__init__.py eagerly exports engine/
+# layer/config classes). Resolved lazily (PEP 562): `from deepspeed_tpu
+# import DeepSpeedTransformerLayer` works for ported code without paying
+# the heavy imports at package import time.
+_LAZY_EXPORTS = {
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine",
+                       "PipelineEngine"),
+    "GPipeSpmdEngine": ("deepspeed_tpu.runtime.pipe.spmd",
+                        "GPipeSpmdEngine"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module",
+                       "PipelineModule"),
+    "InferenceEngine": ("deepspeed_tpu.inference.engine",
+                        "InferenceEngine"),
+    "DeepSpeedConfigError": ("deepspeed_tpu.runtime.config",
+                             "DeepSpeedConfigError"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",
+                                  "DeepSpeedTransformerLayer"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",
+                                   "DeepSpeedTransformerConfig"),
+    "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
+    "init_distributed": ("deepspeed_tpu.comm.comm", "init_distributed"),
+    "module_inject": ("deepspeed_tpu.module_inject", None),
+    "ops": ("deepspeed_tpu.ops", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY_EXPORTS.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    module = importlib.import_module(entry[0])
+    value = module if entry[1] is None else getattr(module, entry[1])
+    globals()[name] = value      # cache for subsequent lookups
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

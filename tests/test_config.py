@@ -152,3 +152,19 @@ def test_commented_config_file_parses(tmp_path):
     from deepspeed_tpu.runtime.config import DeepSpeedConfigError
     with pytest.raises(DeepSpeedConfigError, match="could not parse"):
         DeepSpeedConfig(str(p3))
+
+
+def test_reference_style_top_level_imports():
+    """Ported reference code does `from deepspeed import DeepSpeedEngine,
+    DeepSpeedTransformerLayer, ...` — the analogous names resolve at our
+    top level (lazily, PEP 562), and unknown names still raise."""
+    import deepspeed_tpu as ds
+    for name in ("DeepSpeedEngine", "PipelineEngine", "PipelineModule",
+                 "InferenceEngine", "DeepSpeedConfigError",
+                 "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+                 "GPipeSpmdEngine", "log_dist", "init_distributed",
+                 "module_inject", "ops"):
+        assert getattr(ds, name) is not None, name
+        assert name in dir(ds)
+    with pytest.raises(AttributeError):
+        ds.definitely_not_an_export
