@@ -12,7 +12,9 @@ Public API mirrors the reference's ``deepspeed/__init__.py``:
 from .version import __version__  # noqa: F401
 
 from . import comm  # noqa: F401
-from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.config import (DeepSpeedConfig,  # noqa: F401
+                             DeepSpeedConfigError)
+from .comm.comm import init_distributed  # noqa: F401
 # zero.Init analogue: abstract/sharded/streamed large-model construction
 # (reference zero/partition_parameters.py:529) — see
 # runtime/zero/partition_params.py for the three materialization paths
@@ -92,16 +94,15 @@ _LAZY_EXPORTS = {
                        "PipelineModule"),
     "InferenceEngine": ("deepspeed_tpu.inference.engine",
                         "InferenceEngine"),
-    "DeepSpeedConfigError": ("deepspeed_tpu.runtime.config",
-                             "DeepSpeedConfigError"),
     "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",
                                   "DeepSpeedTransformerLayer"),
     "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",
                                    "DeepSpeedTransformerConfig"),
     "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
-    "init_distributed": ("deepspeed_tpu.comm.comm", "init_distributed"),
     "module_inject": ("deepspeed_tpu.module_inject", None),
     "ops": ("deepspeed_tpu.ops", None),
+    "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing",
+                      None),
 }
 
 

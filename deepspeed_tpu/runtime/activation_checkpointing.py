@@ -1,0 +1,91 @@
+"""Function-style activation checkpointing API.
+
+Reference analogue: ``deepspeed/runtime/activation_checkpointing/
+checkpointing.py`` — ``configure()`` (:825), ``checkpoint(function,
+*args)`` (:743), ``is_configured()`` (:907), ``reset()`` (:768), exported
+as ``deepspeed.checkpointing``. Users wrap arbitrary blocks:
+
+    import deepspeed_tpu as ds
+    ds.checkpointing.configure(None, checkpoint_in_cpu=True)
+    y = ds.checkpointing.checkpoint(block_fn, x)
+
+TPU mapping: ``checkpoint`` is ``jax.checkpoint`` with the policy the
+configuration implies — plain remat (recompute everything) by default,
+host-offloaded carries for ``checkpoint_in_cpu`` (the engine's
+cpu_checkpointing machinery), and ``partition_activations`` is a no-op
+HERE because it is a sharding property of the saved value, applied by the
+model's sharding constraints (``models/gpt.py tp_shard_sequence``) — the
+config flag on the ENGINE wires it (runtime/engine.py). Knobs with no
+honest mapping (contiguous_memory_optimization, synchronize, profile)
+reject loudly, exactly like the engine config path. The CUDA RNG tracker
+APIs have no analogue: jax PRNG keys are explicit values, so there is no
+global RNG state to fork/restore around recompute — recomputation with
+the same keys is deterministic by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+_config: Optional[Dict[str, Any]] = None
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Record the checkpointing policy (reference checkpointing.py:825).
+    ``mpu_``/``deepspeed_config`` accepted for signature parity."""
+    bad = []
+    if contiguous_checkpointing:
+        bad.append("contiguous_checkpointing (XLA owns buffer layout; "
+                   "there is no manual contiguous arena to fill)")
+    if synchronize:
+        bad.append("synchronize (one jitted program has no per-checkpoint "
+                   "host sync points)")
+    if profile:
+        bad.append("profile (use wall_clock_breakdown / the flops "
+                   "profiler)")
+    if bad:
+        raise ValueError("checkpointing.configure cannot honor: "
+                         + "; ".join(bad))
+    global _config
+    _config = {
+        "partition_activations": bool(partition_activations),
+        "num_checkpoints": num_checkpoints,
+        "checkpoint_in_cpu": bool(checkpoint_in_cpu),
+    }
+
+
+def is_configured() -> bool:
+    return _config is not None
+
+
+def reset() -> None:
+    """Reference :768 frees per-iteration buffers; here there are none —
+    reset just clears the recorded configuration."""
+    global _config
+    _config = None
+
+
+def _policy():
+    if _config and _config["checkpoint_in_cpu"]:
+        from jax.ad_checkpoint import checkpoint_name  # noqa: F401
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["ds_block_carry"],
+            offload_src="device", offload_dst="pinned_host")
+    return None   # recompute everything (the reference's default mode)
+
+
+def checkpoint(function, *args):
+    """Run ``function(*args)`` under rematerialization: nothing (or only
+    host-offloaded named values) is kept for backward; the forward is
+    recomputed during the VJP (reference checkpointing.py:743, minus the
+    RNG bookkeeping jax does not need)."""
+    policy = _policy()
+    fn = jax.checkpoint(function, policy=policy, prevent_cse=False) \
+        if policy is not None else jax.checkpoint(function,
+                                                  prevent_cse=False)
+    return fn(*args)
