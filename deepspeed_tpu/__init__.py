@@ -99,6 +99,8 @@ _LAZY_EXPORTS = {
     "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",
                                    "DeepSpeedTransformerConfig"),
     "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
+    "add_tuning_arguments": ("deepspeed_tpu.runtime.lr_schedules",
+                             "add_tuning_arguments"),
     "module_inject": ("deepspeed_tpu.module_inject", None),
     "ops": ("deepspeed_tpu.ops", None),
     "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing",

@@ -172,3 +172,102 @@ def build_lr_scheduler(sched_config, optimizer=None):
         raise ValueError(f"unknown scheduler {sched_config.type!r}; "
                          f"valid: {sorted(SCHEDULE_REGISTRY)}")
     return cls(optimizer, **sched_config.params)
+
+
+def _str2bool(v) -> bool:
+    """argparse `type=bool` treats ANY non-empty string (incl. 'False') as
+    True; reference launch scripts pass `false`/`true` literals."""
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "y"):
+        return True
+    if s in ("false", "0", "no", "n", ""):
+        return False
+    raise ValueError(f"expected a boolean, got {v!r}")
+
+
+def add_tuning_arguments(parser):
+    """Reference parity: the convergence-tuning argparse group
+    (reference lr_schedules.py add_tuning_arguments; exported at the
+    deepspeed top level). Flag vocabulary matches so reference launch
+    scripts parse unchanged; values feed the same schedules through
+    ``parse_arguments_to_schedule_config``."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training "
+                            f"(one of {sorted(SCHEDULE_REGISTRY)})")
+    # LR range test
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=_str2bool,
+                       default=False)
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=0.0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log")
+    group.add_argument("--total_num_steps", type=int, default=None,
+                       help="required by WarmupDecayLR (decay horizon)")
+    return parser
+
+
+def parse_arguments_to_schedule_config(args):
+    """Parsed tuning args -> the {"type", "params"} scheduler config
+    ``build_lr_scheduler`` consumes (None when --lr_schedule unset)."""
+    name = getattr(args, "lr_schedule", None)
+    if not name:
+        return None
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"--lr_schedule {name!r}: valid values are "
+                         f"{sorted(SCHEDULE_REGISTRY)}")
+    if name == "LRRangeTest":
+        params = {"lr_range_test_min_lr": args.lr_range_test_min_lr,
+                  "lr_range_test_step_rate": args.lr_range_test_step_rate,
+                  "lr_range_test_step_size": args.lr_range_test_step_size,
+                  "lr_range_test_staircase": args.lr_range_test_staircase}
+    elif name == "OneCycle":
+        params = {"cycle_min_lr": args.cycle_min_lr,
+                  "cycle_max_lr": args.cycle_max_lr,
+                  "decay_lr_rate": args.decay_lr_rate,
+                  "cycle_first_step_size": args.cycle_first_step_size,
+                  "cycle_first_stair_count": max(
+                      0, args.cycle_first_stair_count),
+                  "decay_step_size": args.decay_step_size,
+                  "cycle_min_mom": args.cycle_min_mom,
+                  "cycle_max_mom": args.cycle_max_mom,
+                  "decay_mom_rate": args.decay_mom_rate}
+        if args.cycle_second_step_size >= 0:
+            params["cycle_second_step_size"] = args.cycle_second_step_size
+        if args.cycle_second_stair_count >= 0:
+            params["cycle_second_stair_count"] = \
+                args.cycle_second_stair_count
+    else:   # WarmupLR / WarmupDecayLR
+        params = {"warmup_min_lr": args.warmup_min_lr,
+                  "warmup_max_lr": args.warmup_max_lr,
+                  "warmup_num_steps": args.warmup_num_steps,
+                  "warmup_type": args.warmup_type}
+        if name == "WarmupDecayLR":
+            total = getattr(args, "total_num_steps", None)
+            if total is None:
+                raise ValueError(
+                    "--lr_schedule WarmupDecayLR requires "
+                    "--total_num_steps (the decay horizon; the reference "
+                    "treats it as required too)")
+            params["total_num_steps"] = total
+    from .config import SchedulerConfig
+    return SchedulerConfig(type=name, params=params)

@@ -78,3 +78,61 @@ def test_registry():
     assert isinstance(s, WarmupLR)
     with pytest.raises(ValueError):
         build_lr_scheduler(SchedulerConfig(type="Nope"))
+
+
+def test_add_tuning_arguments_roundtrip():
+    """Reference-parity argparse group (deepspeed.add_tuning_arguments):
+    reference launch-script flags parse unchanged and produce a working
+    scheduler through parse_arguments_to_schedule_config."""
+    import argparse
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.runtime.lr_schedules import (
+        build_lr_scheduler, parse_arguments_to_schedule_config)
+
+    parser = argparse.ArgumentParser()
+    ds.add_tuning_arguments(parser)
+    args = parser.parse_args([
+        "--lr_schedule", "WarmupLR", "--warmup_min_lr", "0.0",
+        "--warmup_max_lr", "0.01", "--warmup_num_steps", "10"])
+    cfg = parse_arguments_to_schedule_config(args)
+    sched = build_lr_scheduler(cfg)
+    assert abs(float(sched.lr_at(10)) - 0.01) < 1e-6
+    assert float(sched.lr_at(0)) < 0.01
+
+    # unset schedule -> None; bad name -> loud error
+    none_args = parser.parse_args([])
+    assert parse_arguments_to_schedule_config(none_args) is None
+    bad = parser.parse_args(["--lr_schedule", "Nope"])
+    with pytest.raises(ValueError, match="Nope"):
+        parse_arguments_to_schedule_config(bad)
+    # WarmupDecayLR requires the decay horizon; fabricating one silently
+    # would decay to zero mid-run
+    wd = parser.parse_args(["--lr_schedule", "WarmupDecayLR"])
+    with pytest.raises(ValueError, match="total_num_steps"):
+        parse_arguments_to_schedule_config(wd)
+    # boolean flags accept reference-script 'false' literals
+    st = parser.parse_args(["--lr_schedule", "LRRangeTest",
+                            "--lr_range_test_staircase", "false"])
+    assert parse_arguments_to_schedule_config(
+        st).params["lr_range_test_staircase"] is False
+    # warmup_type and the full OneCycle flag set are forwarded
+    lin = parser.parse_args(["--lr_schedule", "WarmupLR",
+                             "--warmup_type", "linear"])
+    assert parse_arguments_to_schedule_config(
+        lin).params["warmup_type"] == "linear"
+    oc_full = parser.parse_args(["--lr_schedule", "OneCycle",
+                                 "--decay_lr_rate", "0.5",
+                                 "--cycle_second_step_size", "4000",
+                                 "--cycle_max_mom", "0.95"])
+    p = parse_arguments_to_schedule_config(oc_full).params
+    assert p["decay_lr_rate"] == 0.5
+    assert p["cycle_second_step_size"] == 4000
+    assert p["cycle_max_mom"] == 0.95
+
+    # OneCycle and LRRangeTest flag paths construct too
+    oc = parser.parse_args(["--lr_schedule", "OneCycle",
+                            "--cycle_min_lr", "0.001",
+                            "--cycle_max_lr", "0.1"])
+    assert build_lr_scheduler(parse_arguments_to_schedule_config(oc))
+    rt = parser.parse_args(["--lr_schedule", "LRRangeTest"])
+    assert build_lr_scheduler(parse_arguments_to_schedule_config(rt))
