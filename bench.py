@@ -625,11 +625,14 @@ def main():
         print(json.dumps(CASE_FNS[args.case]()), flush=True)
         return 0
 
-    t_start = time.time()
+    # monotonic: the bench box's wall clock has been observed to step
+    # (virtualized), and a backward step under time.time() would extend
+    # the budget indefinitely
+    t_start = time.monotonic()
     case_timeout = float(os.environ.get("BENCH_CASE_TIMEOUT", "1800"))
     budget = float(os.environ.get("BENCH_BUDGET_S", "7200"))
     tiny = os.environ.get("BENCH_TINY") == "1"
-    remaining = lambda: budget - (time.time() - t_start)
+    remaining = lambda: budget - (time.monotonic() - t_start)
     asked = [c for c in os.environ.get(
         "BENCH_CASES", ",".join(ALL_CASES)).split(",") if c]
     cases = [c for c in asked if c in CASE_FNS]
@@ -690,10 +693,10 @@ def main():
                 break
         if not chip_ok:
             pt = min(next(ladder), remaining())
-            t0 = time.time()
+            t0 = time.monotonic()
             info, probe_err = _probe(pt)
             state["probe_log"].append(
-                {"timeout_s": pt, "took_s": round(time.time() - t0, 1),
+                {"timeout_s": pt, "took_s": round(time.monotonic() - t0, 1),
                  "ok": info is not None,
                  **({} if info else {"err": str(probe_err)[:200]})})
             _persist(state)

@@ -135,13 +135,26 @@ class OneCycle(_Schedule):
         self.decay_mom_rate = decay_mom_rate
         self.last_step = max(0, last_batch_iteration)
         self.total_size = self.first + self.second
+        # staircase ramps (reference cycle_first/second_stair_count): the
+        # up/down legs quantize into this many flat stairs; 0 = continuous
+        self.first_stairs = max(0, cycle_first_stair_count)
+        self.second_stairs = (self.first_stairs
+                              if cycle_second_stair_count is None
+                              else max(0, cycle_second_stair_count))
+
+    def _frac(self, step):
+        up = jnp.clip(step / self.first, 0.0, 1.0)
+        down = jnp.clip((step - self.first) / self.second, 0.0, 1.0)
+        if self.first_stairs:
+            up = jnp.floor(up * self.first_stairs) / self.first_stairs
+        if self.second_stairs:
+            down = jnp.floor(down * self.second_stairs) / self.second_stairs
+        return jnp.where(step <= self.first, up, 1.0 - down)
 
     def lr_at(self, step):
         step = jnp.asarray(step, jnp.float32)
         in_cycle = step <= self.total_size
-        up = jnp.clip(step / self.first, 0.0, 1.0)
-        down = jnp.clip((step - self.first) / self.second, 0.0, 1.0)
-        frac = jnp.where(step <= self.first, up, 1.0 - down)
+        frac = self._frac(step)
         cyc_lr = self.min_lr + (self.max_lr - self.min_lr) * frac
         decay_steps = jnp.maximum(step - self.total_size, 0.0) / self.decay_step_size
         dec_lr = self.min_lr / (1.0 + decay_steps * self.decay_lr_rate) \
@@ -150,9 +163,7 @@ class OneCycle(_Schedule):
 
     def mom_at(self, step):
         step = jnp.asarray(step, jnp.float32)
-        up = jnp.clip(step / self.first, 0.0, 1.0)
-        down = jnp.clip((step - self.first) / self.second, 0.0, 1.0)
-        frac = jnp.where(step <= self.first, up, 1.0 - down)
+        frac = self._frac(step)
         return self.max_mom - (self.max_mom - self.min_mom) * frac
 
 
@@ -198,29 +209,35 @@ def add_tuning_arguments(parser):
     group.add_argument("--lr_schedule", type=str, default=None,
                        help="LR schedule for training "
                             f"(one of {sorted(SCHEDULE_REGISTRY)})")
+    # Unset flags stay None and are NOT forwarded, so the scheduler CLASS
+    # defaults apply identically on the CLI and JSON-config paths (explicit
+    # per-path argparse defaults would make the same schedule name ramp
+    # differently depending on entry point)
     # LR range test
-    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
-    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
-    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_min_lr", type=float, default=None)
+    group.add_argument("--lr_range_test_step_rate", type=float,
+                       default=None)
+    group.add_argument("--lr_range_test_step_size", type=int, default=None)
     group.add_argument("--lr_range_test_staircase", type=_str2bool,
-                       default=False)
+                       default=None)
     # OneCycle
-    group.add_argument("--cycle_first_step_size", type=int, default=1000)
-    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
-    group.add_argument("--cycle_second_step_size", type=int, default=-1)
-    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
-    group.add_argument("--decay_step_size", type=int, default=1000)
-    group.add_argument("--cycle_min_lr", type=float, default=0.01)
-    group.add_argument("--cycle_max_lr", type=float, default=0.1)
-    group.add_argument("--decay_lr_rate", type=float, default=0.0)
-    group.add_argument("--cycle_min_mom", type=float, default=0.8)
-    group.add_argument("--cycle_max_mom", type=float, default=0.9)
-    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    group.add_argument("--cycle_first_step_size", type=int, default=None)
+    group.add_argument("--cycle_first_stair_count", type=int, default=None)
+    group.add_argument("--cycle_second_step_size", type=int, default=None)
+    group.add_argument("--cycle_second_stair_count", type=int,
+                       default=None)
+    group.add_argument("--decay_step_size", type=int, default=None)
+    group.add_argument("--cycle_min_lr", type=float, default=None)
+    group.add_argument("--cycle_max_lr", type=float, default=None)
+    group.add_argument("--decay_lr_rate", type=float, default=None)
+    group.add_argument("--cycle_min_mom", type=float, default=None)
+    group.add_argument("--cycle_max_mom", type=float, default=None)
+    group.add_argument("--decay_mom_rate", type=float, default=None)
     # Warmup
-    group.add_argument("--warmup_min_lr", type=float, default=0.0)
-    group.add_argument("--warmup_max_lr", type=float, default=0.001)
-    group.add_argument("--warmup_num_steps", type=int, default=1000)
-    group.add_argument("--warmup_type", type=str, default="log")
+    group.add_argument("--warmup_min_lr", type=float, default=None)
+    group.add_argument("--warmup_max_lr", type=float, default=None)
+    group.add_argument("--warmup_num_steps", type=int, default=None)
+    group.add_argument("--warmup_type", type=str, default=None)
     group.add_argument("--total_num_steps", type=int, default=None,
                        help="required by WarmupDecayLR (decay horizon)")
     return parser
@@ -235,39 +252,25 @@ def parse_arguments_to_schedule_config(args):
     if name not in SCHEDULE_REGISTRY:
         raise ValueError(f"--lr_schedule {name!r}: valid values are "
                          f"{sorted(SCHEDULE_REGISTRY)}")
-    if name == "LRRangeTest":
-        params = {"lr_range_test_min_lr": args.lr_range_test_min_lr,
-                  "lr_range_test_step_rate": args.lr_range_test_step_rate,
-                  "lr_range_test_step_size": args.lr_range_test_step_size,
-                  "lr_range_test_staircase": args.lr_range_test_staircase}
-    elif name == "OneCycle":
-        params = {"cycle_min_lr": args.cycle_min_lr,
-                  "cycle_max_lr": args.cycle_max_lr,
-                  "decay_lr_rate": args.decay_lr_rate,
-                  "cycle_first_step_size": args.cycle_first_step_size,
-                  "cycle_first_stair_count": max(
-                      0, args.cycle_first_stair_count),
-                  "decay_step_size": args.decay_step_size,
-                  "cycle_min_mom": args.cycle_min_mom,
-                  "cycle_max_mom": args.cycle_max_mom,
-                  "decay_mom_rate": args.decay_mom_rate}
-        if args.cycle_second_step_size >= 0:
-            params["cycle_second_step_size"] = args.cycle_second_step_size
-        if args.cycle_second_stair_count >= 0:
-            params["cycle_second_stair_count"] = \
-                args.cycle_second_stair_count
-    else:   # WarmupLR / WarmupDecayLR
-        params = {"warmup_min_lr": args.warmup_min_lr,
-                  "warmup_max_lr": args.warmup_max_lr,
-                  "warmup_num_steps": args.warmup_num_steps,
-                  "warmup_type": args.warmup_type}
-        if name == "WarmupDecayLR":
-            total = getattr(args, "total_num_steps", None)
-            if total is None:
-                raise ValueError(
-                    "--lr_schedule WarmupDecayLR requires "
-                    "--total_num_steps (the decay horizon; the reference "
-                    "treats it as required too)")
-            params["total_num_steps"] = total
+    flag_names = {
+        "LRRangeTest": ("lr_range_test_min_lr", "lr_range_test_step_rate",
+                        "lr_range_test_step_size",
+                        "lr_range_test_staircase"),
+        "OneCycle": ("cycle_min_lr", "cycle_max_lr", "decay_lr_rate",
+                     "cycle_first_step_size", "cycle_second_step_size",
+                     "cycle_first_stair_count", "cycle_second_stair_count",
+                     "decay_step_size", "cycle_min_mom", "cycle_max_mom",
+                     "decay_mom_rate"),
+        "WarmupLR": ("warmup_min_lr", "warmup_max_lr", "warmup_num_steps",
+                     "warmup_type"),
+    }
+    flag_names["WarmupDecayLR"] = flag_names["WarmupLR"] + (
+        "total_num_steps",)
+    params = {k: getattr(args, k) for k in flag_names[name]
+              if getattr(args, k, None) is not None}
+    if name == "WarmupDecayLR" and "total_num_steps" not in params:
+        raise ValueError(
+            "--lr_schedule WarmupDecayLR requires --total_num_steps (the "
+            "decay horizon; the reference treats it as required too)")
     from .config import SchedulerConfig
     return SchedulerConfig(type=name, params=params)

@@ -115,6 +115,22 @@ def test_add_tuning_arguments_roundtrip():
                             "--lr_range_test_staircase", "false"])
     assert parse_arguments_to_schedule_config(
         st).params["lr_range_test_staircase"] is False
+    # unset flags are NOT forwarded: the CLI path and the JSON-config path
+    # share the scheduler CLASS defaults (no per-path default divergence)
+    bare = parse_arguments_to_schedule_config(
+        parser.parse_args(["--lr_schedule", "LRRangeTest"]))
+    assert bare.params == {}, bare.params
+    from deepspeed_tpu.runtime.lr_schedules import LRRangeTest, OneCycle
+    assert float(build_lr_scheduler(bare).lr_at(0)) == \
+        float(LRRangeTest().lr_at(0))
+    # OneCycle stair counts actually shape the ramp (staircase quantizes)
+    stair = OneCycle(cycle_first_step_size=100, cycle_first_stair_count=4,
+                     cycle_min_lr=0.0, cycle_max_lr=1.0)
+    smooth = OneCycle(cycle_first_step_size=100, cycle_min_lr=0.0,
+                      cycle_max_lr=1.0)
+    assert float(stair.lr_at(30)) == 0.25     # floor(1.2)/4
+    assert abs(float(smooth.lr_at(30)) - 0.30) < 1e-6
+    assert float(stair.lr_at(99)) == 0.75     # last stair before the top
     # warmup_type and the full OneCycle flag set are forwarded
     lin = parser.parse_args(["--lr_schedule", "WarmupLR",
                              "--warmup_type", "linear"])
