@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Tier-1 verification: the exact quick-tier test command from ROADMAP.md.
+# Prints DOTS_PASSED=<count of passing-test dots> and exits with pytest's
+# status, so CI and humans run the identical gate.
+#
+# Usage: bin/tier1.sh        (from the repo root, or anywhere — it cd's)
+
+cd "$(dirname "$0")/.." || exit 1
+
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

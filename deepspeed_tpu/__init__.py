@@ -94,6 +94,8 @@ _LAZY_EXPORTS = {
                        "PipelineModule"),
     "InferenceEngine": ("deepspeed_tpu.inference.engine",
                         "InferenceEngine"),
+    "ServingEngine": ("deepspeed_tpu.serving.engine", "ServingEngine"),
+    "serving": ("deepspeed_tpu.serving", None),
     "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer",
                                   "DeepSpeedTransformerLayer"),
     "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer",

@@ -38,6 +38,24 @@ from ..utils.logging import logger
 _sp_drop_warned = set()
 
 
+def _kv_write(cache, kv, cur):
+    """Write this step's k/v into the cache at sequence offset ``cur``.
+    ``cur`` scalar: the whole batch sits at one fill (single-stream
+    generate) — one dynamic_update_slice. ``cur`` [b]: every row has its
+    own fill (slotted continuous-batching decode, serving/engine.py) — a
+    vmapped per-row update. An out-of-range per-row offset clamps to the
+    last position (XLA semantics); serving relies on that only for slots
+    already retired, whose rows are fully overwritten at the next insert."""
+    if jnp.ndim(cur) == 0:
+        start = (0, cur) + (0,) * (cache.ndim - 2)
+        return jax.lax.dynamic_update_slice(cache, kv, start)
+
+    def row(c, x, p):
+        return jax.lax.dynamic_update_slice(c, x, (p,) + (0,) * (c.ndim - 1))
+
+    return jax.vmap(row)(cache, kv, cur)
+
+
 def _sp_constraint(x, spec_parts):
     """Ulysses sharding constraint against the global mesh (no-op when the
     mesh's sp axis is 1). Axes the shape doesn't divide are dropped —
@@ -363,7 +381,13 @@ class SelfAttention(nn.Module):
         ``cache_index`` and attends over the filled prefix. Under the
         Pallas decode impl the cache lives FLAT [b, S, h*d]: XLA lane-pads
         a trailing d=64 dim (to 128), so a rank-4 cache would pay a
-        full-cache relayout copy on every kernel call."""
+        full-cache relayout copy on every kernel call.
+
+        ``cache_index`` may be a scalar (every row at the same fill — the
+        single-stream generate path) or a [b] vector (per-slot fills — the
+        continuous-batching serving arena, serving/kv_cache.py): writes and
+        masks are elementwise per row in the vector case, and positions
+        passed by the caller must equal the per-row fills."""
         cfg = self.cfg
         b, s, h, d = q.shape
         impl = cfg.decode_impl
@@ -383,12 +407,12 @@ class SelfAttention(nn.Module):
                                (b, cfg.max_seq_len, h * d), cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
                                (b, cfg.max_seq_len, h * d), cfg.dtype)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype).reshape(b, s, h * d),
-                (0, cur, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype).reshape(b, s, h * d),
-                (0, cur, 0))
+            ck.value = _kv_write(ck.value,
+                                 k.astype(cfg.dtype).reshape(b, s, h * d),
+                                 cur)
+            cv.value = _kv_write(cv.value,
+                                 v.astype(cfg.dtype).reshape(b, s, h * d),
+                                 cur)
             idx.value = cur + s
             from ..ops.pallas.decode_attention import decode_attention
             if s == 1:
@@ -404,10 +428,8 @@ class SelfAttention(nn.Module):
                            (b, cfg.max_seq_len, h, d), cfg.dtype)
         cv = self.variable("cache", "cached_value", jnp.zeros,
                            (b, cfg.max_seq_len, h, d), cfg.dtype)
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+        ck.value = _kv_write(ck.value, k.astype(cfg.dtype), cur)
+        cv.value = _kv_write(cv.value, v.astype(cfg.dtype), cur)
         idx.value = cur + s
         if s == 1 and self.window is None and impl == "pallas":
             from ..ops.pallas.decode_attention import decode_attention

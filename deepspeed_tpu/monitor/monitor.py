@@ -2,10 +2,15 @@
 ``MonitorMaster``:24 dispatching to TensorBoard/W&B/CSV writers, rank-0 only).
 
 Events are ``(label, value, global_sample_count)`` tuples, same contract as
-the reference's ``write_events`` (monitor/monitor.py:45)."""
+the reference's ``write_events`` (monitor/monitor.py:45).
+
+Lifecycle: every writer has an explicit ``close()`` and the master
+registers a flush-and-close atexit hook, so short-lived runs (serving
+benchmarks, smoke tests) never lose buffered trailing rows."""
 
 from __future__ import annotations
 
+import atexit
 import csv
 import os
 from typing import List, Optional, Tuple
@@ -22,22 +27,45 @@ class _BaseWriter:
     def flush(self):
         pass
 
+    def close(self):
+        self.flush()
+
 
 class CsvWriter(_BaseWriter):
+    """One CSV per label. File handles stay open across write_events calls
+    (a serving loop emits every few decode steps — reopening per event is
+    measurable overhead); ``flush``/``close`` push buffered rows out."""
+
     def __init__(self, cfg):
         self.out_dir = os.path.join(cfg.output_path or "csv_monitor", cfg.job_name)
         os.makedirs(self.out_dir, exist_ok=True)
-        self._files = {}
+        self._files = {}         # label -> (file handle, csv writer)
+
+    def _writer(self, label):
+        entry = self._files.get(label)
+        if entry is None:
+            fname = os.path.join(self.out_dir,
+                                 label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            fh = open(fname, "a", newline="")
+            w = csv.writer(fh)
+            if new:
+                w.writerow(["sample", label])
+            entry = self._files[label] = (fh, w)
+        return entry[1]
 
     def write_events(self, events):
         for label, value, sample in events:
-            fname = os.path.join(self.out_dir, label.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as fh:
-                w = csv.writer(fh)
-                if new:
-                    w.writerow(["sample", label])
-                w.writerow([int(sample), float(value)])
+            self._writer(label).writerow([int(sample), float(value)])
+
+    def flush(self):
+        for fh, _ in self._files.values():
+            fh.flush()
+
+    def close(self):
+        for fh, _ in self._files.values():
+            fh.close()
+        self._files = {}
 
 
 class TensorBoardWriter(_BaseWriter):
@@ -53,6 +81,9 @@ class TensorBoardWriter(_BaseWriter):
     def flush(self):
         self.writer.flush()
 
+    def close(self):
+        self.writer.close()
+
 
 class WandbWriter(_BaseWriter):
     def __init__(self, cfg):
@@ -63,6 +94,9 @@ class WandbWriter(_BaseWriter):
     def write_events(self, events):
         for label, value, sample in events:
             self.wandb.log({label: value}, step=int(sample))
+
+    def close(self):
+        self.wandb.finish()
 
 
 class MonitorMaster:
@@ -80,6 +114,10 @@ class MonitorMaster:
                 except Exception as e:  # missing backend is non-fatal
                     logger.warning(f"monitor backend {cls.__name__} disabled: {e}")
         self.enabled = bool(self.writers)
+        if self.enabled:
+            # interpreter-exit safety net: buffered rows (CsvWriter keeps
+            # handles open) survive runs that never call close() themselves
+            atexit.register(self.close)
 
     def write_events(self, events):
         if not self.enabled:
@@ -90,3 +128,21 @@ class MonitorMaster:
     def flush(self):
         for w in self.writers:
             w.flush()
+
+    def close(self):
+        """Flush and release every writer; idempotent, and safe to call
+        before interpreter exit (the atexit hook becomes a no-op)."""
+        for w in self.writers:
+            try:
+                w.close()
+            except Exception as e:
+                logger.warning(f"monitor writer close failed: {e}")
+        self.writers = []
+        self.enabled = False
+        atexit.unregister(self.close)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

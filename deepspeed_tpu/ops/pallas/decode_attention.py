@@ -42,6 +42,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._utils import interpret_mode
 
+# jax >= 0.5 renames TPUMemorySpace -> MemorySpace (and ANY -> HBM for
+# refs the kernel DMAs out of itself); accept either so the kernel runs
+# against both toolchains
+if hasattr(pltpu, "MemorySpace"):
+    _MEM_HBM = pltpu.MemorySpace.HBM
+else:
+    _MEM_HBM = pltpu.TPUMemorySpace.ANY
+
 NEG_INF = float(np.finfo(np.float32).min)
 
 
@@ -51,9 +59,13 @@ def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
     k_buf/v_buf: [2, b, block_k, h*d] VMEM slots — ALL batch rows ride one
     (strided) DMA per block, so the DMA count is O(live blocks), not
     O(b * live blocks). Online softmax state rides the loop carry; the
-    per-batch dots unroll statically (b is small at decode time)."""
-    nb = meta_ref[0]       # live kv blocks
-    clen = meta_ref[1]     # filled prefix length (includes this token)
+    per-batch dots unroll statically (b is small at decode time).
+
+    meta_ref: [1 + b] scalars — [0] is the live block count (max over
+    rows), [1 + bi] row bi's filled prefix length. Per-row lengths are what
+    continuous-batching serving needs: every slot sits at its own fill, so
+    the mask is per-row while the DMA window is sized by the deepest slot."""
+    nb = meta_ref[0]       # live kv blocks (max over batch rows)
 
     def k_copy(i, slot):
         return pltpu.make_async_copy(
@@ -84,9 +96,9 @@ def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
         v_copy(i, slot).wait()
         pos = i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, hp), 0)
-        live = pos < clen
         ms, ls, accs = [], [], []
         for bi in range(b):                        # static unroll
+            live = pos < meta_ref[1 + bi]          # row bi's filled prefix
             kbk = k_buf[slot, bi].astype(jnp.float32)   # [bk, h*d]
             vbk = v_buf[slot, bi].astype(jnp.float32)
             qmat = qmat_ref[bi].astype(jnp.float32)     # [h*d, hp]
@@ -166,8 +178,10 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     cache layout — rank-4 [b, S, h, d] caches are accepted but XLA
     lane-pads their d dim (64 -> 128), so every call pays a full-cache
     relayout copy; keep the cache flat (models/gpt.py does when decode_impl
-    resolves to pallas). cache_len: scalar int32 count of valid cache
-    positions (including this token, already written).
+    resolves to pallas). cache_len: count of valid cache positions
+    (including this token, already written) — a scalar when every row sits
+    at the same fill (single-stream generate), or a [b] int32 vector of
+    per-row fills (slotted continuous-batching decode, serving/engine.py).
     Returns [b, 1, h, d]."""
     b, s_q, h, d = q.shape
     S = cached_key.shape[1]
@@ -189,9 +203,10 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     eye = jnp.eye(h, hp, dtype=q.dtype)                     # [h, hp]
     qmat = jnp.einsum("bhd,hg->bhdg", qt, eye).reshape(b, hd, hp)
 
-    clen = jnp.asarray(cache_len, jnp.int32)
-    nb = jnp.clip((clen + bk - 1) // bk, 1, S // bk)
-    meta = jnp.stack([nb, clen])
+    clen = jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (b,))
+    # DMA window sized by the deepest row; shallower rows mask in-kernel
+    nb = jnp.clip((jnp.max(clen) + bk - 1) // bk, 1, S // bk)
+    meta = jnp.concatenate([nb[None], clen])
 
     if flat:
         kf, vf = cached_key, cached_value
@@ -208,8 +223,8 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
             pl.BlockSpec((b, hd, hp), lambda g, meta: (0, 0, 0)),
             # the cache never enters VMEM wholesale: the kernel DMAs only
             # live blocks out of HBM
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=_MEM_HBM),
+            pl.BlockSpec(memory_space=_MEM_HBM),
         ],
         out_specs=pl.BlockSpec((b, hp, hd), lambda g, meta: (0, 0, 0)),
         scratch_shapes=[
@@ -235,11 +250,17 @@ def masked_cache_attention(q, ck, cv, first_q_pos, scale, window=None):
     fallback and the model's prefill/window paths, so the two can't drift):
     q [b, s, h, d] with query i at absolute position ``first_q_pos + i``,
     ck/cv [b, S, h, d]; each query sees keys at positions <= its own
-    (within the trailing local ``window`` if given)."""
+    (within the trailing local ``window`` if given). ``first_q_pos``:
+    scalar, or a [b] vector when each row decodes at its own fill (slotted
+    serving)."""
     S = ck.shape[1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32) * scale
     key_pos = jnp.arange(S)[None, None, None, :]
-    q_pos = (first_q_pos + jnp.arange(q.shape[1]))[None, None, :, None]
+    fq = jnp.asarray(first_q_pos)
+    if fq.ndim == 1:                               # per-row fills: [b,1,s,1]
+        q_pos = (fq[:, None] + jnp.arange(q.shape[1]))[:, None, :, None]
+    else:
+        q_pos = (fq + jnp.arange(q.shape[1]))[None, None, :, None]
     visible = key_pos <= q_pos
     if window is not None:
         visible = jnp.logical_and(visible, key_pos > q_pos - window)

@@ -134,12 +134,14 @@ class DeepSpeedTransformerLayer(nn.Module):
         if cfg.adjust_init_range and cfg.num_hidden_layers > 0:
             out_std /= math.sqrt(2.0 * cfg.num_hidden_layers)
         init = nn.initializers.normal
-        ln_attn = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
-                               name="attn_ln")
-        ln_out = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
-                              name="out_ln")
-
         def body(x):
+            # submodules are constructed INSIDE the (possibly remat'd) body:
+            # flax's lift machinery rejects calls to modules born in the
+            # outer trace scope from within a jax transform
+            ln_attn = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
+                                   name="attn_ln")
+            ln_out = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=dt,
+                                  name="out_ln")
             x = x.astype(dt)
             b, s, _ = x.shape
             a_in = ln_attn(x) if cfg.pre_layer_norm else x

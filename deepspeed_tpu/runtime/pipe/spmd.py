@@ -31,7 +31,7 @@ from typing import Any, Callable, Dict, Iterator, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ...utils.jax_compat import pcast, shard_map  # jax-version shims
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...utils.logging import log_dist
@@ -365,8 +365,7 @@ class GPipeSpmdEngine:
 
         # the carry varies per stage from tick 1 on; mark the (zero) init
         # as pp-varying so scan's carry type is stable
-        init = jax.lax.pcast(jnp.zeros_like(xs_local[0]), ("pp",),
-                             to="varying")
+        init = pcast(jnp.zeros_like(xs_local[0]), ("pp",), to="varying")
         _, ys = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
         outs = ys[S - 1:]
         # broadcast the last stage's outputs to every stage so the suffix

@@ -1,0 +1,13 @@
+"""Continuous-batching serving subsystem.
+
+The reference ships a dedicated inference layer
+(``deepspeed/inference/engine.py``); this package is its TPU-native
+serving tier — slotted KV-cache management, Orca-style iteration-level
+scheduling, and a two-program jit discipline. See docs/serving.md.
+"""
+
+from .kv_cache import SlotAllocator, SlotKVCacheManager  # noqa: F401
+from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
+                        REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL)
+from .metrics import ServingMetrics, csv_monitor_master  # noqa: F401
+from .engine import ServingEngine  # noqa: F401

@@ -1,0 +1,227 @@
+"""ServingEngine: continuous-batching façade over the inference stack.
+
+Reference analogue: ``deepspeed/inference/engine.py`` serves ONE
+``generate`` call at a time; production serving (the ROADMAP north star)
+needs many concurrent streams. This engine composes
+
+  * the existing :class:`~deepspeed_tpu.inference.engine.InferenceEngine`
+    (TP placement, int8 dequant-in-program, multi-host input handling),
+  * a slotted KV arena (serving/kv_cache.py) with per-slot fills,
+  * an iteration-level scheduler (serving/scheduler.py),
+  * live metrics through the monitor fan-out (serving/metrics.py),
+
+into a serve loop with exactly TWO compiled model programs regardless of
+traffic — the CUDA-graph discipline applied to serving:
+
+  prefill  (params, ids[1, P],  len, rng) -> (token[1],  cache)   fixed P
+  decode   (params, arena, tok[B], pos[B], rng) -> (token[B], arena)
+
+(plus one trivial non-model copy program that moves a prefilled cache into
+its arena slot). Prompts pad to the ``max_prompt_len`` bucket; the decode
+batch is always ``max_batch`` wide with retired slots riding as masked-out
+lanes, so XLA never sees a new shape after warmup.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..utils.logging import log_dist
+from .kv_cache import SlotKVCacheManager
+from .metrics import ServingMetrics
+from .scheduler import ContinuousBatchScheduler, Request
+
+
+def sample_tokens(logits, rng, temperature: float, top_k: Optional[int]):
+    """Greedy / temperature / top-k sampling over [b, V] logits — the same
+    policy as InferenceEngine.generate's sampler."""
+    import jax
+    import jax.numpy as jnp
+    logits = logits.astype(jnp.float32)
+    if temperature not in (0.0, 1.0):
+        logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -1e10, logits)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class ServingEngine:
+    """Continuous-batching server over a decoder LM.
+
+    Pass an existing ``InferenceEngine`` (keeps its TP/quantization setup),
+    or ``model`` + ``model_parameters`` to build one. Minimal use::
+
+        serving = ServingEngine(model, model_parameters=params,
+                                max_batch=8, dtype=jnp.float32)
+        results = serving.run([prompt_ids_1, prompt_ids_2, ...],
+                              max_new_tokens=32)
+        results[0].output_ids      # prompt + generated tokens
+    """
+
+    def __init__(self, model=None, model_parameters=None, *,
+                 engine=None,
+                 max_batch: int = 8,
+                 max_prompt_len: Optional[int] = None,
+                 max_queue: int = 64,
+                 temperature: float = 0.0,
+                 top_k: Optional[int] = None,
+                 monitor=None,
+                 emit_every_steps: int = 16,
+                 seed: int = 0,
+                 **inference_kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        if engine is None:
+            from ..inference.engine import InferenceEngine
+            engine = InferenceEngine(model, model_parameters=model_parameters,
+                                     **inference_kwargs)
+        self.engine = engine
+        self.module = engine.module
+        cfg = getattr(self.module, "cfg", None)
+        max_seq = getattr(cfg, "max_seq_len", None)
+        if max_seq is None:
+            raise ValueError("ServingEngine needs a model with "
+                             "cfg.max_seq_len (the KV arena extent)")
+        self.max_batch = int(max_batch)
+        self.max_prompt_len = int(max_prompt_len or max_seq)
+        if self.max_prompt_len > max_seq:
+            raise ValueError(f"max_prompt_len {self.max_prompt_len} exceeds "
+                             f"the model's max_seq_len {max_seq}")
+        self.temperature = float(temperature)
+        self.top_k = top_k
+
+        self.kv = SlotKVCacheManager(self.module, engine.params,
+                                     self.max_batch)
+        self.scheduler = ContinuousBatchScheduler(
+            self.kv.allocator, max_queue=max_queue,
+            max_prompt_len=self.max_prompt_len)
+        self.metrics = ServingMetrics(monitor,
+                                      emit_every_steps=emit_every_steps)
+        self._rng = jax.random.PRNGKey(seed)
+        self._last_token = np.zeros(self.max_batch, np.int32)
+
+        mat = engine._materialize
+        module = self.module
+        temperature_, top_k_ = self.temperature, self.top_k
+
+        def prefill(params, ids, true_len, rng):
+            pm = mat(params)
+            positions = jnp.arange(ids.shape[1])[None, :]
+            logits, vc = module.apply({"params": pm}, ids,
+                                      positions=positions, mutable=["cache"])
+            if isinstance(logits, tuple):
+                logits = logits[0]
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, true_len - 1, 1, axis=1)[:, 0]          # [1, V]
+            tok = sample_tokens(last, rng, temperature_, top_k_)
+            return tok, vc["cache"]
+
+        def decode(params, cache, tokens, positions, rng):
+            pm = mat(params)
+            logits, vc = module.apply(
+                {"params": pm, "cache": cache}, tokens[:, None],
+                positions=positions[:, None], mutable=["cache"])
+            if isinstance(logits, tuple):
+                logits = logits[0]
+            tok = sample_tokens(logits[:, -1], rng, temperature_, top_k_)
+            return tok, vc["cache"]
+
+        self._jit_prefill = jax.jit(prefill)
+        # donate the arena: XLA updates every slot's KV rows in place
+        self._jit_decode = jax.jit(decode, donate_argnums=(1,))
+        log_dist(f"serving engine ready: slots={self.max_batch} "
+                 f"prefill_bucket={self.max_prompt_len} "
+                 f"max_seq={max_seq}", ranks=[0])
+
+    # --------------------------------------------------------------- API
+    def submit(self, prompt: Union[Request, Sequence[int], np.ndarray],
+               **request_kwargs) -> Request:
+        """Enqueue one request (token-id prompt or a prebuilt Request).
+        Rejections (bounded queue, oversized prompt) come back as
+        ``status == "rejected"`` with ``reject_reason`` set — the
+        backpressure signal, not an exception."""
+        req = prompt if isinstance(prompt, Request) else Request(
+            prompt=np.asarray(prompt, np.int32), **request_kwargs)
+        self.metrics.start()
+        if not self.scheduler.submit(req):
+            self.metrics.on_rejected()
+        return req
+
+    def step(self) -> List[Request]:
+        """One continuous-batching iteration: admit newly-runnable requests
+        into free slots (prefill + arena insert), then one fused decode
+        step over all live slots. Returns requests finished this step."""
+        before = len(self.scheduler.finished)
+        self._admit()
+        self._decode_once()
+        return self.scheduler.finished[before:]
+
+    def run(self, prompts: Optional[Sequence] = None,
+            **request_kwargs) -> List[Request]:
+        """Serve until drained. ``prompts``: token-id sequences (or Request
+        objects) submitted up front; per-request kwargs (max_new_tokens,
+        eos_token_id, deadline_s) apply to all of them. Returns the
+        submitted requests in submission order (rejected ones included,
+        flagged by status)."""
+        submitted = [self.submit(p, **request_kwargs)
+                     for p in (prompts or [])]
+        while self.scheduler.has_work():
+            self.step()
+        self.metrics.maybe_emit(self.scheduler.queue_depth,
+                                self.kv.occupancy, force=True)
+        return submitted
+
+    # ---------------------------------------------------------- internals
+    def _next_rng(self):
+        import jax
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+        for req in self.scheduler.admit():
+            ids = np.zeros((1, self.max_prompt_len), np.int32)
+            ids[0, :req.prompt_len] = req.prompt
+            tok, one_cache = self._jit_prefill(
+                self.engine.params, jnp.asarray(ids),
+                jnp.int32(req.prompt_len), self._next_rng())
+            self.kv.insert(one_cache, req.slot, req.prompt_len)
+            first = int(np.asarray(tok)[0])
+            self._last_token[req.slot] = first
+            # may retire the request immediately (max_new_tokens == 1 or
+            # an instant EOS) — its slot frees before the decode step
+            self.scheduler.record_first_token(req, first)
+            self.metrics.on_tokens(1)
+
+    def _decode_once(self) -> None:
+        import jax.numpy as jnp
+        running = self.scheduler.running
+        if not running:
+            return
+        slots = sorted(running)
+        tokens = np.zeros(self.max_batch, np.int32)
+        positions = np.zeros(self.max_batch, np.int32)
+        for s in slots:
+            tokens[s] = self._last_token[s]
+            positions[s] = self.kv.fill[s]
+        tok, new_cache = self._jit_decode(
+            self.engine.params, self.kv.cache, jnp.asarray(tokens),
+            jnp.asarray(positions), self._next_rng())
+        self.kv.update(new_cache)
+        self.kv.allocator.advance(slots)
+        tok_host = np.asarray(tok)
+        for s in slots:
+            self._last_token[s] = int(tok_host[s])
+        finished = self.scheduler.step_tokens(
+            {s: int(tok_host[s]) for s in slots})
+        self.metrics.on_tokens(len(slots))
+        self.metrics.on_decode_step()
+        self.metrics.on_finished(finished)
+        self.metrics.maybe_emit(self.scheduler.queue_depth,
+                                self.kv.occupancy)

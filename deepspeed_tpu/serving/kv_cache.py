@@ -1,0 +1,173 @@
+"""Slotted KV-cache management for continuous-batching serving.
+
+Reference analogue: the inference kernel's per-request KV arena
+(``csrc/transformer/inference/includes/context.h`` allocates one workspace
+sized ``[max_out_tokens, ...]`` per layer and hands each request a region).
+Here the arena is the model's own flax ``cache`` collection, widened to a
+fixed ``[max_batch]`` slot axis with a PER-SLOT fill index — the vLLM/
+PagedAttention idea specialized to TPU constraints: rather than paging
+variable-sized blocks (dynamic shapes XLA would recompile on), every
+request leases one fixed ``[max_seq, ...]`` slot row, and slot reuse is a
+single fused ``dynamic_update_slice`` per cache leaf.
+
+Two layers, deliberately separable:
+  * :class:`SlotAllocator` — pure host-side accounting (free list, per-slot
+    fill lengths, occupancy). No JAX. Unit-testable at CPU speed.
+  * :class:`SlotKVCacheManager` — owns the device arena pytree and the
+    jitted slot-insert program; composes a SlotAllocator for the
+    bookkeeping.
+"""
+
+from __future__ import annotations
+
+import heapq
+from functools import partial
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class SlotAllocator:
+    """Host-side slot accounting: a fixed pool of ``max_batch`` cache rows,
+    each leased to at most one in-flight request, with per-slot fill
+    lengths (number of valid KV positions). Lowest-index-first allocation
+    keeps runs deterministic."""
+
+    def __init__(self, max_batch: int, max_seq_len: int):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self._free: List[int] = list(range(max_batch))
+        heapq.heapify(self._free)
+        self.fill = np.zeros(max_batch, np.int32)
+        self.active = np.zeros(max_batch, bool)
+
+    # ------------------------------------------------------------- leases
+    def alloc(self, fill_len: int = 0) -> Optional[int]:
+        """Lease the lowest free slot at ``fill_len`` valid positions;
+        None when every slot is busy (caller applies backpressure)."""
+        if not self._free:
+            return None
+        if fill_len > self.max_seq_len:
+            raise ValueError(
+                f"fill_len {fill_len} exceeds max_seq_len {self.max_seq_len}")
+        slot = heapq.heappop(self._free)
+        self.active[slot] = True
+        self.fill[slot] = fill_len
+        return slot
+
+    def free(self, slot: int) -> None:
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self.active[slot] = False
+        self.fill[slot] = 0
+        heapq.heappush(self._free, slot)
+
+    def advance(self, slots) -> None:
+        """One decode step wrote one token into each of ``slots``."""
+        self.fill[np.asarray(slots, np.int64)] += 1
+
+    # ------------------------------------------------------------ queries
+    @property
+    def n_active(self) -> int:
+        return int(self.active.sum())
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.n_active / self.max_batch
+
+    def remaining(self, slot: int) -> int:
+        """Cache positions still writable in this slot's row."""
+        return self.max_seq_len - int(self.fill[slot])
+
+
+class SlotKVCacheManager:
+    """The device arena: the model's flax ``cache`` pytree widened to
+    ``[..., max_batch, max_seq, ...]`` with per-slot ``cache_index``
+    vectors, plus the jitted insert that moves one prefilled request's KV
+    into its leased slot row.
+
+    ``slot_axis``: position of the batch/slot axis in the cached k/v
+    leaves — 1 when the model scans its layers (leaves are stacked
+    ``[L, B, S, ...]``), 0 otherwise.
+    """
+
+    def __init__(self, model, params, max_batch: int, *,
+                 slot_axis: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = getattr(model, "cfg", None)
+        self.max_seq_len = int(getattr(cfg, "max_seq_len"))
+        self.allocator = SlotAllocator(max_batch, self.max_seq_len)
+        if slot_axis is None:
+            slot_axis = 1 if getattr(cfg, "scan_layers", False) else 0
+        self._slot_axis = slot_axis
+
+        # Arena construction via eval_shape: no compute, no compile — just
+        # the cache pytree the decode path would allocate for a [B, 1]
+        # step, with every leaf zeroed and the scalar-per-layer
+        # ``cache_index`` widened to a per-slot [..., B] vector (the shape
+        # models/gpt.py's _decode_attention dispatches per-slot mode on).
+        ids = jnp.zeros((max_batch, 1), jnp.int32)
+        pos = jnp.zeros((max_batch, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            partial(model.apply, mutable=["cache"]),
+            {"params": params}, ids, positions=pos)
+        cache_shapes = shapes[1]["cache"]
+
+        def build(path, leaf):
+            if "cache_index" in jax.tree_util.keystr(path):
+                return jnp.zeros(leaf.shape + (max_batch,), jnp.int32)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+
+        self.cache = jax.tree_util.tree_map_with_path(build, cache_shapes)
+
+        ax = self._slot_axis
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _insert(arena, one, slot, fill):
+            def leaf(a, o):
+                if a.ndim == o.ndim:        # cached_key / cached_value rows
+                    start = tuple(slot if i == ax else 0
+                                  for i in range(a.ndim))
+                    return jax.lax.dynamic_update_slice(
+                        a, o.astype(a.dtype), start)
+                # per-slot fill vector: the TRUE prompt length, not the
+                # prefill program's padded index
+                return a.at[..., slot].set(fill)
+            return jax.tree.map(leaf, arena, one)
+
+        self._insert = _insert
+
+    # ----------------------------------------------------------- mutation
+    def insert(self, prefill_cache: Any, slot: int, fill_len: int) -> None:
+        """Move a batch-1 prefilled cache into slot ``slot`` and pin its
+        fill at ``fill_len`` (the unpadded prompt length). Donates and
+        replaces the arena — one fused copy per cache leaf."""
+        self.cache = self._insert(self.cache, prefill_cache,
+                                  np.int32(slot), np.int32(fill_len))
+
+    def update(self, new_cache: Any) -> None:
+        """Adopt the cache returned by a (donating) decode step."""
+        self.cache = new_cache
+
+    # ---------------------------------------------- allocator passthrough
+    def alloc(self, fill_len: int = 0) -> Optional[int]:
+        return self.allocator.alloc(fill_len)
+
+    def free(self, slot: int) -> None:
+        self.allocator.free(slot)
+
+    @property
+    def fill(self) -> np.ndarray:
+        return self.allocator.fill
+
+    @property
+    def occupancy(self) -> float:
+        return self.allocator.occupancy

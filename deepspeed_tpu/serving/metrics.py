@@ -1,0 +1,111 @@
+"""Live serving metrics, emitted through the monitor fan-out.
+
+Events ride the existing ``(label, value, sample)`` contract of
+``deepspeed_tpu/monitor/monitor.py`` (reference monitor/monitor.py:45), so
+any configured writer — CSV, TensorBoard, W&B — picks them up unchanged.
+``sample`` is the decode-iteration counter: serving dashboards line up
+against the same x-axis the training monitor uses for steps.
+
+Labels:
+  serving/tokens_per_s      aggregate decode throughput since start
+  serving/ttft_s            mean time-to-first-token over finished requests
+  serving/queue_depth       requests waiting for a slot
+  serving/slot_occupancy    fraction of KV slots leased [0, 1]
+  serving/requests_done     completed requests (cumulative)
+  serving/rejected_total    backpressure rejections (cumulative)
+"""
+
+from __future__ import annotations
+
+import time
+from types import SimpleNamespace
+from typing import Dict, Optional
+
+
+def csv_monitor_master(output_path: str, job_name: str = "serving"):
+    """A CSV-only MonitorMaster for serving/benchmark runs that have no
+    DeepSpeedConfig — same writer class, same on-disk format."""
+    from ..monitor.monitor import MonitorMaster
+    cfg = SimpleNamespace(
+        tensorboard=SimpleNamespace(enabled=False),
+        wandb=SimpleNamespace(enabled=False),
+        csv_monitor=SimpleNamespace(enabled=True, output_path=output_path,
+                                    job_name=job_name))
+    return MonitorMaster(cfg)
+
+
+class ServingMetrics:
+    """Aggregates serving counters and periodically flushes them as monitor
+    events. ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, monitor=None, *, emit_every_steps: int = 16,
+                 clock=time.perf_counter):
+        self.monitor = monitor
+        self.emit_every_steps = max(1, int(emit_every_steps))
+        self.clock = clock
+        self.t0: Optional[float] = None
+        self.tokens_out = 0
+        self.decode_steps = 0
+        self.requests_done = 0
+        self.rejected = 0
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
+
+    # ----------------------------------------------------------- recording
+    def start(self) -> None:
+        if self.t0 is None:
+            self.t0 = self.clock()
+
+    def on_tokens(self, n: int) -> None:
+        self.tokens_out += int(n)
+
+    def on_decode_step(self) -> None:
+        self.decode_steps += 1
+
+    def on_finished(self, requests) -> None:
+        for req in requests:
+            self.requests_done += 1
+            if req.ttft_s is not None:
+                self._ttft_sum += req.ttft_s
+                self._ttft_n += 1
+
+    def on_rejected(self, n: int = 1) -> None:
+        self.rejected += int(n)
+
+    # ------------------------------------------------------------ reading
+    @property
+    def mean_ttft_s(self) -> float:
+        return self._ttft_sum / self._ttft_n if self._ttft_n else 0.0
+
+    def tokens_per_s(self) -> float:
+        if self.t0 is None:
+            return 0.0
+        dt = self.clock() - self.t0
+        return self.tokens_out / dt if dt > 0 else 0.0
+
+    def snapshot(self, queue_depth: int, occupancy: float) -> Dict[str, float]:
+        return {
+            "serving/tokens_per_s": self.tokens_per_s(),
+            "serving/ttft_s": self.mean_ttft_s,
+            "serving/queue_depth": float(queue_depth),
+            "serving/slot_occupancy": float(occupancy),
+            "serving/requests_done": float(self.requests_done),
+            "serving/rejected_total": float(self.rejected),
+        }
+
+    # ------------------------------------------------------------ emitting
+    def maybe_emit(self, queue_depth: int, occupancy: float,
+                   force: bool = False) -> Optional[Dict[str, float]]:
+        """Write a snapshot through the monitor every ``emit_every_steps``
+        decode iterations (always on ``force`` — the drain path, so short
+        benchmark runs still land their last rows)."""
+        if not force and self.decode_steps % self.emit_every_steps != 0:
+            return None
+        snap = self.snapshot(queue_depth, occupancy)
+        if self.monitor is not None:
+            events = [(label, value, self.decode_steps)
+                      for label, value in snap.items()]
+            self.monitor.write_events(events)
+            if force:
+                self.monitor.flush()
+        return snap

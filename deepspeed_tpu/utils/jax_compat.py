@@ -1,0 +1,42 @@
+"""Symbols that moved or changed spelling across the jax versions this
+repo supports (0.4.x through current).
+
+``shard_map``: jax >= 0.5 exports it at top level and spells the
+replication-check knob ``check_vma``; jax 0.4.x keeps it in
+``jax.experimental.shard_map`` and spells it ``check_rep``. Call sites
+here use the modern spelling; the shim rewrites it when running on the
+older API.
+
+``pcast``: the explicit replicated<->varying cast of the check_vma type
+system. jax 0.4.x has no value-varying types — its ``check_rep`` rewrite
+pass inserts the equivalent ``pbroadcast``s itself — so there the cast is
+a semantic no-op.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
+
+if hasattr(jax.lax, "pcast"):
+    pcast = jax.lax.pcast
+else:
+    def pcast(x, axis_name, *, to=None):
+        return x
+
+
+__all__ = ["shard_map", "pcast"]
