@@ -574,10 +574,22 @@ def _host_only(name):
 
 def _transportish(err):
     """Did a case failure smell like the TPU transport rather than the
-    case itself? (timeout, backend init, relay unavailable)"""
+    case itself? Matches SPECIFIC transport-failure signatures, not bare
+    substrings — "backend" alone also appears in ordinary case errors
+    ("unsupported backend op", "backend config mismatch") and "connect"
+    in module names, which used to reset chip_ok on failures the chip had
+    nothing to do with."""
     s = str(err).lower()
-    return any(k in s for k in ("timed out", "unavailable", "backend",
-                                "deadline", "transport", "connect"))
+    return any(k in s for k in (
+        "timed out",
+        "deadline exceeded",
+        "unable to initialize backend",
+        "failed to connect",
+        "connection refused",
+        "connection reset",
+        "transport unavailable",
+        "server unavailable",
+    ))
 
 
 # Deliberately NOT gitignored: the round-end "commit uncommitted work"

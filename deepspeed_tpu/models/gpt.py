@@ -43,15 +43,18 @@ def _kv_write(cache, kv, cur):
     ``cur`` scalar: the whole batch sits at one fill (single-stream
     generate) — one dynamic_update_slice. ``cur`` [b]: every row has its
     own fill (slotted continuous-batching decode, serving/engine.py) — a
-    vmapped per-row update. An out-of-range per-row offset clamps to the
-    last position (XLA semantics); serving relies on that only for slots
-    already retired, whose rows are fully overwritten at the next insert."""
+    vmapped per-row update. A per-row offset >= the cache extent is the
+    MASKED-LANE sentinel: that row's write is dropped entirely (the fused
+    multi-step serving decode pins retired lanes at ``max_seq_len`` so a
+    dead lane never dirties KV rows a later occupant of the slot could
+    attend before overwriting them)."""
     if jnp.ndim(cur) == 0:
         start = (0, cur) + (0,) * (cache.ndim - 2)
         return jax.lax.dynamic_update_slice(cache, kv, start)
 
     def row(c, x, p):
-        return jax.lax.dynamic_update_slice(c, x, (p,) + (0,) * (c.ndim - 1))
+        upd = jax.lax.dynamic_update_slice(c, x, (p,) + (0,) * (c.ndim - 1))
+        return jnp.where(p < c.shape[0], upd, c)
 
     return jax.vmap(row)(cache, kv, cur)
 

@@ -182,9 +182,14 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     (including this token, already written) — a scalar when every row sits
     at the same fill (single-stream generate), or a [b] int32 vector of
     per-row fills (slotted continuous-batching decode, serving/engine.py).
+    Masked-lane entries may sit past the cache extent (the serving
+    engine's retired-lane sentinel is ``max_seq_len``); they are clamped
+    to S here so the DMA window / mask math stays in range — the lane's
+    output is garbage the caller discards, never an OOB access.
     Returns [b, 1, h, d]."""
     b, s_q, h, d = q.shape
     S = cached_key.shape[1]
+    cache_len = jnp.minimum(jnp.asarray(cache_len, jnp.int32), S)
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     bk = _choose_block(b, S, h, d, jnp.dtype(cached_key.dtype).itemsize,

@@ -106,22 +106,29 @@ def ds_quantize(vals: jnp.ndarray, groups: int, bits: int = 8,
             q = low + (r < (t - low)).astype(jnp.float32)
         else:
             q = jnp.round(t)
+        # saturating clamp to the code range: at the group max t == 2^bits
+        # exactly (and the stochastic +1 bump can land there too), one
+        # code past the top — the int8 store would wrap it to the bottom
+        q = jnp.clip(q, 0.0, float((1 << bits) - 1))
         out = q * scale + mn
     else:
         absmax = jnp.max(jnp.abs(flat), axis=1, keepdims=True)
         q_scale = float(1 << bits) / (2.0 * absmax + 1e-5)
         t = flat * q_scale
+        high_q = float((1 << (bits - 1)) - 1)
+        low_q = float(-(1 << (bits - 1)))
         if stochastic:
             ti = jnp.trunc(t)
-            high_q = float((1 << (bits - 1)) - 1)
-            low_q = float(-(1 << (bits - 1)))
             err = jnp.abs(t - ti)
             r = jax.random.uniform(key, flat.shape)
             bump = ((r < err) & (ti > low_q) & (ti < high_q)
                     ).astype(jnp.float32)
             q = ti + jnp.sign(t) * bump
         else:
-            q = jnp.round(t)
+            # saturating clamp: at v == absmax, t is a hair under
+            # 2^(bits-1) and round() lands ON it — one code past high_q,
+            # which an int8 store would wrap to the bottom of the range
+            q = jnp.clip(jnp.round(t), low_q, high_q)
         out = q / q_scale
     return out.reshape(vals.shape).astype(vals.dtype)
 

@@ -51,7 +51,17 @@ class StackedPipeSpec:
                                            ``aux`` is broadcast per-block
                                            side input (GPT: positions,
                                            BERT: attention mask), an array
-                                           with leading batch dim
+                                           with leading batch dim.
+                                           CONTRACT: aux must be
+                                           parameter-INDEPENDENT (derived
+                                           from the batch alone) — the
+                                           streamed backward treats it as
+                                           a constant and differentiates
+                                           the prefix only through ``x``,
+                                           so gradients routed through aux
+                                           would be dropped. The streamer
+                                           wraps it in stop_gradient at
+                                           this boundary to enforce that.
     block(block_params, x, aux) -> x       ONE layer from the stacked tree
                                            (leaves carry a leading layer
                                            axis; ``block`` receives one

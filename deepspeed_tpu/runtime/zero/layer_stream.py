@@ -229,7 +229,16 @@ def _streamed_fns(streamer: LayerStreamer):
     block_apply = spec.block
 
     def embed_fn(res, batch):
-        return spec.prefix(res, batch)          # -> (x, aux)
+        # CONTRACT: aux is parameter-independent side input (positions,
+        # attention masks — batch-derived constants). The backward pass
+        # closes over aux as a constant in every block vjp and
+        # differentiates the prefix only through x (layer_stream's
+        # ``embed_fn(r, batch)[0]`` vjp), so any parameter dependence
+        # routed through aux would be silently dropped from the gradient.
+        # stop_gradient enforces the contract at the spec boundary rather
+        # than leaving it implicit in the vjp plumbing.
+        x, aux = spec.prefix(res, batch)
+        return x, jax.lax.stop_gradient(aux)
 
     def head_fn(res, x, batch, scale):
         loss = spec.suffix_loss(res, x, batch)

@@ -10,21 +10,41 @@ needs many concurrent streams. This engine composes
   * an iteration-level scheduler (serving/scheduler.py),
   * live metrics through the monitor fan-out (serving/metrics.py),
 
-into a serve loop with exactly TWO compiled model programs regardless of
-traffic — the CUDA-graph discipline applied to serving:
+into a DEVICE-PACED serve loop. The compiled model programs:
 
-  prefill  (params, ids[1, P],  len, rng) -> (token[1],  cache)   fixed P
-  decode   (params, arena, tok[B], pos[B], rng) -> (token[B], arena)
+  prefill  (params, ids[n, P], lens[n], rng) -> (tok[n], cache)
+           bucketed: P is the smallest power-of-two bucket (16/32/64/...)
+           covering the batch's longest prompt, n <= max_batch; compiled
+           lazily per (n, P) pair so a burst of short prompts stops
+           paying ``max_prompt_len`` of padded compute
+  decode   (params, arena, tok[B], pos[B], rng) -> (tok[B], arena)
+           the PR-1 per-token loop, kept behind ``decode_chunk=1`` as the
+           bit-parity reference
+  decode_chunk
+           (params, arena, tok[B], pos[B], act[B], eos[B], rem[B], rng)
+           -> (toks[B, K], valid[B, K], arena, carry...)
+           a ``lax.scan`` running K = ``decode_chunk`` decode steps per
+           host iteration: sampling, per-slot EOS / token-budget stop
+           masking, and KV writes all stay on device; retired lanes pin
+           their write index at ``max_seq_len`` (models/gpt.py drops the
+           write) so a dead lane never dirties KV rows. The host syncs
+           ONCE per chunk and hands the token buffer to the scheduler in
+           one ``step_tokens_chunk`` call.
 
-(plus one trivial non-model copy program that moves a prefilled cache into
-its arena slot). Prompts pad to the ``max_prompt_len`` bucket; the decode
-batch is always ``max_batch`` wide with retired slots riding as masked-out
-lanes, so XLA never sees a new shape after warmup.
+(plus the trivial non-model insert programs that move prefilled caches
+into arena slot rows). ``run()`` additionally double-buffers: the next
+chunk is enqueued from the previous chunk's device-resident carry BEFORE
+the host blocks on its token buffer, so scheduler bookkeeping overlaps
+device compute (JAX async dispatch). This converts the serving tier from
+host-paced (one dispatch + one sync per token) to device-paced (one per
+K tokens) — the difference that shows up wherever dispatch latency
+rivals the model's step time.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Union
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
 
@@ -50,6 +70,30 @@ def sample_tokens(logits, rng, temperature: float, top_k: Optional[int]):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def default_prefill_buckets(max_prompt_len: int) -> List[int]:
+    """Power-of-two prefill buckets from 16 up to ``max_prompt_len``
+    (which always caps the list so every admissible prompt has a
+    bucket)."""
+    out: List[int] = []
+    b = 16
+    while b < max_prompt_len:
+        out.append(b)
+        b *= 2
+    out.append(max_prompt_len)
+    return out
+
+
+@dataclasses.dataclass
+class _InflightChunk:
+    """One enqueued decode chunk: device handles (nothing synced yet) plus
+    the slot->request-uid snapshot at launch time, so tokens are never
+    attributed to a slot's NEXT occupant."""
+    slot_uids: Dict[int, int]
+    tokens: Any          # [B, K] device
+    valid: Any           # [B, K] device (lane was live entering the step)
+    state: Tuple         # (tok[B], pos[B], act[B], rem[B], eos[B]) device
+
+
 class ServingEngine:
     """Continuous-batching server over a decoder LM.
 
@@ -61,6 +105,12 @@ class ServingEngine:
         results = serving.run([prompt_ids_1, prompt_ids_2, ...],
                               max_new_tokens=32)
         results[0].output_ids      # prompt + generated tokens
+
+    ``decode_chunk`` is the number of decode steps fused into one device
+    program invocation (K). ``decode_chunk=1`` is the PR-1 per-token loop
+    (one host sync per token); greedy outputs are bit-identical across
+    all K. Deadlines are only observed at chunk boundaries — a request
+    may overrun its deadline by up to K-1 tokens of device work.
     """
 
     def __init__(self, model=None, model_parameters=None, *,
@@ -68,6 +118,8 @@ class ServingEngine:
                  max_batch: int = 8,
                  max_prompt_len: Optional[int] = None,
                  max_queue: int = 64,
+                 decode_chunk: int = 8,
+                 prefill_buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0,
                  top_k: Optional[int] = None,
                  monitor=None,
@@ -89,10 +141,22 @@ class ServingEngine:
             raise ValueError("ServingEngine needs a model with "
                              "cfg.max_seq_len (the KV arena extent)")
         self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq)
         self.max_prompt_len = int(max_prompt_len or max_seq)
         if self.max_prompt_len > max_seq:
             raise ValueError(f"max_prompt_len {self.max_prompt_len} exceeds "
                              f"the model's max_seq_len {max_seq}")
+        self.decode_chunk = int(decode_chunk)
+        if self.decode_chunk < 1:
+            raise ValueError(
+                f"decode_chunk must be >= 1, got {decode_chunk}")
+        if prefill_buckets is None:
+            self._buckets = default_prefill_buckets(self.max_prompt_len)
+        else:
+            self._buckets = sorted(
+                {int(b) for b in prefill_buckets
+                 if 0 < int(b) <= self.max_prompt_len}
+                | {self.max_prompt_len})
         self.temperature = float(temperature)
         self.top_k = top_k
 
@@ -105,20 +169,29 @@ class ServingEngine:
                                       emit_every_steps=emit_every_steps)
         self._rng = jax.random.PRNGKey(seed)
         self._last_token = np.zeros(self.max_batch, np.int32)
+        # distinct (batch, bucket) prefill shapes seen so far — the
+        # compile count ServingMetrics reports
+        self._prefill_shapes: Set[Tuple[int, int]] = set()
+        # host corrections to device-carried chunk state, applied at the
+        # NEXT chunk launch (see _device_state)
+        self._deact_slots: Set[int] = set()
+        self._admit_patches: Dict[int, Tuple[int, int, int, int]] = {}
 
         mat = engine._materialize
         module = self.module
         temperature_, top_k_ = self.temperature, self.top_k
+        max_seq_ = self.max_seq_len
+        K = self.decode_chunk
 
-        def prefill(params, ids, true_len, rng):
+        def prefill(params, ids, true_lens, rng):
             pm = mat(params)
             positions = jnp.arange(ids.shape[1])[None, :]
             logits, vc = module.apply({"params": pm}, ids,
                                       positions=positions, mutable=["cache"])
             if isinstance(logits, tuple):
                 logits = logits[0]
-            last = jax.lax.dynamic_slice_in_dim(
-                logits, true_len - 1, 1, axis=1)[:, 0]          # [1, V]
+            last = jnp.take_along_axis(
+                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [n,V]
             tok = sample_tokens(last, rng, temperature_, top_k_)
             return tok, vc["cache"]
 
@@ -132,11 +205,60 @@ class ServingEngine:
             tok = sample_tokens(logits[:, -1], rng, temperature_, top_k_)
             return tok, vc["cache"]
 
+        def _with_write_index(cache, write_pos):
+            # the engine owns the per-slot write cursor: overwrite every
+            # cache_index leaf with this step's write positions (retired
+            # lanes carry the max_seq sentinel -> models/gpt.py drops the
+            # write entirely)
+            def leaf(path, x):
+                if "cache_index" in jax.tree_util.keystr(path):
+                    return jnp.broadcast_to(
+                        write_pos.astype(x.dtype), x.shape)
+                return x
+            return jax.tree_util.tree_map_with_path(leaf, cache)
+
+        def decode_chunk_fn(params, cache, tokens, positions, active,
+                            eos, remaining, rng):
+            pm = mat(params)
+
+            def body(carry, _):
+                c, tok, pos, act, rem, key = carry
+                write_pos = jnp.where(act, pos,
+                                      jnp.int32(max_seq_))  # masked lanes
+                c = _with_write_index(c, write_pos)
+                logits, vc = module.apply(
+                    {"params": pm, "cache": c}, tok[:, None],
+                    positions=pos[:, None], mutable=["cache"])
+                if isinstance(logits, tuple):
+                    logits = logits[0]
+                key, sub = jax.random.split(key)
+                nxt = sample_tokens(logits[:, -1], sub,
+                                    temperature_, top_k_)
+                nxt = jnp.where(act, nxt, tok)       # frozen lanes hold
+                emitted = act                        # validity of nxt
+                rem = jnp.where(act, rem - 1, rem)
+                hit_eos = jnp.logical_and(eos >= 0, nxt == eos)
+                act = jnp.logical_and(
+                    act, jnp.logical_and(rem > 0,
+                                         jnp.logical_not(hit_eos)))
+                pos = jnp.where(emitted, pos + 1, pos)
+                return (vc["cache"], nxt, pos, act, rem, key), (nxt, emitted)
+
+            (c, tok_f, pos_f, act_f, rem_f, _), (toks, valid) = jax.lax.scan(
+                body, (cache, tokens, positions, active, remaining, rng),
+                None, length=K)
+            return (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(valid, 0, 1),
+                    c, tok_f, pos_f, act_f, rem_f)
+
+        # prefill retraces lazily per (n, bucket) shape — the jit cache IS
+        # the bucket program table
         self._jit_prefill = jax.jit(prefill)
         # donate the arena: XLA updates every slot's KV rows in place
         self._jit_decode = jax.jit(decode, donate_argnums=(1,))
+        self._jit_decode_chunk = jax.jit(decode_chunk_fn, donate_argnums=(1,))
         log_dist(f"serving engine ready: slots={self.max_batch} "
-                 f"prefill_bucket={self.max_prompt_len} "
+                 f"prefill_buckets={self._buckets} "
+                 f"decode_chunk={self.decode_chunk} "
                  f"max_seq={max_seq}", ranks=[0])
 
     # --------------------------------------------------------------- API
@@ -154,25 +276,36 @@ class ServingEngine:
         return req
 
     def step(self) -> List[Request]:
-        """One continuous-batching iteration: admit newly-runnable requests
-        into free slots (prefill + arena insert), then one fused decode
-        step over all live slots. Returns requests finished this step."""
+        """One synchronous continuous-batching iteration: admit
+        newly-runnable requests into free slots (bucketed batched prefill
+        + arena insert), then one decode invocation over all live slots —
+        a single fused step when ``decode_chunk == 1``, a K-step
+        device-resident chunk otherwise. Returns requests finished this
+        iteration."""
         before = len(self.scheduler.finished)
         self._admit()
-        self._decode_once()
+        if self.decode_chunk <= 1:
+            self._decode_once()
+        elif self.scheduler.running:
+            self._consume_chunk(self._launch_chunk(self._host_state()))
         return self.scheduler.finished[before:]
 
     def run(self, prompts: Optional[Sequence] = None,
             **request_kwargs) -> List[Request]:
         """Serve until drained. ``prompts``: token-id sequences (or Request
         objects) submitted up front; per-request kwargs (max_new_tokens,
-        eos_token_id, deadline_s) apply to all of them. Returns the
-        submitted requests in submission order (rejected ones included,
-        flagged by status)."""
+        eos_token_id, deadline_s) apply to all of them. With
+        ``decode_chunk > 1`` the loop is double-buffered: the next chunk
+        is enqueued from device-resident carry state before the previous
+        chunk's token buffer is synced. Returns the submitted requests in
+        submission order (rejected ones included, flagged by status)."""
         submitted = [self.submit(p, **request_kwargs)
                      for p in (prompts or [])]
-        while self.scheduler.has_work():
-            self.step()
+        if self.decode_chunk <= 1:
+            while self.scheduler.has_work():
+                self.step()
+        else:
+            self._serve_pipelined()
         self.metrics.maybe_emit(self.scheduler.queue_depth,
                                 self.kv.occupancy, force=True)
         return submitted
@@ -183,22 +316,64 @@ class ServingEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _admit(self) -> None:
-        import jax.numpy as jnp
-        for req in self.scheduler.admit():
-            ids = np.zeros((1, self.max_prompt_len), np.int32)
-            ids[0, :req.prompt_len] = req.prompt
-            tok, one_cache = self._jit_prefill(
-                self.engine.params, jnp.asarray(ids),
-                jnp.int32(req.prompt_len), self._next_rng())
-            self.kv.insert(one_cache, req.slot, req.prompt_len)
-            first = int(np.asarray(tok)[0])
-            self._last_token[req.slot] = first
-            # may retire the request immediately (max_new_tokens == 1 or
-            # an instant EOS) — its slot frees before the decode step
-            self.scheduler.record_first_token(req, first)
-            self.metrics.on_tokens(1)
+    def _bucket_for(self, prompt_len: int) -> int:
+        for b in self._buckets:
+            if prompt_len <= b:
+                return b
+        return self._buckets[-1]    # unreachable: submit() length guard
 
+    def _admit(self) -> None:
+        """Admit every currently-runnable request: group by prefill
+        bucket, ONE batched prefill call per bucket group, one fused
+        batched arena insert per group."""
+        import jax.numpy as jnp
+        admitted = self.scheduler.admit()
+        if not admitted:
+            return
+        groups: Dict[int, List[Request]] = {}
+        for req in admitted:
+            groups.setdefault(self._bucket_for(req.prompt_len),
+                              []).append(req)
+        for bucket, reqs in sorted(groups.items()):
+            n = len(reqs)
+            ids = np.zeros((n, bucket), np.int32)
+            lens = np.empty(n, np.int32)
+            for i, r in enumerate(reqs):
+                ids[i, :r.prompt_len] = r.prompt
+                lens[i] = r.prompt_len
+            self._prefill_shapes.add((n, bucket))
+            toks, cache = self._jit_prefill(
+                self.engine.params, jnp.asarray(ids), jnp.asarray(lens),
+                self._next_rng())
+            self.kv.insert_batch(cache, [r.slot for r in reqs], lens)
+            toks_host = np.asarray(toks)
+            self.metrics.on_prefill(n, bucket, int(lens.sum()),
+                                    len(self._prefill_shapes))
+            self.metrics.on_tokens(n)
+            for i, r in enumerate(reqs):
+                first = int(toks_host[i])
+                self._last_token[r.slot] = first
+                # may retire the request immediately (max_new_tokens == 1
+                # or an instant EOS) — its slot frees before any decode
+                self.scheduler.record_first_token(r, first)
+                if self.decode_chunk > 1:
+                    self._record_admit_patch(r)
+
+    def _record_admit_patch(self, req: Request) -> None:
+        slot = req.slot
+        if req.status == "running":
+            rem = min(req.max_new_tokens - len(req.tokens),
+                      self.kv.allocator.remaining(slot))
+            eos = -1 if req.eos_token_id is None else int(req.eos_token_id)
+            self._admit_patches[slot] = (int(req.tokens[-1]),
+                                         req.prompt_len, rem, eos)
+            self._deact_slots.discard(slot)
+        else:
+            # instantly retired: the slot must stay dead on device
+            self._admit_patches.pop(slot, None)
+            self._deact_slots.add(slot)
+
+    # ------------------------------------------------- per-token (K == 1)
     def _decode_once(self) -> None:
         import jax.numpy as jnp
         running = self.scheduler.running
@@ -225,3 +400,127 @@ class ServingEngine:
         self.metrics.on_finished(finished)
         self.metrics.maybe_emit(self.scheduler.queue_depth,
                                 self.kv.occupancy)
+
+    # --------------------------------------------- fused chunks (K > 1)
+    def _host_state(self) -> Tuple:
+        """Full chunk-input state vectors rebuilt from scheduler/allocator
+        mirrors (authoritative — any pending patches are subsumed)."""
+        B = self.max_batch
+        tokens = np.zeros(B, np.int32)
+        positions = np.zeros(B, np.int32)
+        active = np.zeros(B, bool)
+        remaining = np.zeros(B, np.int32)
+        eos = np.full(B, -1, np.int32)
+        for slot, req in self.scheduler.running.items():
+            tokens[slot] = self._last_token[slot]
+            positions[slot] = self.kv.fill[slot]
+            active[slot] = True
+            remaining[slot] = min(req.max_new_tokens - len(req.tokens),
+                                  self.kv.allocator.remaining(slot))
+            if req.eos_token_id is not None:
+                eos[slot] = int(req.eos_token_id)
+        self._deact_slots.clear()
+        self._admit_patches.clear()
+        return tokens, positions, active, remaining, eos
+
+    def _device_state(self, chunk: _InflightChunk) -> Tuple:
+        """Chunk-input state propagated on DEVICE from the previous
+        chunk's carry (no host sync), with the host's corrections patched
+        in: lanes the scheduler finished for its own reasons (deadline)
+        go inactive; freshly admitted requests get their full lane
+        state."""
+        tok, pos, act, rem, eos = chunk.state
+        if self._deact_slots:
+            idx = np.array(sorted(self._deact_slots), np.int32)
+            act = act.at[idx].set(False)
+        if self._admit_patches:
+            slots = np.array(sorted(self._admit_patches), np.int32)
+            vals = [self._admit_patches[int(s)] for s in slots]
+            tok = tok.at[slots].set(
+                np.array([v[0] for v in vals], np.int32))
+            pos = pos.at[slots].set(
+                np.array([v[1] for v in vals], np.int32))
+            rem = rem.at[slots].set(
+                np.array([v[2] for v in vals], np.int32))
+            eos = eos.at[slots].set(
+                np.array([v[3] for v in vals], np.int32))
+            act = act.at[slots].set(True)
+        self._deact_slots.clear()
+        self._admit_patches.clear()
+        return tok, pos, act, rem, eos
+
+    def _launch_chunk(self, state: Tuple) -> _InflightChunk:
+        """Enqueue one K-step decode chunk (returns immediately — JAX
+        async dispatch; nothing here blocks on device results)."""
+        import jax.numpy as jnp
+        tokens, positions, active, remaining, eos = (
+            jnp.asarray(a) for a in state)
+        toks, valid, new_cache, tok_f, pos_f, act_f, rem_f = \
+            self._jit_decode_chunk(self.engine.params, self.kv.cache,
+                                   tokens, positions, active, eos,
+                                   remaining, self._next_rng())
+        self.kv.update(new_cache)
+        return _InflightChunk(
+            slot_uids={s: r.uid for s, r in self.scheduler.running.items()},
+            tokens=toks, valid=valid,
+            state=(tok_f, pos_f, act_f, rem_f, eos))
+
+    def _consume_chunk(self, chunk: _InflightChunk) -> List[Request]:
+        """Block on the chunk's token buffer (the ONE host sync per K
+        steps) and feed it through the scheduler."""
+        toks = np.asarray(chunk.tokens)
+        valid = np.asarray(chunk.valid)
+        per_slot: Dict[int, List[int]] = {}
+        for slot, uid in chunk.slot_uids.items():
+            req = self.scheduler.running.get(slot)
+            if req is None or req.uid != uid:
+                continue        # slot retired/re-leased since launch
+            seq = [int(t) for t, v in zip(toks[slot], valid[slot]) if v]
+            if seq:
+                per_slot[slot] = seq
+                self._last_token[slot] = seq[-1]
+        finished = self.scheduler.step_tokens_chunk(per_slot)
+        self.metrics.on_tokens(sum(len(v) for v in per_slot.values()))
+        self.metrics.on_decode_step()
+        self.metrics.on_finished(finished)
+        for req in finished:
+            if req.slot is not None:
+                self._deact_slots.add(req.slot)
+        self.metrics.maybe_emit(self.scheduler.queue_depth,
+                                self.kv.occupancy)
+        return finished
+
+    def _may_outlive_chunk(self) -> bool:
+        """Could any lane still be live AFTER the in-flight chunk? (Host
+        mirrors are pre-chunk here, so a lane survives it only if its
+        remaining budget exceeds K.) Gates the speculative next-chunk
+        launch so the drain tail doesn't pay a fully-dead chunk."""
+        K = self.decode_chunk
+        for slot, req in self.scheduler.running.items():
+            rem = min(req.max_new_tokens - len(req.tokens),
+                      self.kv.allocator.remaining(slot))
+            if rem > K:
+                return True
+        return False
+
+    def _serve_pipelined(self) -> None:
+        """The async host loop: always keep one chunk in flight, and
+        enqueue its successor (from device-carried state) BEFORE blocking
+        on its token buffer — host-side scheduling/bookkeeping overlaps
+        device compute. Host-only events (deadline expiry, admissions)
+        take effect one chunk late; device-detected stops (EOS, budget)
+        take effect immediately via the carried active mask."""
+        sched = self.scheduler
+        pending: Optional[_InflightChunk] = None
+        while sched.has_work() or pending is not None:
+            if pending is None:
+                self._admit()
+                if sched.running:
+                    pending = self._launch_chunk(self._host_state())
+                continue
+            nxt = None
+            if self._may_outlive_chunk():
+                nxt = self._launch_chunk(self._device_state(pending))
+            self._consume_chunk(pending)
+            self._admit()
+            pending = nxt

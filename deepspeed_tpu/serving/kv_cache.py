@@ -145,6 +145,29 @@ class SlotKVCacheManager:
 
         self._insert = _insert
 
+        @partial(jax.jit, donate_argnums=(0,))
+        def _insert_batch(arena, batched, slots, fills):
+            """Move a batch-n bucketed prefill cache into n leased slot
+            rows. The prefill leaves are [.., n, P_bucket, ..] with
+            P_bucket <= max_seq — only the bucket's prefix of each row is
+            overwritten; stale tail positions from a previous occupant
+            stay masked (fill < their position) until the new request's
+            own decode writes them, so they are never attended."""
+            def leaf(a, o):
+                if a.ndim == o.ndim:        # cached_key / cached_value rows
+                    for i in range(o.shape[ax]):    # n <= max_batch: unroll
+                        row = jax.lax.dynamic_slice_in_dim(o, i, 1, axis=ax)
+                        start = tuple(slots[i] if j == ax else 0
+                                      for j in range(a.ndim))
+                        a = jax.lax.dynamic_update_slice(
+                            a, row.astype(a.dtype), start)
+                    return a
+                # per-slot fill vector: scatter the TRUE prompt lengths
+                return a.at[..., slots].set(fills)
+            return jax.tree.map(leaf, arena, batched)
+
+        self._insert_batch = _insert_batch
+
     # ----------------------------------------------------------- mutation
     def insert(self, prefill_cache: Any, slot: int, fill_len: int) -> None:
         """Move a batch-1 prefilled cache into slot ``slot`` and pin its
@@ -152,6 +175,18 @@ class SlotKVCacheManager:
         replaces the arena — one fused copy per cache leaf."""
         self.cache = self._insert(self.cache, prefill_cache,
                                   np.int32(slot), np.int32(fill_len))
+
+    def insert_batch(self, prefill_cache: Any, slots, fills) -> None:
+        """Move a batch-n bucketed prefill cache (leaves [.., n, P, ..])
+        into the n slot rows ``slots``, pinning each slot's fill at its
+        TRUE prompt length. Donates and replaces the arena. Compiles one
+        program per (n, P_bucket) pair — the same lazy shape family as the
+        bucketed prefill itself."""
+        import jax.numpy as jnp
+        self.cache = self._insert_batch(
+            self.cache, prefill_cache,
+            jnp.asarray(np.asarray(slots, np.int32)),
+            jnp.asarray(np.asarray(fills, np.int32)))
 
     def update(self, new_cache: Any) -> None:
         """Adopt the cache returned by a (donating) decode step."""

@@ -13,6 +13,14 @@ Labels:
   serving/slot_occupancy    fraction of KV slots leased [0, 1]
   serving/requests_done     completed requests (cumulative)
   serving/rejected_total    backpressure rejections (cumulative)
+  serving/prefill_padding_waste
+                            fraction of prefill compute spent on bucket
+                            padding: 1 - true_prompt_tokens/padded_tokens
+                            (0 when every prompt exactly fills its bucket)
+  serving/prefill_programs  distinct compiled (batch, bucket) prefill
+                            program shapes so far (the compile-cache cost
+                            of bucketed prefill, watched so it stays
+                            bounded)
 """
 
 from __future__ import annotations
@@ -50,6 +58,9 @@ class ServingMetrics:
         self.rejected = 0
         self._ttft_sum = 0.0
         self._ttft_n = 0
+        self.prefill_prompt_tokens = 0
+        self.prefill_padded_tokens = 0
+        self.prefill_programs = 0
 
     # ----------------------------------------------------------- recording
     def start(self) -> None:
@@ -72,7 +83,25 @@ class ServingMetrics:
     def on_rejected(self, n: int = 1) -> None:
         self.rejected += int(n)
 
+    def on_prefill(self, n_prompts: int, bucket_len: int,
+                   prompt_tokens: int, n_programs: int) -> None:
+        """One batched bucketed prefill: ``n_prompts`` prompts padded to
+        ``bucket_len`` (``prompt_tokens`` true tokens among them);
+        ``n_programs`` is the engine's running count of distinct compiled
+        (batch, bucket) prefill shapes."""
+        self.prefill_prompt_tokens += int(prompt_tokens)
+        self.prefill_padded_tokens += int(n_prompts) * int(bucket_len)
+        self.prefill_programs = int(n_programs)
+
     # ------------------------------------------------------------ reading
+    @property
+    def padding_waste(self) -> float:
+        """Fraction of padded prefill positions that carried no prompt
+        token (0.0 before the first prefill)."""
+        if not self.prefill_padded_tokens:
+            return 0.0
+        return 1.0 - self.prefill_prompt_tokens / self.prefill_padded_tokens
+
     @property
     def mean_ttft_s(self) -> float:
         return self._ttft_sum / self._ttft_n if self._ttft_n else 0.0
@@ -91,6 +120,8 @@ class ServingMetrics:
             "serving/slot_occupancy": float(occupancy),
             "serving/requests_done": float(self.requests_done),
             "serving/rejected_total": float(self.rejected),
+            "serving/prefill_padding_waste": float(self.padding_waste),
+            "serving/prefill_programs": float(self.prefill_programs),
         }
 
     # ------------------------------------------------------------ emitting

@@ -319,14 +319,15 @@ def test_ds_quantize_reference_semantics():
     out = np.asarray(ds_quantize(jnp.asarray(x), G, bits))
     flat = x.reshape(G, -1)
     qs = (1 << bits) / (2 * np.abs(flat).max(1, keepdims=True) + 1e-5)
-    ref = np.round(flat * qs) / qs
+    ref = np.clip(np.round(flat * qs),
+                  -(1 << (bits - 1)), (1 << (bits - 1)) - 1) / qs
     np.testing.assert_allclose(out.reshape(G, -1), ref, rtol=1e-6)
 
     # asym nearest vs quantizer.cu:565 math
     out = np.asarray(ds_quantize(jnp.asarray(x), G, bits, asymmetric=True))
     mn, mx = flat.min(1, keepdims=True), flat.max(1, keepdims=True)
     sc = ((mx - mn) + 1e-5) / (1 << bits)
-    ref = np.round((flat - mn) / sc) * sc + mn
+    ref = np.clip(np.round((flat - mn) / sc), 0, (1 << bits) - 1) * sc + mn
     np.testing.assert_allclose(out.reshape(G, -1), ref, rtol=1e-5,
                                atol=1e-6)
 
@@ -342,13 +343,47 @@ def test_ds_quantize_reference_semantics():
         err = np.abs(outs.reshape(64, G, -1) - x.reshape(1, G, -1))
         assert float(err.max()) <= float(step.max()) * 1.001
         # mean over draws converges on the input (unbiased rounding) far
-        # tighter than a single nearest-rounding error bound
-        mean_err = np.abs(outs.mean(0) - x).max()
-        assert mean_err < float(step.max()) * 0.35, mean_err
+        # tighter than a single nearest-rounding error bound — except the
+        # group MAX under asym, where the saturating clamp pins the top
+        # code (a deliberate one-sided bias; the alternative is int8 wrap
+        # to the bottom of the range)
+        mean_err = np.abs(outs.mean(0) - x).reshape(G, -1)
+        if asym:
+            xg = x.reshape(G, -1)
+            near_top = xg >= xg.max(1, keepdims=True) - step.reshape(G, 1)
+            mean_err = np.where(near_top, 0.0, mean_err)
+        assert mean_err.max() < float(step.max()) * 0.35, mean_err.max()
 
     # stochastic requires a key
     with pytest.raises(ValueError, match="key"):
         ds_quantize(jnp.asarray(x), G, stochastic=True)
+
+
+def test_ds_quantize_saturates_at_group_extremes():
+    """Regression: the code one past the top of the range must never be
+    produced. At the group max, sym round() lands on +2^(bits-1) (one
+    past high_q) and asym round()/floor+bump land on 2^bits — an int8
+    store would wrap either to the OPPOSITE end of the range, turning the
+    group's largest value into its smallest. The saturating clamp keeps
+    every dequantized value within one grid step of its input instead."""
+    from deepspeed_tpu.ops.quantizer import ds_quantize
+    G, bits = 2, 8
+    # large magnitudes make 1e-5 range padding negligible, so the top
+    # code is actually reached; include the exact +/- extremes per group
+    x = np.asarray([[100.0, -100.0, 3.0, 0.5],
+                    [-40.0, 40.0, -7.0, 0.25]], np.float32)
+    step_sym = (2 * np.abs(x).max(1) + 1e-5) / (1 << bits)
+    step_asym = (x.max(1) - x.min(1) + 1e-5) / (1 << bits)
+    for asym, step in ((False, step_sym), (True, step_asym)):
+        for stochastic in (False, True):
+            out = np.asarray(ds_quantize(
+                jnp.asarray(x), G, bits, asymmetric=asym,
+                stochastic=stochastic,
+                key=jax.random.PRNGKey(3) if stochastic else None))
+            err = np.abs(out - x)
+            assert err.max() <= step.max() * 1.001, (
+                f"asym={asym} stochastic={stochastic}: wrap-scale error "
+                f"{err.max()} vs grid step {step.max()}")
 
 
 def test_int8_asymmetric_tree_and_engine():

@@ -189,6 +189,53 @@ class TestScheduler:
         sched.record_first_token(r, 1)
         assert r.ttft_s == pytest.approx(0.25)
 
+    def test_step_tokens_chunk_matches_per_token_calls(self):
+        """A fused chunk's token list must behave exactly like K
+        step_tokens calls: per-token allocator advance, termination
+        mid-list, trailing speculative tokens dropped."""
+        sched, alloc, _ = _sched(max_batch=2, max_seq=32)
+        a = Request(prompt=[1, 2], max_new_tokens=10, eos_token_id=7)
+        b = Request(prompt=[3], max_new_tokens=3)
+        sched.submit(a)
+        sched.submit(b)
+        sched.admit()
+        sched.record_first_token(a, 4)
+        sched.record_first_token(b, 5)
+        fill_a, fill_b = int(alloc.fill[a.slot]), int(alloc.fill[b.slot])
+        # a hits EOS at its 3rd chunk token; b exhausts max_new_tokens at
+        # its 2nd — trailing tokens in both lists are speculative junk
+        done = sched.step_tokens_chunk({a.slot: [9, 9, 7, 8, 8],
+                                        b.slot: [6, 6, 6, 6]})
+        assert sorted(r.uid for r in done) == sorted([a.uid, b.uid])
+        assert a.status == "done" and a.tokens == [4, 9, 9, 7]
+        assert b.status == "done" and b.tokens == [5, 6, 6]
+        # fill advanced once per CONSUMED token, then reset by free()
+        assert alloc.n_free == 2
+        # unknown slot still raises
+        with pytest.raises(KeyError):
+            sched.step_tokens_chunk({1: [1]})
+
+    def test_step_tokens_chunk_advances_fill_per_token(self):
+        """The cache-row safety net must see the same remaining count the
+        per-token loop would — fill advances inside the chunk, not once
+        at the end."""
+        sched, alloc, _ = _sched(max_batch=1, max_seq=8)
+        r = Request(prompt=[1, 2, 3], max_new_tokens=5)
+        sched.submit(r)
+        sched.admit()
+        r.max_new_tokens = 99      # white-box: leave only the row limit
+        sched.record_first_token(r, 4)
+        assert int(alloc.fill[r.slot]) == 3
+        sched.step_tokens_chunk({r.slot: [5, 6]})
+        assert r.status == "running"
+        assert int(alloc.fill[r.slot]) == 5
+        # three more writable rows -> the third consumed token drives
+        # remaining() to 0 and the safety net retires the request; the
+        # trailing speculative token is dropped
+        done = sched.step_tokens_chunk({r.slot: [7, 8, 9, 9]})
+        assert done == [r] and r.status == "done"
+        assert r.tokens == [4, 5, 6, 7, 8, 9]
+
 
 # --------------------------------------------------- engine (integration)
 def _tiny(vocab=64, max_seq=48):
@@ -232,6 +279,39 @@ class TestServingEngine:
                 p[None], max_new_tokens=6, temperature=0.0))[0]
             np.testing.assert_array_equal(r.output_ids, ref)
 
+    def test_chunked_decode_matches_per_token_loop(self, tiny_engine):
+        """The fused K-step loop is an execution strategy, not a model
+        change: greedy outputs must be BIT-identical to the per-token
+        loop for mixed-length prompts, mid-chunk EOS, and EOS on the very
+        first (prefill-sampled) token."""
+        rng = np.random.default_rng(1)
+        vocab = tiny_engine.module.cfg.vocab_size
+        prompts = [rng.integers(0, vocab, (n,)).astype(np.int32)
+                   for n in [3, 7, 5, 9, 4, 6]]
+        pt = ServingEngine(engine=tiny_engine, max_batch=3,
+                           max_prompt_len=16, max_queue=8, decode_chunk=1)
+        ck = ServingEngine(engine=tiny_engine, max_batch=3,
+                           max_prompt_len=16, max_queue=8, decode_chunk=8)
+
+        def both(**kw):
+            a = pt.run(list(prompts), **kw)
+            b = ck.run(list(prompts), **kw)
+            for x, y in zip(a, b):
+                assert x.status == y.status == "done"
+                np.testing.assert_array_equal(x.output_ids, y.output_ids)
+            return a
+
+        base = both(max_new_tokens=11)       # K does not divide 11
+        # mid-chunk EOS: a token observed mid-stream becomes the EOS id,
+        # so lanes retire at different in-chunk offsets
+        mid_eos = base[0].tokens[2]
+        both(max_new_tokens=11, eos_token_id=int(mid_eos))
+        # instant EOS: some request's FIRST sampled token is the EOS id —
+        # it retires during admission, before any decode chunk
+        first_eos = base[1].tokens[0]
+        res = both(max_new_tokens=11, eos_token_id=int(first_eos))
+        assert any(len(r.tokens) == 1 for r in res)
+
     def test_engine_rejections_surface(self, tiny_engine):
         serving = ServingEngine(engine=tiny_engine, max_batch=2,
                                 max_prompt_len=8, max_queue=8)
@@ -256,6 +336,108 @@ class TestServingEngine:
             assert f"{label}.csv" in files
         rows = (out / "serving_tokens_per_s.csv").read_text().strip()
         assert len(rows.splitlines()) >= 2            # header + >=1 sample
+
+
+class TestBucketedPrefill:
+    def test_bucket_selection(self, tiny_engine):
+        serving = ServingEngine(engine=tiny_engine, max_batch=2,
+                                max_prompt_len=40)
+        assert serving._buckets == [16, 32, 40]
+        assert serving._bucket_for(3) == 16
+        assert serving._bucket_for(16) == 16
+        assert serving._bucket_for(17) == 32
+        assert serving._bucket_for(40) == 40
+        # a max_prompt_len at/below the smallest bucket collapses to one
+        small = ServingEngine(engine=tiny_engine, max_batch=2,
+                              max_prompt_len=12)
+        assert small._buckets == [12]
+
+    def test_short_prompts_use_small_bucket(self, tiny_engine):
+        """A short prompt must prefill through its own bucket, not
+        max_prompt_len — the compiled shape set and the padding-waste
+        metric both show it."""
+        serving = ServingEngine(engine=tiny_engine, max_batch=2,
+                                max_prompt_len=40, decode_chunk=4)
+        res = serving.run([np.arange(1, 4, dtype=np.int32),      # len 3
+                           np.arange(1, 21, dtype=np.int32)],    # len 20
+                          max_new_tokens=4)
+        assert all(r.status == "done" for r in res)
+        # one (1, 16) and one (1, 32) prefill — never a 40-wide program
+        assert serving._prefill_shapes == {(1, 16), (1, 32)}
+        assert serving.metrics.prefill_programs == 2
+        # 23 true prompt tokens over 48 padded positions
+        assert serving.metrics.padding_waste == pytest.approx(1 - 23 / 48)
+
+    def test_mixed_lengths_same_bucket_batch(self, tiny_engine):
+        """Same-bucket admissions share ONE batched prefill call."""
+        serving = ServingEngine(engine=tiny_engine, max_batch=3,
+                                max_prompt_len=16, decode_chunk=4)
+        res = serving.run([np.arange(1, 4, dtype=np.int32),
+                           np.arange(1, 9, dtype=np.int32),
+                           np.arange(1, 14, dtype=np.int32)],
+                          max_new_tokens=3)
+        assert all(r.status == "done" for r in res)
+        assert serving._prefill_shapes == {(3, 16)}
+
+
+class TestSampling:
+    def test_sample_tokens_top_k_and_greedy(self):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.serving.engine import sample_tokens
+        logits = jnp.asarray(
+            np.random.default_rng(0).normal(size=(4, 32)), jnp.float32)
+        # temperature 0 is argmax regardless of key
+        greedy = np.asarray(sample_tokens(logits, jax.random.PRNGKey(0),
+                                          0.0, None))
+        np.testing.assert_array_equal(greedy,
+                                      np.argmax(np.asarray(logits), -1))
+        # top-k draws stay inside each row's top-k set
+        topk = set()
+        for k in range(16):
+            out = np.asarray(sample_tokens(logits, jax.random.PRNGKey(k),
+                                           1.0, 3))
+            ranked = np.argsort(np.asarray(logits), -1)[:, -3:]
+            for row, tok in enumerate(out):
+                assert tok in ranked[row]
+                topk.add((row, int(tok)))
+        assert len(topk) > 4          # actually stochastic, not argmax
+
+    def test_sampled_serving_is_deterministic_under_seed(self, tiny_engine):
+        """temperature/top-k sampling through the chunked loop: same
+        engine seed -> identical streams; different seed -> different."""
+        rng = np.random.default_rng(2)
+        vocab = tiny_engine.module.cfg.vocab_size
+        prompts = [rng.integers(0, vocab, (5,)).astype(np.int32)
+                   for _ in range(3)]
+
+        def run(seed):
+            serving = ServingEngine(engine=tiny_engine, max_batch=3,
+                                    max_prompt_len=8, decode_chunk=4,
+                                    temperature=1.0, top_k=8, seed=seed)
+            return [r.tokens for r in
+                    serving.run(list(prompts), max_new_tokens=8)]
+
+        assert run(seed=0) == run(seed=0)
+        assert run(seed=0) != run(seed=1)
+
+
+def test_serving_bench_smoke(tmp_path):
+    """Fast end-to-end smoke over the real benchmark path (the
+    bin/serving_smoke.sh entry point): per-token vs chunked loops on the
+    tiny model, greedy parity asserted inside run_bench, JSON-ready
+    result dict with tokens/s for both loops."""
+    from deepspeed_tpu.benchmarks.serving_bench import run_bench
+    result = run_bench(n_requests=4, max_new_tokens=10, max_batch=4,
+                       prompt_len=16, decode_chunk=4,
+                       out_dir=str(tmp_path / "csv"),
+                       with_sequential=False)
+    assert result["greedy_parity"] is True
+    assert result["per_token_tokens_per_s"] > 0
+    assert result["chunked_tokens_per_s"] > 0
+    assert result["prefill_programs"] >= 1
+    assert 0.0 <= result["prefill_padding_waste"] < 1.0
+    assert result["csv_files"], "serving metrics CSVs missing"
 
 
 @pytest.mark.slow
