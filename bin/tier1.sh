@@ -7,6 +7,11 @@
 
 cd "$(dirname "$0")/.." || exit 1
 
+# tracelint first: pure-AST tracer-safety gate (no JAX import, <1 s) —
+# hot-path host syncs / retrace hazards fail fast, before pytest
+# collection spends minutes. See docs/analysis.md.
+python bin/tracelint deepspeed_tpu || exit $?
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

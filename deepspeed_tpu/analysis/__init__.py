@@ -1,0 +1,30 @@
+"""tracelint: JAX/TPU tracer-safety static analysis.
+
+Two engines:
+
+* **Engine 1** (``astlint`` + ``baseline`` + ``cli``): a pure-AST linter
+  — no JAX import — enforcing host-sync, nondeterminism, captured-state
+  mutation, and weak-typed-jit-arg rules inside hot contexts, with a
+  committed suppression baseline. CLI wrapper: ``bin/tracelint``.
+* **Engine 2** (``auditor``): :class:`TraceAuditor`, a context manager
+  wrapping ``jax.jit`` to enforce per-program retrace budgets, catch
+  donation-after-use, and audit jaxprs for large baked-in constants and
+  unexpected host callbacks.
+
+See docs/analysis.md for the rule catalogue and workflows.
+"""
+
+from .rules import RULES, Finding
+from .astlint import lint_file, lint_paths, lint_source
+from .baseline import (BaselineEntry, BaselineFormatError, apply_baseline,
+                       format_baseline, load_baseline, parse_baseline)
+from .auditor import (DonationError, ProgramRecord, RetraceBudgetError,
+                      TraceAuditError, TraceAuditor)
+
+__all__ = [
+    "RULES", "Finding", "lint_file", "lint_paths", "lint_source",
+    "BaselineEntry", "BaselineFormatError", "apply_baseline",
+    "format_baseline", "load_baseline", "parse_baseline",
+    "TraceAuditor", "TraceAuditError", "RetraceBudgetError",
+    "DonationError", "ProgramRecord",
+]

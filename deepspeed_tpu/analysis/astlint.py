@@ -1,0 +1,621 @@
+"""tracelint Engine 1: pure-AST tracer-safety linter (no JAX import).
+
+Lints Python sources for violations of the hot-path invariants the
+runtime is built around (runtime/engine.py's "device_get IS the sync",
+serving/engine.py's one-sync-per-chunk loop). Everything here is static:
+``ast`` only, no imports of the linted code, so the whole package lints
+in milliseconds and the check can run before pytest even collects.
+
+Hot contexts
+------------
+The linter never flags a callee in isolation — ``jax.device_get`` at a
+checkpoint boundary is correct. It flags callees inside three contexts:
+
+* **traced** functions: reachable from a ``jax.jit``/``lax.scan``-family
+  entry point. Seeds: jit-decorated defs, function arguments to trace
+  entries (``scan``/``while_loop``/``grad``/``vmap``/...), and function
+  names passed to callables whose own name mentions ``jit`` (the
+  ``self._jit_state_step(train_step)`` factory idiom). Reachability is a
+  fixpoint over same-module calls by bare name.
+* **per-step loops**: ``for``/``while`` bodies that dispatch a compiled
+  program each iteration (the serve/train/power-iteration loops).
+* **hot functions**: any function that dispatches a compiled program.
+
+Compiled-callable detection is structural plus one repo convention:
+assignment targets of ``jax.jit(...)`` / ``partial(jax.jit, ...)`` /
+jit-factory calls, names of jit-decorated defs, and any name or
+attribute starting with ``_jit``. Factories (functions *returning* a
+jitted callable, like ``Eigenvalue._build_hvp`` or
+``TPUEngine._jit_state_step``) are resolved to a fixpoint so
+``self._hvp = self._build_hvp(...)`` marks ``_hvp`` as dispatchable.
+
+Suppression: a trailing or preceding-line ``# tracelint:
+disable=<rule>[,<rule>...]`` comment silences a finding in source; the
+committed baseline (baseline.py) silences it centrally with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .rules import Finding, RULES, normalize_code
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+# dotted callable -> positional indices holding traced functions
+_TRACE_ENTRIES: Dict[str, Tuple[int, ...]] = {}
+for _lax in ("jax.lax", "lax"):
+    _TRACE_ENTRIES.update({
+        f"{_lax}.scan": (0,),
+        f"{_lax}.while_loop": (0, 1),
+        f"{_lax}.fori_loop": (2,),
+        f"{_lax}.cond": (1, 2),
+        f"{_lax}.map": (0,),
+        f"{_lax}.associative_scan": (0,),
+    })
+for _j in ("jax", ""):
+    _p = "jax." if _j else ""
+    _TRACE_ENTRIES.update({
+        f"{_p}grad": (0,),
+        f"{_p}value_and_grad": (0,),
+        f"{_p}jacfwd": (0,),
+        f"{_p}jacrev": (0,),
+        f"{_p}hessian": (0,),
+        f"{_p}vmap": (0,),
+        f"{_p}pmap": (0,),
+        f"{_p}jvp": (0,),
+        f"{_p}vjp": (0,),
+        f"{_p}linearize": (0,),
+        f"{_p}checkpoint": (0,),
+        f"{_p}remat": (0,),
+        f"{_p}eval_shape": (0,),
+        f"{_p}make_jaxpr": (0,),
+    })
+_TRACE_ENTRIES.update({"jax.jit": (0,), "jit": (0,)})
+
+_NONDET_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "datetime.")
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "sort", "reverse", "appendleft", "write"}
+
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+_DISABLE_RE = re.compile(r"#\s*tracelint:\s*disable=([\w\-, ]+)")
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _iter_scoped(node, *, skip_defs=True):
+    """Walk a function/module body without crossing nested def/class/
+    lambda boundaries (their bodies are separate lint scopes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if skip_defs and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _dec_is_jit(dec) -> Tuple[bool, bool]:
+    """(is jit decorator, declares static args)."""
+    if _dotted(dec) in _JIT_NAMES:
+        return True, False
+    if isinstance(dec, ast.Call):
+        d = _dotted(dec.func)
+        if d in _JIT_NAMES:
+            return True, _has_static_kw(dec)
+        if d in _PARTIAL_NAMES and dec.args and \
+                _dotted(dec.args[0]) in _JIT_NAMES:
+            return True, _has_static_kw(dec)
+    return False, False
+
+
+def _has_static_kw(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords if kw.arg)
+
+
+class _ModuleLint:
+    """One linted module: index pass + rule passes."""
+
+    def __init__(self, relpath: str, tree: ast.Module, lines: List[str]):
+        self.relpath = relpath
+        self.tree = tree
+        self.lines = lines
+        self.findings: List[Finding] = []
+
+        # ---- function index -------------------------------------------
+        self.funcs: List[ast.FunctionDef] = []
+        self.qualname: Dict[int, str] = {}
+        self.by_name: Dict[str, List[ast.FunctionDef]] = {}
+        self.module_method: Set[int] = set()   # methods of *Module classes
+        self._index(tree, "", None)
+
+        # ---- jit knowledge --------------------------------------------
+        self.jit_roots: Set[int] = set()
+        for fn in self.funcs:
+            for dec in fn.decorator_list:
+                is_jit, _static = _dec_is_jit(dec)
+                if is_jit:
+                    self.jit_roots.add(id(fn))
+        self.factories: Set[str] = self._factory_fixpoint()
+        # name -> declares-static (False/unknown means "assume traced")
+        self.jit_callables: Dict[str, bool] = {}
+        self._collect_jit_bindings()
+        self.traced: Set[int] = self._traced_closure()
+
+    # ------------------------------------------------------------ index
+    def _index(self, node, prefix: str, cls: Optional[ast.ClassDef]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = prefix + child.name
+                self.funcs.append(child)
+                self.qualname[id(child)] = q
+                self.by_name.setdefault(child.name, []).append(child)
+                if cls is not None and any(
+                        (_dotted(b) or "").endswith("Module")
+                        for b in cls.bases):
+                    self.module_method.add(id(child))
+                self._index(child, q + ".", None)
+            elif isinstance(child, ast.ClassDef):
+                self._index(child, prefix + child.name + ".", child)
+            else:
+                self._index(child, prefix, cls)
+
+    # ------------------------------------------------- jitted callables
+    def _value_is_jitted(self, value) -> Optional[bool]:
+        """Does this expression evaluate to a compiled callable?
+        Returns declares-static, or None if not jitted."""
+        if isinstance(value, ast.Call):
+            d = _dotted(value.func)
+            if d in _JIT_NAMES:
+                return _has_static_kw(value)
+            # partial(jax.jit, ...)(f)
+            if isinstance(value.func, ast.Call):
+                is_jit, static = _dec_is_jit(value.func)
+                if is_jit:
+                    return static
+            # call to a known jit factory (by bare trailing name)
+            if d is not None and d.split(".")[-1] in self.factories:
+                return False
+        # bare reference to a jit-decorated def: self._insert = _insert
+        if isinstance(value, ast.Name):
+            for fn in self.by_name.get(value.id, []):
+                if id(fn) in self.jit_roots:
+                    return False
+        return None
+
+    def _factory_fixpoint(self) -> Set[str]:
+        factories: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.funcs:
+                if fn.name in factories:
+                    continue
+                for node in _iter_scoped(fn):
+                    if not (isinstance(node, ast.Return) and node.value):
+                        continue
+                    v = node.value
+                    is_fac = False
+                    if isinstance(v, ast.Call):
+                        d = _dotted(v.func)
+                        if d in _JIT_NAMES or \
+                                (d and d.split(".")[-1] in factories):
+                            is_fac = True
+                        elif isinstance(v.func, ast.Call) and \
+                                _dec_is_jit(v.func)[0]:
+                            is_fac = True
+                    elif isinstance(v, ast.Name):
+                        # returns a nested jit-decorated def
+                        for cand in self.by_name.get(v.id, []):
+                            if id(cand) in self.jit_roots:
+                                is_fac = True
+                    if is_fac:
+                        factories.add(fn.name)
+                        changed = True
+                        break
+        return factories
+
+    def _collect_jit_bindings(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            else:
+                continue
+            static = self._value_is_jitted(value)
+            if static is None:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Subscript):
+                    tgt = tgt.value
+                name = tgt.id if isinstance(tgt, ast.Name) else (
+                    tgt.attr if isinstance(tgt, ast.Attribute) else None)
+                if name:
+                    prev = self.jit_callables.get(name)
+                    self.jit_callables[name] = bool(prev) or static
+        for fn in self.funcs:        # jit-decorated defs are callables too
+            if id(fn) in self.jit_roots:
+                static = any(_dec_is_jit(d)[1] for d in fn.decorator_list)
+                self.jit_callables[fn.name] = \
+                    self.jit_callables.get(fn.name, False) or static
+
+    def _dispatch_target(self, call: ast.Call) -> Optional[str]:
+        """Name of the compiled callable this Call dispatches, if any."""
+        func = call.func
+        if isinstance(func, ast.Subscript):      # self._jit_fwd[key](...)
+            func = func.value
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Call):         # jax.jit(f)(...) inline
+            return "<inline-jit>" \
+                if self._value_is_jitted(func) is not None else None
+        else:
+            return None
+        if name in self.jit_callables or name.startswith("_jit"):
+            return name
+        return None
+
+    # ----------------------------------------------------- traced set
+    def _traced_closure(self) -> Set[int]:
+        traced: Set[int] = set(self.jit_roots)
+        seeds: Set[str] = set()
+        self.traced_lambdas: List[ast.Lambda] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            idxs = _TRACE_ENTRIES.get(d or "")
+            if idxs is None and isinstance(node.func, ast.Call) and \
+                    _dec_is_jit(node.func)[0]:
+                idxs = (0,)                      # partial(jax.jit,...)(f)
+            if idxs is not None:
+                for i in idxs:
+                    if i < len(node.args):
+                        a = node.args[i]
+                        if isinstance(a, ast.Name):
+                            seeds.add(a.id)
+                        elif isinstance(a, ast.Lambda):
+                            self.traced_lambdas.append(a)
+            elif d is not None and "jit" in d.split(".")[-1].lower():
+                # factory idiom: self._jit_state_step(train_step)
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in self.by_name:
+                        seeds.add(a.id)
+        work = [fn for name in seeds for fn in self.by_name.get(name, [])]
+        for fn in work:
+            traced.add(id(fn))
+        work = [fn for fn in self.funcs if id(fn) in traced]
+        while work:
+            fn = work.pop()
+            for node in _iter_scoped(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = None
+                if isinstance(node.func, ast.Name):
+                    name = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                for callee in self.by_name.get(name or "", []):
+                    if id(callee) not in traced:
+                        traced.add(id(callee))
+                        work.append(callee)
+        return traced
+
+    # ------------------------------------------------------------ emit
+    def _emit(self, node, rule: str, message: str, func: str) -> None:
+        line = getattr(node, "lineno", 1)
+        src = self.lines[line - 1] if line <= len(self.lines) else ""
+        for probe in (src, self.lines[line - 2] if line >= 2 else ""):
+            m = _DISABLE_RE.search(probe)
+            if m:
+                names = {s.strip() for s in m.group(1).split(",")}
+                if rule in names or "all" in names:
+                    return
+        self.findings.append(Finding(
+            path=self.relpath, line=line,
+            col=getattr(node, "col_offset", 0) + 1, rule=rule,
+            message=message, func=func, code=normalize_code(src)))
+
+    # ------------------------------------------------- in-trace rules
+    @staticmethod
+    def _binding_names(t):
+        """Names BOUND by an assignment target. A Subscript/Attribute
+        target's base name is being mutated, not bound — walking into it
+        would hide captured-state mutation behind a fake 'local'."""
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, ast.Starred):
+            yield from _ModuleLint._binding_names(t.value)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from _ModuleLint._binding_names(e)
+
+    def _local_names(self, fn) -> Set[str]:
+        names: Set[str] = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs +
+                  ([args.vararg] if args.vararg else []) +
+                  ([args.kwarg] if args.kwarg else [])):
+            names.add(a.arg)
+        for node in _iter_scoped(fn, skip_defs=False):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    names.update(self._binding_names(t))
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                   ast.For, ast.comprehension)):
+                names.update(self._binding_names(node.target))
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                names.update(self._binding_names(node.optional_vars))
+        return names
+
+    def _mentions_any(self, node, names: Set[str]) -> bool:
+        return any(isinstance(s, ast.Name) and s.id in names
+                   for s in ast.walk(node))
+
+    def _is_static_probe(self, node) -> bool:
+        """float()/int() over .shape/.ndim/len() etc. is trace-safe."""
+        for s in ast.walk(node):
+            if isinstance(s, ast.Attribute) and s.attr in _STATIC_ATTRS:
+                return True
+            if isinstance(s, ast.Call) and _dotted(s.func) == "len":
+                return True
+        return False
+
+    def _arg_names(self, fn) -> Set[str]:
+        args = fn.args
+        return {a.arg for a in (
+            args.posonlyargs + args.args + args.kwonlyargs +
+            ([args.vararg] if args.vararg else []) +
+            ([args.kwarg] if args.kwarg else []))}
+
+    def _lint_traced(self, fn, qual: str) -> None:
+        # traced inputs (for concretization checks) vs anything locally
+        # bound (for captured-state mutation checks)
+        arg_names = self._arg_names(fn)
+        locals_ = self._local_names(fn)
+        for node in _iter_scoped(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                self._emit(node, "mutation-in-trace",
+                           f"{type(node).__name__.lower()} rebinding "
+                           "inside a traced function runs at trace time, "
+                           "not per step", qual)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    self._check_mutation_target(t, fn, locals_, qual)
+            elif isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                # discarded-result calls: the only form container mutation
+                # takes (list.append/dict.update return None); calls whose
+                # result is consumed are functional APIs (optax .update)
+                self._check_mutator_call(node.value, locals_, qual)
+            elif isinstance(node, ast.Call):
+                self._check_traced_call(node, arg_names, locals_, qual)
+
+    def _check_mutation_target(self, t, fn, locals_: Set[str],
+                               qual: str) -> None:
+        if isinstance(t, ast.Attribute):
+            if id(fn) in self.module_method:
+                return              # flax-style module attrs are fine
+            base = t
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in locals_ and \
+                    base.id != "self":
+                return              # mutating an object built locally
+            self._emit(t, "mutation-in-trace",
+                       "attribute write under trace mutates Python state "
+                       "once at trace time — carry it through the "
+                       "program's inputs/outputs instead", qual)
+        elif isinstance(t, ast.Subscript):
+            base = t.value
+            while isinstance(base, (ast.Attribute, ast.Subscript)):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id not in locals_:
+                self._emit(t, "mutation-in-trace",
+                           f"subscript write to captured '{base.id}' "
+                           "under trace mutates host state at trace time",
+                           qual)
+
+    def _check_traced_call(self, node: ast.Call, arg_names: Set[str],
+                           locals_: Set[str], qual: str) -> None:
+        d = _dotted(node.func)
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        if d in ("jax.device_get", "device_get") or \
+                d == "jax.block_until_ready" or attr == "block_until_ready":
+            self._emit(node, "host-sync",
+                       "host synchronization inside a traced function — "
+                       "under jit this is a trace error or a hidden "
+                       "callback; return the value instead", qual)
+            return
+        if attr == "item" and not node.args:
+            self._emit(node, "host-sync",
+                       ".item() inside a traced function concretizes a "
+                       "tracer — return the array and sync at the "
+                       "boundary", qual)
+            return
+        if d in ("float", "int", "bool") and len(node.args) == 1 and \
+                not node.keywords:
+            arg = node.args[0]
+            if self._mentions_any(arg, arg_names) and \
+                    not self._is_static_probe(arg):
+                self._emit(node, "host-sync",
+                           f"{d}() on a traced value concretizes it at "
+                           "trace time (ConcretizationTypeError on real "
+                           "tracers, silent baking on constants)", qual)
+            return
+        if d:
+            if d.startswith(_NONDET_PREFIXES):
+                self._emit(node, "nondet-in-trace",
+                           f"'{d}' inside a traced function is evaluated "
+                           "once at trace time — every execution replays "
+                           "the same value; thread jax.random keys or "
+                           "pass host values as arguments", qual)
+                return
+    def _check_mutator_call(self, node: ast.Call, locals_: Set[str],
+                            qual: str) -> None:
+        if not isinstance(node.func, ast.Attribute):
+            return
+        attr = node.func.attr
+        if attr not in _MUTATORS:
+            return
+        base = node.func.value
+        while isinstance(base, (ast.Attribute, ast.Subscript)):
+            base = base.value
+        if isinstance(base, ast.Name) and base.id not in locals_:
+            self._emit(node, "mutation-in-trace",
+                       f"'.{attr}()' on captured '{base.id}' inside "
+                       "a traced function mutates host state at "
+                       "trace time, not per step", qual)
+
+    # ------------------------------------------------ host-side rules
+    def _lint_host(self, fn, qual: str) -> None:
+        """Per-step-loop and hot-function sync rules for untraced code."""
+        dispatches = [n for n in _iter_scoped(fn)
+                      if isinstance(n, ast.Call) and
+                      self._dispatch_target(n) is not None]
+        if not dispatches:
+            return
+        hot_loops = []
+        for node in _iter_scoped(fn):
+            if isinstance(node, (ast.For, ast.While)) and any(
+                    isinstance(n, ast.Call) and
+                    self._dispatch_target(n) is not None
+                    for n in _iter_scoped(node)):
+                hot_loops.append(node)
+        loop_members: Set[int] = set()
+        for loop in hot_loops:
+            for n in _iter_scoped(loop):
+                loop_members.add(id(n))
+
+        for node in _iter_scoped(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else None
+            sync = None
+            if d in ("jax.device_get", "device_get",
+                     "jax.block_until_ready") or \
+                    attr == "block_until_ready":
+                sync = d or f".{attr}()"
+            elif attr == "item" and not node.args:
+                sync = ".item()"
+            if sync is None:
+                continue
+            if id(node) in loop_members:
+                self._emit(node, "host-sync",
+                           f"{sync} inside a per-step dispatch loop — one "
+                           "host sync per iteration serializes the device "
+                           "(carry the value on device and sync once "
+                           "after the loop)", qual)
+            else:
+                self._emit(node, "host-sync",
+                           f"{sync} in a function that dispatches jitted "
+                           "programs — keep the hot path async or "
+                           "baseline this with a reason", qual)
+
+    # ----------------------------------------------------- weak args
+    def _lint_weak_args(self) -> None:
+        for fn in self.funcs + [self.tree]:
+            qual = self.qualname.get(id(fn), "<module>")
+            for node in _iter_scoped(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self._dispatch_target(node)
+                if target is None or self.jit_callables.get(target, False):
+                    continue        # unknown/static-aware bindings pass
+                literals = [a for a in node.args
+                            if isinstance(a, ast.Constant) and
+                            isinstance(a.value, (bool, float))]
+                literals += [kw.value for kw in node.keywords
+                             if kw.arg and isinstance(kw.value, ast.Constant)
+                             and isinstance(kw.value.value, (bool, float))]
+                for lit in literals:
+                    self._emit(lit, "weak-jit-arg",
+                               f"Python {type(lit.value).__name__} literal "
+                               f"passed to jitted '{target}' compiled "
+                               "without static_argnums — weak-typed "
+                               "tracer args retrace per distinct "
+                               "value/type; mark static or pass an array",
+                               qual)
+
+    # ------------------------------------------------------------ run
+    def run(self) -> List[Finding]:
+        for fn in self.funcs:
+            qual = self.qualname[id(fn)]
+            if id(fn) in self.traced:
+                self._lint_traced(fn, qual)
+            else:
+                self._lint_host(fn, qual)
+        self._lint_host(self.tree, "<module>")
+        for lam in self.traced_lambdas:
+            for node in ast.walk(lam):
+                if isinstance(node, ast.Call):
+                    self._check_traced_call(node, set(), set(), "<lambda>")
+        self._lint_weak_args()
+        return self.findings
+
+
+def lint_source(source: str, relpath: str) -> List[Finding]:
+    """Lint one module's source text (the unit the tests drive)."""
+    tree = ast.parse(source, filename=relpath)
+    return _ModuleLint(relpath, tree, source.splitlines()).run()
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    root = root or os.getcwd()
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+def iter_py_files(paths: Iterable[str]):
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        yield os.path.join(dirpath, fname)
+        elif p.endswith(".py"):
+            yield p
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint every ``.py`` under ``paths`` (files or directory trees)."""
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, root))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,315 @@
+"""tracelint Engine 2: runtime-assisted trace audits.
+
+:class:`TraceAuditor` wraps ``jax.jit`` inside a ``with`` block so every
+program compiled in scope is accounted for:
+
+* **retrace budgets** — per-program compilation counts (measured as
+  jit-cache growth via ``_cache_size()``, so cache hits are free and a
+  silent reshape/weak-type retrace is not). A program exceeding its
+  declared budget raises :class:`RetraceBudgetError` at the offending
+  call, with the argument signature that triggered the recompile.
+* **donation violations** — argument buffers passed under
+  ``donate_argnums``/``donate_argnames`` are registered; if any later
+  audited call receives one of them again, :class:`DonationError` fires.
+  This is bookkeeping on array identity, NOT ``is_deleted()``: on CPU
+  (where CI runs) XLA ignores donation and never deletes the buffer, so
+  the reuse would silently "work" locally and corrupt results on TPU.
+  The auditor makes the CPU run fail the same way the TPU would.
+* **jaxpr audits** — on each compile the program is re-traced
+  (``jitted.trace``, trace-only: no XLA compile) and its jaxpr walked
+  for (a) closure constants bigger than ``const_bytes_limit`` — params
+  captured by value instead of passed as arguments, the classic
+  "the program bakes the model in and retraces every update" bug — and
+  (b) host-callback primitives (``pure_callback`` / ``io_callback`` /
+  ``debug_callback``) that put a host round-trip inside a hot program.
+  These accumulate as findings; ``check()`` (called on ``__exit__``)
+  raises :class:`TraceAuditError` if any were recorded.
+
+Programs are keyed by the wrapped function's ``__name__``. Budgets are
+declared up front (``budgets={"decode_chunk_fn": 2}``) or later via
+``expect()``; unbudgeted programs are counted but never fail. Only jits
+created INSIDE the context are audited — wrapping survives ``__exit__``
+(the returned callables keep counting), so a warmup-scoped ``with``
+still audits the steady state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+class TraceAuditError(AssertionError):
+    """Base: a trace-audit invariant was violated."""
+
+
+class RetraceBudgetError(TraceAuditError):
+    """A program compiled more times than its declared budget."""
+
+
+class DonationError(TraceAuditError):
+    """A donated buffer was passed to a program again after donation."""
+
+
+@dataclasses.dataclass
+class ProgramRecord:
+    name: str
+    budget: Optional[int] = None
+    compiles: int = 0
+    calls: int = 0
+    donated_leaves: int = 0
+    large_consts: List[str] = dataclasses.field(default_factory=list)
+    callbacks: List[str] = dataclasses.field(default_factory=list)
+
+
+def _normalize_donate(kwargs) -> tuple:
+    dn = kwargs.get("donate_argnums")
+    if dn is None:
+        return ()
+    if isinstance(dn, int):
+        return (dn,)
+    return tuple(dn)
+
+
+def _arg_signature(args, kwargs) -> str:
+    """Compact shape/dtype signature for retrace diagnostics."""
+    def one(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return f"{dtype}[{','.join(map(str, shape))}]"
+        return type(x).__name__
+    try:
+        import jax
+        parts = [one(l) for l in
+                 jax.tree_util.tree_leaves((args, kwargs))[:16]]
+    except Exception:
+        parts = [one(a) for a in args]
+    return "(" + ", ".join(parts) + ")"
+
+
+class _AuditedFunction:
+    """Callable wrapper around one jitted program; delegates everything
+    else (``lower``, ``trace``, ``_cache_size``, ...) to the original."""
+
+    def __init__(self, auditor: "TraceAuditor", jitted, fn,
+                 record: ProgramRecord, donate: tuple):
+        self._auditor = auditor
+        self._jitted = jitted
+        self._fn = fn
+        self._record = record
+        self._donate = donate
+        self.__name__ = record.name
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
+
+    def __call__(self, *args, **kwargs):
+        aud, rec = self._auditor, self._record
+        rec.calls += 1
+        aud._check_donated_reuse(rec.name, args, kwargs)
+        before = self._cache_size_safe()
+        out = self._jitted(*args, **kwargs)
+        after = self._cache_size_safe()
+        if after is not None and before is not None and after > before:
+            rec.compiles += after - before
+            if rec.budget is not None and rec.compiles > rec.budget:
+                raise RetraceBudgetError(
+                    f"tracelint: program '{rec.name}' compiled "
+                    f"{rec.compiles}x, over its declared retrace budget "
+                    f"of {rec.budget} — triggering call signature "
+                    f"{_arg_signature(args, kwargs)}; widen the budget "
+                    "only if this retrace is by design")
+            if aud.audit_jaxprs:
+                aud._audit_jaxpr(self._jitted, rec, args, kwargs)
+        if self._donate:
+            aud._register_donated(rec.name, self._donate, args)
+        return out
+
+    def _cache_size_safe(self) -> Optional[int]:
+        try:
+            return self._jitted._cache_size()
+        except Exception:
+            return None
+
+
+class TraceAuditor:
+    """Context manager auditing every ``jax.jit`` created in scope."""
+
+    def __init__(self, budgets: Optional[Dict[str, int]] = None, *,
+                 default_budget: Optional[int] = None,
+                 check_donation: bool = True,
+                 audit_jaxprs: bool = True,
+                 const_bytes_limit: Optional[int] = 1 << 20,
+                 forbid_callbacks: bool = False,
+                 fail_on_exit: bool = True):
+        self.budgets = dict(budgets or {})
+        self.default_budget = default_budget
+        self.check_donation = check_donation
+        self.audit_jaxprs = audit_jaxprs
+        self.const_bytes_limit = const_bytes_limit
+        self.forbid_callbacks = forbid_callbacks
+        self.fail_on_exit = fail_on_exit
+        self.records: Dict[str, ProgramRecord] = {}
+        # id(leaf) -> (weakref-or-leaf, "program[argpos]") of donated args
+        self._donated: Dict[int, Any] = {}
+        self._orig_jit = None
+
+    # ------------------------------------------------------- patching
+    def __enter__(self) -> "TraceAuditor":
+        import jax
+        if self._orig_jit is not None:
+            raise RuntimeError("TraceAuditor is not reentrant")
+        self._orig_jit = jax.jit
+        jax.jit = self._audited_jit
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        import jax
+        jax.jit = self._orig_jit
+        self._orig_jit = None
+        if exc_type is None and self.fail_on_exit:
+            self.check()
+
+    def _audited_jit(self, fun, *jit_args, **jit_kwargs):
+        jitted = self._orig_jit(fun, *jit_args, **jit_kwargs)
+        return self.wrap(jitted, fun=fun,
+                         donate=_normalize_donate(jit_kwargs))
+
+    def wrap(self, jitted, *, fun=None, name: Optional[str] = None,
+             donate: tuple = (), budget: Optional[int] = None):
+        """Audit an already-jitted callable (the non-context path)."""
+        name = name or getattr(fun or jitted, "__name__", repr(jitted))
+        rec = self.records.get(name)
+        if rec is None:
+            rec = ProgramRecord(
+                name=name,
+                budget=budget if budget is not None
+                else self.budgets.get(name, self.default_budget))
+            self.records[name] = rec
+        return _AuditedFunction(self, jitted, fun or jitted, rec, donate)
+
+    # ---------------------------------------------------------- sugar
+    def expect(self, name: str, budget: int) -> None:
+        """Declare/adjust a program's retrace budget after creation."""
+        self.budgets[name] = budget
+        if name in self.records:
+            self.records[name].budget = budget
+
+    def compiles(self, name: str) -> int:
+        rec = self.records.get(name)
+        return rec.compiles if rec else 0
+
+    def report(self) -> Dict[str, Dict[str, Any]]:
+        return {name: dataclasses.asdict(rec)
+                for name, rec in sorted(self.records.items())}
+
+    def check(self) -> None:
+        """Raise on accumulated jaxpr findings (budget/donation raise at
+        the offending call already)."""
+        problems = []
+        for rec in self.records.values():
+            for c in rec.large_consts:
+                problems.append(f"{rec.name}: large baked-in constant {c}")
+            if self.forbid_callbacks:
+                for cb in rec.callbacks:
+                    problems.append(
+                        f"{rec.name}: host callback '{cb}' inside the "
+                        "compiled program")
+        if problems:
+            raise TraceAuditError(
+                "tracelint trace audit failed:\n  " +
+                "\n  ".join(problems))
+
+    # ------------------------------------------------------- donation
+    def _register_donated(self, name: str, donate: tuple, args) -> None:
+        if not self.check_donation:
+            return
+        import jax
+        import weakref
+        if len(self._donated) > 8192:   # shed dead refs on long runs
+            self._donated = {k: v for k, v in self._donated.items()
+                             if v[0]() is not None}
+        for pos in donate:
+            if pos >= len(args):
+                continue
+            for leaf in jax.tree_util.tree_leaves(args[pos]):
+                if not hasattr(leaf, "dtype"):
+                    continue
+                try:
+                    ref = weakref.ref(leaf)
+                except TypeError:
+                    ref = (lambda obj: (lambda: obj))(leaf)
+                self._donated[id(leaf)] = (ref, f"{name}[arg {pos}]")
+
+    def _check_donated_reuse(self, name: str, args, kwargs) -> None:
+        if not self.check_donation or not self._donated:
+            return
+        import jax
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            entry = self._donated.get(id(leaf))
+            if entry is None:
+                continue
+            ref, origin = entry
+            if ref() is leaf:       # identity confirmed, not an id reuse
+                raise DonationError(
+                    f"tracelint: buffer donated to {origin} was passed "
+                    f"to '{name}' again — donated inputs are dead after "
+                    "the call (XLA reuses their memory on TPU; CPU only "
+                    "appears to tolerate this). Use the program's "
+                    "returned arrays instead.")
+
+    # ---------------------------------------------------- jaxpr audit
+    def _audit_jaxpr(self, jitted, rec: ProgramRecord, args,
+                     kwargs) -> None:
+        try:
+            closed = jitted.trace(*args, **kwargs).jaxpr
+        except Exception:
+            return                  # shape-polymorphic/static oddities
+        try:
+            self._scan_consts(closed, rec)
+            self._scan_callbacks(closed.jaxpr, rec, seen=set())
+        except Exception:
+            pass
+
+    def _scan_consts(self, closed, rec: ProgramRecord) -> None:
+        if self.const_bytes_limit is None:
+            return
+        for const in getattr(closed, "consts", []):
+            nbytes = getattr(const, "nbytes", None)
+            if nbytes is None:
+                size = getattr(const, "size", 0)
+                itemsize = getattr(getattr(const, "dtype", None),
+                                   "itemsize", 0)
+                nbytes = size * itemsize
+            if nbytes and nbytes > self.const_bytes_limit:
+                shape = getattr(const, "shape", ())
+                if len(rec.large_consts) < 8:
+                    rec.large_consts.append(
+                        f"{nbytes} bytes shape={tuple(shape)} — pass it "
+                        "as an argument so updates don't retrace")
+
+    def _scan_callbacks(self, jaxpr, rec: ProgramRecord, seen) -> None:
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if "callback" in prim or prim == "debug_print":
+                if len(rec.callbacks) < 8:
+                    rec.callbacks.append(prim)
+            for sub in _sub_jaxprs(eqn.params):
+                self._scan_callbacks(sub, rec, seen)
+
+
+def _sub_jaxprs(params):
+    """Inner jaxprs of an eqn's params (scan/cond/jit bodies)."""
+    for value in params.values():
+        vals = value if isinstance(value, (list, tuple)) else (value,)
+        for v in vals:
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(v, "eqns"):
+                yield v

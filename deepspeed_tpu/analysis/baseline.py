@@ -1,0 +1,125 @@
+"""tracelint suppression baseline.
+
+The baseline is the committed list of findings the team has looked at
+and decided to keep — every entry MUST carry a reason. Format, one entry
+per line::
+
+    <path>::<rule>::<func>::<normalized code>  # <reason>
+
+(the left side is exactly ``Finding.fingerprint``; the separator before
+the reason is two-spaces-hash). Fingerprints carry no line numbers, so
+edits elsewhere in a file don't churn the baseline; editing the flagged
+line itself invalidates the entry — by design, a changed sync site must
+be re-justified.
+
+Two failure modes are distinct on purpose:
+
+* a finding NOT in the baseline fails as a lint violation — fix it or
+  add a justified entry;
+* a baseline entry matching NO current finding fails as a **stale
+  suppression** (``stale-suppression`` rule) — the underlying issue was
+  fixed, so the allowlist must shrink. This keeps the baseline a
+  ratchet, never a landfill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .rules import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    fingerprint: str
+    reason: str
+    line: int           # line in the baseline file (for error reporting)
+
+
+class BaselineFormatError(ValueError):
+    """Malformed baseline line (missing '::' fields or a reason)."""
+
+
+_SEP = "  # "
+
+
+def parse_baseline(text: str, path: str = "<baseline>"
+                   ) -> List[BaselineEntry]:
+    entries: List[BaselineEntry] = []
+    for i, raw in enumerate(text.splitlines(), 1):
+        line = raw.rstrip()
+        if not line or line.lstrip().startswith("#"):
+            continue
+        if _SEP not in line:
+            raise BaselineFormatError(
+                f"{path}:{i}: baseline entry has no reason — append "
+                f"'{_SEP}<why this sync/violation is intentional>'")
+        fingerprint, reason = line.split(_SEP, 1)
+        fingerprint, reason = fingerprint.rstrip(), reason.strip()
+        if fingerprint.count("::") < 3:
+            raise BaselineFormatError(
+                f"{path}:{i}: malformed fingerprint (want "
+                "path::rule::func::code): {fingerprint!r}")
+        if not reason:
+            raise BaselineFormatError(
+                f"{path}:{i}: empty suppression reason")
+        entries.append(BaselineEntry(fingerprint, reason, i))
+    return entries
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_baseline(f.read(), path)
+
+
+def format_baseline(findings: Sequence[Finding],
+                    reasons: Dict[str, str] = None) -> str:
+    """Render findings as baseline lines (used by --write-baseline; the
+    operator then replaces the TODO reasons with real ones)."""
+    reasons = reasons or {}
+    seen = set()
+    lines = ["# tracelint suppression baseline — one justified finding "
+             "per line:",
+             "#   <path>::<rule>::<func>::<code>  # <reason>",
+             "# Stale entries (no longer firing) fail CI: delete them."]
+    for f in findings:
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        reason = reasons.get(f.fingerprint, "TODO: justify or fix")
+        lines.append(f"{f.fingerprint}{_SEP}{reason}")
+    return "\n".join(lines) + "\n"
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   entries: Sequence[BaselineEntry]
+                   ) -> Tuple[List[Finding], List[Finding], int]:
+    """Split findings against the baseline.
+
+    Returns ``(unsuppressed, stale, suppressed_count)`` where ``stale``
+    are synthetic ``stale-suppression`` findings pointing at baseline
+    entries that matched nothing.
+    """
+    by_fp: Dict[str, BaselineEntry] = {e.fingerprint: e for e in entries}
+    matched = set()
+    unsuppressed: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        if f.fingerprint in by_fp:
+            matched.add(f.fingerprint)
+            suppressed += 1
+        else:
+            unsuppressed.append(f)
+    stale = [
+        Finding(path="tracelint_baseline.txt", line=e.line, col=1,
+                rule="stale-suppression",
+                message="remove stale suppression — no current finding "
+                        f"matches '{e.fingerprint}' (the issue it "
+                        "excused was fixed)",
+                func="<baseline>", code=e.fingerprint)
+        for e in entries if e.fingerprint not in matched]
+    return unsuppressed, stale, suppressed

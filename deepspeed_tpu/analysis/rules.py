@@ -1,0 +1,68 @@
+"""tracelint rule registry (Engine 1 — pure AST, no JAX import).
+
+Each rule is a named invariant of the TPU hot path. The registry is the
+single source of truth for rule ids: the linter emits them, in-source
+``# tracelint: disable=<rule>`` comments and the committed baseline
+reference them, and docs/analysis.md documents them one by one.
+
+Rules fire only inside *hot contexts* (see astlint.py): code traced under
+``jax.jit`` / ``lax.scan``-family transforms, per-step host loops that
+dispatch compiled programs, and functions that dispatch compiled
+programs. The same ``jax.device_get`` that is a bug inside a decode loop
+is the correct, documented sync at a report boundary — context, not the
+callee, is what the linter judges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+#: rule id -> one-line description (the CLI's --list-rules output)
+RULES = {
+    "host-sync":
+        "host synchronization (jax.device_get / .item() / float()/int() on "
+        "device values / block_until_ready) inside a traced function, a "
+        "per-step dispatch loop, or a program-dispatching function",
+    "nondet-in-trace":
+        "nondeterminism baked in at trace time: time.*, random.*, "
+        "np.random.* called inside a jit/scan-traced function",
+    "mutation-in-trace":
+        "Python mutation of captured state inside a traced function "
+        "(global/nonlocal rebinding, captured container mutation, object "
+        "attribute writes) — runs once at trace time, not per step",
+    "weak-jit-arg":
+        "Python bool/float literal passed to a jitted callable compiled "
+        "without static_argnums/static_argnames — weak-typed tracer "
+        "arguments that silently retrace or mis-specialize",
+    "stale-suppression":
+        "baseline entry no longer matched by any finding — remove the "
+        "stale suppression (emitted by the baseline checker, not the AST "
+        "walk)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One linter hit. ``fingerprint`` is line-number-free so committed
+    baselines survive unrelated edits above the flagged line."""
+    path: str       # forward-slash path relative to the lint root
+    line: int
+    col: int
+    rule: str
+    message: str
+    func: str       # enclosing def qualname, or "<module>"
+    code: str       # normalized source line (single-spaced)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.rule}::{self.func}::{self.code}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} " \
+               f"[{self.func}] {self.message}"
+
+
+def normalize_code(source_line: str) -> str:
+    """Whitespace-collapsed code line used in fingerprints."""
+    return " ".join(source_line.split())

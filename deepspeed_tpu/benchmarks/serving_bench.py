@@ -34,6 +34,17 @@ import time
 
 import numpy as np
 
+#: compiles the fused decode-chunk program is ALLOWED (and expected) to
+#: spend across warmup: the initial trace (insert-built arena), the
+#: carry retrace inside the first run (a chunk's donated output arena
+#: carries different buffer metadata than the insert-built one), and one
+#: more entering the second run (the insert now consumes a decode-output
+#: arena, so its own output metadata shifts once) — after which the
+#: program NEVER compiles again; the double-warm exists so the timed
+#: pass is charged zero compiles. CI asserts this exact count
+#: (tests/test_tracelint.py) and the bench fails beyond it.
+DECODE_PROGRAM_BUDGET = 3
+
 
 def _tiny_model(vocab_size=512, max_seq_len=64):
     """Small enough that per-step host overhead (dispatch + sync + python
@@ -121,16 +132,37 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
     pt_tps = pt_tokens / pt_dt
 
     # ---- continuous batching, fused chunks (decode_chunk=K) ------------
+    # The decode-chunk program's compile count is ASSERTED, not just
+    # worked around: _timed_serving_run double-warms because arena
+    # buffer metadata shifts twice before steady state (see
+    # DECODE_PROGRAM_BUDGET), so the program compiles exactly three
+    # times and then never again — including across the timed pass. A
+    # fourth compile (e.g. a weak-type or shape leak into the chunk
+    # state) fails the bench at the offending call via the declared
+    # TraceAuditor budget. Jaxpr audits stay off so warmup timing
+    # reflects production compiles; donation tracking validates the
+    # arena handle discipline for free.
+    from ..analysis import TraceAuditor
     monitor = csv_monitor_master(out_dir, "serving_bench")
-    chunked = ServingEngine(engine=engine, max_batch=max_batch,
-                            max_prompt_len=prompt_len,
-                            decode_chunk=decode_chunk,
-                            max_queue=max(n_requests, 8),
-                            monitor=monitor, emit_every_steps=4)
-    ck_results, ck_dt, ck_tokens = _timed_serving_run(
-        chunked, prompts, max_new_tokens)
+    auditor = TraceAuditor(budgets={"decode_chunk_fn": DECODE_PROGRAM_BUDGET},
+                           audit_jaxprs=False)
+    with auditor:
+        chunked = ServingEngine(engine=engine, max_batch=max_batch,
+                                max_prompt_len=prompt_len,
+                                decode_chunk=decode_chunk,
+                                max_queue=max(n_requests, 8),
+                                monitor=monitor, emit_every_steps=4)
+        ck_results, ck_dt, ck_tokens = _timed_serving_run(
+            chunked, prompts, max_new_tokens)
     ck_tps = ck_tokens / ck_dt
     monitor.close()
+    decode_compiles = auditor.compiles("decode_chunk_fn")
+    if decode_compiles != DECODE_PROGRAM_BUDGET:
+        raise RuntimeError(
+            f"decode_chunk compiled {decode_compiles}x, expected exactly "
+            f"{DECODE_PROGRAM_BUDGET} (initial trace + two arena-metadata "
+            "retraces across the double-warm) — the warmup strategy no "
+            "longer matches the program's retrace behavior")
 
     parity = all(
         np.array_equal(a.output_ids, b.output_ids)
@@ -163,6 +195,9 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         "speedup": round(ck_tps / seq_tps, 3) if seq_tps else None,
         "prefill_padding_waste": round(chunked.metrics.padding_waste, 4),
         "prefill_programs": chunked.metrics.prefill_programs,
+        # audited, not assumed: TraceAuditor counts actual XLA compiles
+        "decode_chunk_compiles": decode_compiles,
+        "decode_chunk_budget": DECODE_PROGRAM_BUDGET,
         "mean_ttft_s": round(float(np.mean(ttfts)), 4) if ttfts else None,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],

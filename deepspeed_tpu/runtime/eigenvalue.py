@@ -16,12 +16,10 @@ like the reference's per-block parameter lists.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, List
+from typing import Callable, List
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..utils.logging import log_dist
 
@@ -51,66 +49,102 @@ class Eigenvalue:
         self.gas_boundary_resolution = gas_boundary_resolution
         self.layer_name = layer_name
         self.layer_num = layer_num
-        self._hvp = None
+        self._power_iter = None
         log_dist(f"enabled eigenvalue: max_iter={max_iter} tol={tol} "
                  f"layer_name={layer_name} layer_num={layer_num}", ranks=[0])
 
-    def _build_hvp(self, loss_fn: Callable):
-        """One jitted (params, v, batch, rng, layer_idx) -> (Hv_block, <Hv,v>).
-        loss_fn(params, batch, rng) -> scalar."""
+    def _build_power_iter(self, loss_fn: Callable):
+        """One jitted (params, v0, batch, rng, layer_idx) ->
+        (eigenvalue, iterations) program running the WHOLE power
+        iteration on device. loss_fn(params, batch, rng) -> scalar.
 
-        @functools.partial(jax.jit, static_argnums=())
-        def hvp(params, v, batch, rng, layer_idx):
+        The Rayleigh quotient is carried in the ``lax.while_loop`` state
+        and the convergence test (same predicate as the reference:
+        ``cur == 0 or |cur - prev| / |cur| < tol``, capped at
+        ``max_iter``) runs on device too, so a block's solve performs
+        ZERO host syncs — the old loop paid one blocking ``device_get``
+        per iteration just to decide whether to keep going
+        (tracelint: host-sync in a per-step dispatch loop)."""
+        max_iter, tol = self.max_iter, self.tol
+        stability = self.stability
+        layer_name, layer_num = self.layer_name, self.layer_num
+
+        def _norm(tree):
+            return jnp.sqrt(sum(jnp.vdot(l, l).real
+                                for l in jax.tree.leaves(tree)))
+
+        def power_iterate(params, v0, batch, rng, layer_idx):
+            mask = _block_mask(params, layer_name, layer_num, layer_idx)
             grad_fn = lambda p: jax.grad(
                 lambda q: loss_fn(q, batch, rng).astype(jnp.float32))(p)
-            _, Hv = jax.jvp(grad_fn, (params,), (v,))
-            mask = _block_mask(params, self.layer_name, self.layer_num,
-                               layer_idx)
-            Hv = jax.tree.map(lambda h, m: jnp.nan_to_num(
-                h.astype(jnp.float32), posinf=0.0, neginf=0.0) * m, Hv, mask)
-            ip = sum(jnp.vdot(h, u) for h, u in
-                     zip(jax.tree.leaves(Hv), jax.tree.leaves(v)))
-            return Hv, ip
-        return hvp
 
-    def _norm(self, tree):
-        return jnp.sqrt(sum(jnp.vdot(l, l).real
-                            for l in jax.tree.leaves(tree)))
+            def hvp(v):
+                _, Hv = jax.jvp(grad_fn, (params,), (v,))
+                Hv = jax.tree.map(lambda h, m: jnp.nan_to_num(
+                    h.astype(jnp.float32), posinf=0.0, neginf=0.0) * m,
+                    Hv, mask)
+                ip = sum(jnp.vdot(h, u) for h, u in
+                         zip(jax.tree.leaves(Hv), jax.tree.leaves(v)))
+                return Hv, ip
+
+            v = jax.tree.map(jnp.multiply, v0, mask)
+            nrm = _norm(v) + stability
+            v = jax.tree.map(lambda x: x / nrm, v)
+
+            def not_converged(carry):
+                _, cur, prev, it = carry
+                zero = cur == 0.0
+                rel = jnp.abs((cur - prev) /
+                              jnp.where(zero, jnp.float32(1.0), cur))
+                done = jnp.logical_or(zero, rel < tol)
+                return jnp.logical_and(it < max_iter,
+                                       jnp.logical_not(done))
+
+            def step(carry):
+                v, cur, prev, it = carry
+                Hv, ip = hvp(v)
+                nrm = _norm(Hv) + stability
+                v = jax.tree.map(lambda x: x / nrm, Hv)
+                return v, ip.astype(jnp.float32), cur, it + 1
+
+            _, cur, _, iters = jax.lax.while_loop(
+                not_converged, step,
+                (v, jnp.float32(1.0), jnp.float32(0.0), jnp.int32(0)))
+            return cur, iters
+
+        return jax.jit(power_iterate)
 
     def compute_eigenvalue(self, loss_fn: Callable, params, batch,
                            rng=None) -> List[float]:
         """Dominant |eigenvalue| per layer block, post-processed to [0, 1]
         (reference post_process:150: abs-normalized by the max; failed
-        blocks report 1.0)."""
+        blocks report 1.0). The per-block solves dispatch asynchronously
+        back to back; the ONE host sync happens after every block's
+        device-carried convergence loop has been enqueued."""
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        if self._hvp is None:
-            self._hvp = self._build_hvp(loss_fn)
-        values = []
+        if self._power_iter is None:
+            self._power_iter = self._build_power_iter(loss_fn)
+        eigs, iters = [], []
         for l in range(self.layer_num):
             key = jax.random.fold_in(rng, l)
-            mask = _block_mask(params, self.layer_name, self.layer_num, l)
             leaves, treedef = jax.tree.flatten(params)
             ks = jax.random.split(key, len(leaves))
-            v = jax.tree.unflatten(treedef, [
+            v0 = jax.tree.unflatten(treedef, [
                 jax.random.normal(k, p.shape, jnp.float32)
                 for k, p in zip(ks, leaves)])
-            v = jax.tree.map(jnp.multiply, v, mask)
-            nrm = self._norm(v) + self.stability
-            v = jax.tree.map(lambda x: x / nrm, v)
-
-            cur, prev = 1.0, 0.0
-            for i in range(self.max_iter):
-                Hv, ip = self._hvp(params, v, batch, rng, l)
-                prev, cur = cur, float(jax.device_get(ip))
-                if cur == 0.0 or abs((cur - prev) / cur) < self.tol:
-                    break
-                nrm = self._norm(Hv) + self.stability
-                v = jax.tree.map(lambda x: x / nrm, Hv)
-            values.append(cur)
-            if self.verbose:
-                log_dist(f"block {l}: power iterations {i + 1}, "
-                         f"eigenvalue {cur}", ranks=[0])
+            cur, n_it = self._power_iter(params, v0, batch, rng, l)
+            eigs.append(cur)
+            iters.append(n_it)
+        # one batched transfer for all blocks, after convergence ran on
+        # device — the only intended sync in this module
+        host_eigs, host_iters = jax.device_get(  # tracelint: disable=host-sync
+            (jnp.stack(eigs), jnp.stack(iters)))
+        values = [float(x) for x in host_eigs]
+        if self.verbose:
+            for l, (n_it, val) in enumerate(zip(host_iters, values)):
+                log_dist(f"block {l}: power iterations {int(n_it)}, "
+                         f"eigenvalue {val}", ranks=[0])
         return self.post_process(values)
 
     @staticmethod

@@ -1,0 +1,473 @@
+"""CI gate + unit tests for the tracelint analysis subsystem
+(deepspeed_tpu/analysis/): Engine 1 (pure-AST lint + suppression
+baseline) over the whole package, per-rule seeded violations, and
+Engine 2 (TraceAuditor) retrace/donation/jaxpr audits over synthetic
+programs, the serving chunked-decode path, the train-step path, and the
+eigenvalue module's one-sync contract."""
+
+import os
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.tracelint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "deepspeed_tpu")
+BASELINE = os.path.join(REPO_ROOT, "tracelint_baseline.txt")
+
+from deepspeed_tpu.analysis import (  # noqa: E402
+    DonationError, RetraceBudgetError, TraceAuditError, TraceAuditor,
+    apply_baseline, astlint, cli, load_baseline, parse_baseline,
+    BaselineFormatError, lint_source)
+
+
+def _lint(src):
+    return lint_source(textwrap.dedent(src), "synthetic/mod.py")
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ===================================================== Engine 1: CI gate
+
+def test_package_lints_clean_against_baseline():
+    """THE gate: zero non-baselined findings and zero stale suppressions
+    over the whole package. A new hot-path sync fails here; a fixed one
+    left in the baseline fails here too (ratchet in both directions)."""
+    findings = astlint.lint_paths([PKG_DIR], root=REPO_ROOT)
+    entries = load_baseline(BASELINE)
+    unsuppressed, stale, suppressed = apply_baseline(findings, entries)
+    assert not unsuppressed, "\n".join(f.render() for f in unsuppressed)
+    assert not stale, "\n".join(f.render() for f in stale)
+    assert suppressed > 0      # the baseline is load-bearing, not empty
+
+
+def test_baseline_is_small_and_justified():
+    entries = load_baseline(BASELINE)
+    assert 1 <= len(entries) <= 25
+    for e in entries:
+        assert e.reason.strip(), e.fingerprint
+
+
+def test_cli_exit_zero_on_package(capsys):
+    rc = cli.main([PKG_DIR, "--root", REPO_ROOT, "--baseline", BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "clean" in out
+
+
+def test_eigenvalue_fix_not_in_baseline():
+    """Satellite: the per-iteration sync in runtime/eigenvalue.py was
+    FIXED (device-carried while_loop), not baselined — no eigenvalue
+    entry may ever come back."""
+    entries = load_baseline(BASELINE)
+    assert not [e for e in entries if "eigenvalue" in e.fingerprint]
+
+
+# ====================================== Engine 1: per-rule seeded bugs
+
+def test_rule_host_sync_in_jitted_function():
+    findings = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = x * 2
+            return float(jax.device_get(y))
+    """)
+    assert "host-sync" in _rules(findings), findings
+
+
+def test_rule_host_sync_in_dispatch_loop():
+    findings = _lint("""
+        import jax
+
+        _jit_step = jax.jit(lambda x: x + 1)
+
+        def train(x, n):
+            for _ in range(n):
+                x = _jit_step(x)
+                loss = x.item()
+            return loss
+    """)
+    hs = [f for f in findings if f.rule == "host-sync"]
+    assert hs and any(".item()" in f.code for f in hs), findings
+
+
+def test_rule_host_sync_block_until_ready():
+    findings = _lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x
+
+        def hot(x, n):
+            for _ in range(n):
+                x = f(x)
+                x.block_until_ready()
+            return x
+    """)
+    assert "host-sync" in _rules(findings), findings
+
+
+def test_rule_nondet_in_trace():
+    findings = _lint("""
+        import time
+        import random
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * time.time() + random.random() + np.random.rand()
+    """)
+    nd = [f for f in findings if f.rule == "nondet-in-trace"]
+    assert len(nd) >= 3, findings
+
+
+def test_rule_mutation_in_trace():
+    findings = _lint("""
+        import jax
+
+        _cache = {}
+
+        @jax.jit
+        def f(x):
+            _cache["last"] = x
+            return x
+    """)
+    assert "mutation-in-trace" in _rules(findings), findings
+
+
+def test_rule_mutation_mutator_call():
+    findings = _lint("""
+        import jax
+
+        seen = []
+
+        @jax.jit
+        def f(x):
+            seen.append(x)
+            return x
+    """)
+    assert "mutation-in-trace" in _rules(findings), findings
+
+
+def test_functional_update_not_flagged():
+    """optax-style consumed ``.update()`` results are pure-functional
+    calls, not container mutation — must not fire mutation-in-trace."""
+    findings = _lint("""
+        import jax
+
+        @jax.jit
+        def f(opt, grads, state):
+            updates, new_state = opt.update(grads, state)
+            return updates, new_state
+    """)
+    assert "mutation-in-trace" not in _rules(findings), findings
+
+
+def test_rule_weak_jit_arg():
+    findings = _lint("""
+        import jax
+
+        def f(x, training):
+            return x
+
+        g = jax.jit(f)
+
+        def run(x):
+            return g(x, True)
+    """)
+    assert "weak-jit-arg" in _rules(findings), findings
+
+
+def test_weak_jit_arg_ok_with_static_argnums():
+    findings = _lint("""
+        import jax
+
+        def f(x, training):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def run(x):
+            return g(x, True)
+    """)
+    assert "weak-jit-arg" not in _rules(findings), findings
+
+
+def test_static_shape_probe_not_flagged():
+    """float()/int() over static metadata (.shape/.ndim/...) is free
+    under trace — no host-sync."""
+    findings = _lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = int(x.shape[0])
+            return x * float(x.ndim)
+    """)
+    assert "host-sync" not in _rules(findings), findings
+
+
+# =========================================== Engine 1: suppressions
+
+def test_inline_disable_comment_honored():
+    clean = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(jax.device_get(x))  # tracelint: disable=host-sync
+    """)
+    assert "host-sync" not in _rules(clean), clean
+    # without the annotation the same code fires
+    dirty = _lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(jax.device_get(x))
+    """)
+    assert "host-sync" in _rules(dirty)
+
+
+def test_baseline_requires_reason():
+    with pytest.raises(BaselineFormatError):
+        parse_baseline("a.py::host-sync::f::jax.device_get(x)\n",
+                       "inline")
+
+
+def test_stale_suppression_is_distinct_failure(tmp_path, capsys):
+    """An entry matching nothing fails with rule ``stale-suppression``
+    and CLI exit 2 — distinct from lint violations (exit 1)."""
+    src = tmp_path / "clean_mod.py"
+    src.write_text("import os\n\n\ndef f(x):\n    return x\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("clean_mod.py::host-sync::f::float(jax.device_get(x))"
+                  "  # sync that was since fixed\n")
+    rc = cli.main([str(src), "--root", str(tmp_path),
+                   "--baseline", str(bl)])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "stale-suppression" in out
+    assert "remove stale suppression" in out
+
+
+def test_violation_exit_one(tmp_path, capsys):
+    src = tmp_path / "hot_mod.py"
+    src.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(jax.device_get(x))
+    """))
+    rc = cli.main([str(src), "--root", str(tmp_path), "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "host-sync" in out
+
+
+def test_suppressed_by_baseline_exits_zero(tmp_path, capsys):
+    src = tmp_path / "hot_mod.py"
+    src.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(jax.device_get(x))
+    """))
+    findings = astlint.lint_paths([str(src)], root=str(tmp_path))
+    assert findings
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("".join(f"{f.fingerprint}  # intentional for the test\n"
+                          for f in findings))
+    rc = cli.main([str(src), "--root", str(tmp_path),
+                   "--baseline", str(bl)])
+    assert rc == 0, capsys.readouterr().out
+
+
+# ============================================ Engine 2: TraceAuditor
+
+def test_retrace_budget_exceeded_raises():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x + 1
+
+    with TraceAuditor(budgets={"f": 1}, audit_jaxprs=False,
+                      fail_on_exit=False) as aud:
+        jf = jax.jit(f)
+        jf(jnp.ones((2,)))
+        with pytest.raises(RetraceBudgetError) as ei:
+            jf(jnp.ones((3,)))          # shape change -> second compile
+    assert "budget" in str(ei.value)
+    assert aud.compiles("f") == 2
+
+
+def test_cache_hits_are_free_and_wrap_survives_exit():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return x * 2
+
+    with TraceAuditor(audit_jaxprs=False) as aud:
+        jf = jax.jit(f)
+        jf(jnp.ones((4,)))
+    jf(jnp.ones((4,)))                  # cache hit after __exit__
+    assert aud.compiles("f") == 1
+    jf(jnp.ones((5,)))                  # still counted after __exit__
+    assert aud.compiles("f") == 2
+
+
+def test_donation_after_use_caught():
+    import jax
+    import jax.numpy as jnp
+
+    def g(x):
+        return x * 2
+
+    with TraceAuditor(audit_jaxprs=False, fail_on_exit=False):
+        jg = jax.jit(g, donate_argnums=(0,))
+        a = jnp.ones((8,))
+        b = jg(a)                       # a is dead now
+        jg(b)                           # fresh handle: fine
+        with pytest.raises(DonationError):
+            jg(a)                       # reuse of the donated buffer
+
+
+def test_large_baked_const_flagged():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    big = jnp.asarray(np.ones((64, 64), np.float32))   # 16 KiB
+
+    def h(x):
+        return x @ big                  # captured by value, not passed
+
+    aud = TraceAuditor(const_bytes_limit=1000, fail_on_exit=False)
+    with aud:
+        jh = jax.jit(h)
+        jh(jnp.ones((4, 64)))
+    assert aud.records["h"].large_consts
+    with pytest.raises(TraceAuditError):
+        aud.check()
+
+
+def test_host_callback_flagged():
+    import jax
+    import jax.numpy as jnp
+
+    def k(x):
+        jax.debug.callback(lambda v: None, x)
+        return x + 1
+
+    aud = TraceAuditor(forbid_callbacks=True, fail_on_exit=False)
+    with aud:
+        jk = jax.jit(k)
+        jk(jnp.ones((2,)))
+    assert aud.records["k"].callbacks
+    with pytest.raises(TraceAuditError):
+        aud.check()
+
+
+# ================================ Engine 2 over the real hot paths
+
+def test_serving_decode_path_at_declared_budget():
+    """The serving chunked-decode program stays inside its declared
+    budget (initial trace + two arena-metadata retraces, see
+    benchmarks/serving_bench.DECODE_PROGRAM_BUDGET) across three full
+    runs — the double-warm invariant, asserted instead of assumed."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import (
+        DECODE_PROGRAM_BUDGET, _tiny_model)
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 7, 12, 4)]
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_fn": DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert aud.compiles("decode_chunk_fn") == DECODE_PROGRAM_BUDGET
+    # the model-program family is the PR 1 design: bucketed prefill +
+    # decode chunk (insert programs are cache plumbing, not the model)
+    assert "prefill" in aud.records
+    assert aud.records["decode_chunk_fn"].calls >= 6
+
+
+def test_train_step_path_at_declared_budget():
+    """The fused train step compiles exactly twice — the initial trace
+    (freshly initialized state) plus one retrace when call 2 feeds back
+    the program's own donated-output state (its buffer metadata differs
+    from init's, same mechanism as the serving arena) — then NEVER
+    again: batches/extras ride as jit arguments, so host schedules
+    cannot retrace it, and donation is honored (every call passes the
+    returned state, never a dead one)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from simple_model import make_engine
+
+    cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 2,
+           "steps_per_print": 100,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}}
+    aud = TraceAuditor(budgets={"train_step": 2})
+    with aud:
+        engine = make_engine(cfg)
+        for _ in range(4):
+            engine.train_batch()
+    assert aud.compiles("train_step") == 2
+    assert aud.records["train_step"].calls == 4
+
+
+def test_eigenvalue_single_sync_and_single_program(monkeypatch):
+    """Satellite regression lock: compute_eigenvalue performs exactly ONE
+    host sync for ALL blocks (the old loop synced every power iteration
+    of every block) and its power-iteration program compiles once (the
+    block index is a traced argument, not a static one)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    syncs = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get",
+                        lambda x: (syncs.append(1), real(x))[1])
+
+    L, k = 3, 16
+    cs = jnp.asarray([1.0, 4.0, 2.0])
+    params = {"blocks": {"w": jnp.ones((L, k)) * 0.1}}
+
+    def loss_fn(p, batch, rng):
+        w = p["blocks"]["w"]
+        return 0.5 * jnp.sum(cs[:, None] * w * w)
+
+    aud = TraceAuditor(budgets={"power_iterate": 1}, audit_jaxprs=False)
+    with aud:
+        ev = Eigenvalue(max_iter=50, tol=1e-4, layer_name="blocks",
+                        layer_num=L)
+        vals = ev.compute_eigenvalue(loss_fn, params, batch=None)
+    np.testing.assert_allclose(vals, [0.25, 1.0, 0.5], rtol=1e-3)
+    assert len(syncs) == 1
+    assert aud.compiles("power_iterate") == 1
