@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Frontend smoke: open-loop overload benchmark for the serving frontend
+# (streaming + priority/SLO-aware admission). Offers 4x the measured
+# capacity with mixed priorities and ASSERTS: streamed greedy outputs
+# bit-identical to ServingEngine.run, every admitted high-priority
+# request finishes with bounded p99 TTFT, and low-priority work sheds
+# with machine-readable reasons. Writes BENCH_frontend.json at the repo
+# root and exits nonzero on any violated bound or crash.
+#
+# Usage: bin/frontend_smoke.sh        (from the repo root, or anywhere)
+
+cd "$(dirname "$0")/.." || exit 1
+
+exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m deepspeed_tpu.benchmarks.frontend_bench \
+    --n-requests 48 --overload-factor 4.0 --max-new-tokens 16 \
+    --max-batch 4 --decode-chunk 4 \
+    --json-out BENCH_frontend.json

@@ -1,0 +1,244 @@
+"""Frontend benchmark: open-loop overload with mixed priorities.
+
+``serving_bench`` measures the data plane (decode throughput of the
+chunked loop); this benchmark measures the control plane built on top of
+it — :class:`~deepspeed_tpu.serving.frontend.ServingFrontend` under an
+arrival process it cannot fully serve. Three phases over one tiny model:
+
+  1. **calibrate** — a plain ``ServingEngine.run`` measures decode
+     capacity (tokens/s -> requests/s at the benchmark's token budget);
+  2. **parity** — the same prompts go through the frontend's streaming
+     path; every streamed greedy output must be BIT-identical to the
+     ``ServingEngine.run`` result (the frontend is a delivery mechanism,
+     not a model change);
+  3. **overload** — an OPEN-LOOP arrival process (submission times fixed
+     in advance, never waiting on completions — the honest overload
+     model; closed loops self-throttle) offers
+     ``overload_factor``x the measured capacity, mixed priorities:
+     high-priority interactive traffic without deadlines, low-priority
+     traffic with deadlines that cannot all be met.
+
+Assertions (the bench FAILS, not just reports):
+  * every admitted high-priority request finishes ``done``;
+  * p99 TTFT over finished high-priority requests stays under
+    ``ttft_bound_s`` — shedding low-priority work is what buys this;
+  * low-priority work IS shed, every shed carrying a machine-readable
+    reason (``deadline_infeasible`` / ``deadline_expired`` / ...);
+  * streamed greedy parity (phase 2).
+
+Run:  python -m deepspeed_tpu.benchmarks.frontend_bench
+(or the repo-root wrapper ``benchmarks/frontend_bench.py``). The tier-1
+smoke wrapper is ``bin/frontend_smoke.sh`` (writes BENCH_frontend.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from .serving_bench import _tiny_model
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs \
+        else None
+
+
+def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
+              max_new_tokens: int = 16, max_batch: int = 4,
+              prompt_len: int = 16, decode_chunk: int = 4,
+              high_fraction: float = 0.25, ttft_bound_s: float = 10.0,
+              seed: int = 0, model=None, params=None,
+              timeout_s: float = 300.0) -> dict:
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from ..serving import ServingEngine
+    from ..serving.frontend import (AdmissionConfig, PRIORITY_HIGH,
+                                    PRIORITY_LOW, ServingFrontend)
+
+    if model is None:
+        model, params = _tiny_model()
+    vocab = model.cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(min(4, prompt_len), prompt_len + 1, max_batch * 2)
+    prompts = [rng.integers(0, vocab, (int(n),)).astype(np.int32)
+               for n in lens]
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+
+    # ---- phase 1: calibrate capacity on the plain engine loop ----------
+    reference = ServingEngine(engine=engine, max_batch=max_batch,
+                              max_prompt_len=prompt_len,
+                              decode_chunk=decode_chunk,
+                              max_queue=max(len(prompts), 8))
+    reference.run(list(prompts), max_new_tokens=max_new_tokens)  # warm
+    reference.run(list(prompts), max_new_tokens=max_new_tokens)
+    t0 = time.perf_counter()
+    ref_results = reference.run(list(prompts),
+                                max_new_tokens=max_new_tokens)
+    cal_dt = time.perf_counter() - t0
+    cal_tokens = sum(len(r.tokens) for r in ref_results)
+    capacity_tps = cal_tokens / cal_dt
+    capacity_rps = capacity_tps / max_new_tokens
+    offered_rps = overload_factor * capacity_rps
+
+    # ---- phase 2: streaming parity through the frontend ----------------
+    fe_engine = ServingEngine(engine=engine, max_batch=max_batch,
+                              max_prompt_len=prompt_len,
+                              decode_chunk=decode_chunk,
+                              max_queue=max(n_requests, 8))
+    # warm every program the frontend can hit before it owns the engine:
+    # batched prefill compiles per (n, bucket), and which n the driver
+    # sees depends on arrival timing — a cold (2, 16) prefill mid-overload
+    # would charge ~1 s of XLA compile to some request's TTFT. The k-sized
+    # runs compile every prefill width; the extra full runs absorb the
+    # decode-chunk program's arena-metadata retraces (serving_bench's
+    # double-warm).
+    for k in range(1, max_batch + 1):
+        fe_engine.run(list(prompts[:k]), max_new_tokens=max_new_tokens)
+    fe_engine.run(list(prompts), max_new_tokens=max_new_tokens)
+    frontend = ServingFrontend(
+        fe_engine,
+        admission=AdmissionConfig(max_pending=n_requests + 8),
+        trace_keep_last=n_requests + len(prompts) + 8)
+    handles = [frontend.submit(p, max_new_tokens=max_new_tokens)
+               for p in prompts]
+    for h, ref in zip(handles, ref_results):
+        streamed = list(h)                       # the blocking iterator
+        if h.status != "done":
+            raise RuntimeError(
+                f"parity request uid={h.uid} ended {h.status}, not done")
+        if streamed != h.tokens or not np.array_equal(
+                h.output_ids, ref.output_ids):
+            raise RuntimeError(
+                "streamed greedy output diverged from ServingEngine.run "
+                f"for uid={h.uid} — the frontend must be bit-identical")
+    parity = True
+    # the parity pass also warmed the frontend's throughput estimator, so
+    # the overload phase sheds against a measured rate from step one
+
+    # ---- phase 3: open-loop overload with mixed priorities -------------
+    # low-priority deadline: roughly the unloaded service time of a few
+    # requests — generous when idle, infeasible at overload_factor x
+    low_deadline_s = 4.0 / capacity_rps
+    interval = 1.0 / offered_rps
+    n_high = 0
+    load_handles = []
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        # open loop: the i-th arrival is scheduled at t_start + i*interval
+        # regardless of how far behind the server is
+        target = t_start + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        high = (i % max(1, round(1 / high_fraction))) == 0
+        n_high += int(high)
+        n = int(rng.integers(min(4, prompt_len), prompt_len + 1))
+        prompt = rng.integers(0, vocab, (n,)).astype(np.int32)
+        h = frontend.submit(
+            prompt, max_new_tokens=max_new_tokens,
+            priority=PRIORITY_HIGH if high else PRIORITY_LOW,
+            tenant="interactive" if high else "bulk",
+            slo_ttft_s=ttft_bound_s if high else None,
+            deadline_s=None if high else low_deadline_s)
+        load_handles.append((h, high))
+    deadline = time.monotonic() + timeout_s
+    for h, _ in load_handles:
+        h.result(timeout=max(0.1, deadline - time.monotonic()))
+    wall_s = time.perf_counter() - t_start
+    frontend.close()
+
+    traces = {t["uid"]: t
+              for t in frontend.tracing.to_json()["requests"]}
+    high_statuses = [h.status for h, hi in load_handles if hi]
+    low_statuses = [h.status for h, hi in load_handles if not hi]
+    shed_reasons = sorted({
+        h.reject_reason for h, hi in load_handles
+        if not hi and h.status == "rejected"})
+    n_shed = sum(s == "rejected" for s in low_statuses)
+    ttfts_high = [traces[h.uid]["ttft_s"] for h, hi in load_handles
+                  if hi and h.status == "done"
+                  and traces.get(h.uid, {}).get("ttft_s") is not None]
+    p50_high = _percentile(ttfts_high, 50)
+    p99_high = _percentile(ttfts_high, 99)
+
+    if not all(s == "done" for s in high_statuses):
+        raise RuntimeError(
+            "admitted high-priority requests did not all finish: "
+            f"{sorted(set(high_statuses))}")
+    if n_shed == 0:
+        raise RuntimeError(
+            f"no low-priority request was shed at {overload_factor}x "
+            "offered load — admission control is not shedding")
+    if any(r is None for r in shed_reasons):
+        raise RuntimeError("a shed request carried no rejection reason")
+    if p99_high is None or p99_high > ttft_bound_s:
+        raise RuntimeError(
+            f"high-priority p99 TTFT {p99_high}s exceeds the "
+            f"{ttft_bound_s}s bound under overload")
+
+    return {
+        "n_requests": n_requests,
+        "n_high": n_high,
+        "n_low": n_requests - n_high,
+        "overload_factor": overload_factor,
+        "max_new_tokens": max_new_tokens,
+        "max_batch": max_batch,
+        "decode_chunk": decode_chunk,
+        "greedy_streaming_parity": parity,
+        "capacity_tokens_per_s": round(capacity_tps, 2),
+        "capacity_requests_per_s": round(capacity_rps, 3),
+        "offered_requests_per_s": round(offered_rps, 3),
+        "low_deadline_s": round(low_deadline_s, 4),
+        "overload_wall_s": round(wall_s, 4),
+        "high_statuses": {s: int(n) for s, n in
+                          zip(*np.unique(high_statuses,
+                                         return_counts=True))},
+        "low_statuses": {s: int(n) for s, n in
+                         zip(*np.unique(low_statuses, return_counts=True))},
+        "low_shed": n_shed,
+        "shed_reasons": shed_reasons,
+        "ttft_bound_s": ttft_bound_s,
+        "high_ttft_p50_s": round(p50_high, 4) if p50_high else None,
+        "high_ttft_p99_s": round(p99_high, 4) if p99_high else None,
+        "frontend_snapshot": frontend.tracing.snapshot(),
+        "frontend_stats": frontend.stats(),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n-requests", type=int, default=48)
+    ap.add_argument("--overload-factor", type=float, default=4.0)
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--decode-chunk", type=int, default=4)
+    ap.add_argument("--high-fraction", type=float, default=0.25)
+    ap.add_argument("--ttft-bound-s", type=float, default=10.0)
+    ap.add_argument("--json-out", type=str, default=None,
+                    help="also write the result dict to this JSON file")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    result = run_bench(n_requests=args.n_requests,
+                       overload_factor=args.overload_factor,
+                       max_new_tokens=args.max_new_tokens,
+                       max_batch=args.max_batch,
+                       prompt_len=args.prompt_len,
+                       decode_chunk=args.decode_chunk,
+                       high_fraction=args.high_fraction,
+                       ttft_bound_s=args.ttft_bound_s,
+                       seed=args.seed)
+    print(json.dumps(result, indent=2))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -6,13 +6,19 @@ the reference's ``write_events`` (monitor/monitor.py:45).
 
 Lifecycle: every writer has an explicit ``close()`` and the master
 registers a flush-and-close atexit hook, so short-lived runs (serving
-benchmarks, smoke tests) never lose buffered trailing rows."""
+benchmarks, smoke tests) never lose buffered trailing rows.
+
+Thread safety: the serving frontend emits from its engine-driver thread
+while snapshots/benchmark code may flush from callers, so ``CsvWriter``
+and ``MonitorMaster`` serialize write/flush/close behind a lock —
+concurrent emits never interleave rows or race a close."""
 
 from __future__ import annotations
 
 import atexit
 import csv
 import os
+import threading
 from typing import List, Optional, Tuple
 
 import jax
@@ -40,6 +46,7 @@ class CsvWriter(_BaseWriter):
         self.out_dir = os.path.join(cfg.output_path or "csv_monitor", cfg.job_name)
         os.makedirs(self.out_dir, exist_ok=True)
         self._files = {}         # label -> (file handle, csv writer)
+        self._lock = threading.RLock()
 
     def _writer(self, label):
         entry = self._files.get(label)
@@ -55,17 +62,20 @@ class CsvWriter(_BaseWriter):
         return entry[1]
 
     def write_events(self, events):
-        for label, value, sample in events:
-            self._writer(label).writerow([int(sample), float(value)])
+        with self._lock:
+            for label, value, sample in events:
+                self._writer(label).writerow([int(sample), float(value)])
 
     def flush(self):
-        for fh, _ in self._files.values():
-            fh.flush()
+        with self._lock:
+            for fh, _ in self._files.values():
+                fh.flush()
 
     def close(self):
-        for fh, _ in self._files.values():
-            fh.close()
-        self._files = {}
+        with self._lock:
+            for fh, _ in self._files.values():
+                fh.close()
+            self._files = {}
 
 
 class TensorBoardWriter(_BaseWriter):
@@ -103,6 +113,7 @@ class MonitorMaster:
     def __init__(self, ds_config):
         self.writers: List[_BaseWriter] = []
         self.enabled = False
+        self._lock = threading.RLock()
         if jax.process_index() != 0:
             return
         for cfg, cls in ((ds_config.tensorboard, TensorBoardWriter),
@@ -120,25 +131,30 @@ class MonitorMaster:
             atexit.register(self.close)
 
     def write_events(self, events):
-        if not self.enabled:
-            return
-        for w in self.writers:
-            w.write_events(events)
+        with self._lock:
+            if not self.enabled:
+                return
+            for w in self.writers:
+                w.write_events(events)
 
     def flush(self):
-        for w in self.writers:
-            w.flush()
+        with self._lock:
+            for w in self.writers:
+                w.flush()
 
     def close(self):
         """Flush and release every writer; idempotent, and safe to call
-        before interpreter exit (the atexit hook becomes a no-op)."""
-        for w in self.writers:
-            try:
-                w.close()
-            except Exception as e:
-                logger.warning(f"monitor writer close failed: {e}")
-        self.writers = []
-        self.enabled = False
+        before interpreter exit (the atexit hook becomes a no-op) or
+        concurrently with a late emitter thread (which sees a disabled
+        master, not a closed file)."""
+        with self._lock:
+            for w in self.writers:
+                try:
+                    w.close()
+                except Exception as e:
+                    logger.warning(f"monitor writer close failed: {e}")
+            self.writers = []
+            self.enabled = False
         atexit.unregister(self.close)
 
     def __enter__(self):

@@ -8,6 +8,8 @@ scheduling, and a two-program jit discipline. See docs/serving.md.
 
 from .kv_cache import SlotAllocator, SlotKVCacheManager  # noqa: F401
 from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
-                        REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL)
-from .metrics import ServingMetrics, csv_monitor_master  # noqa: F401
+                        REJECT_DEADLINE_EXPIRED, REJECT_PROMPT_TOO_LONG,
+                        REJECT_QUEUE_FULL)
+from .metrics import (Reservoir, ServingMetrics,  # noqa: F401
+                      csv_monitor_master)
 from .engine import ServingEngine  # noqa: F401

@@ -176,6 +176,9 @@ class ServingEngine:
         # NEXT chunk launch (see _device_state)
         self._deact_slots: Set[int] = set()
         self._admit_patches: Dict[int, Tuple[int, int, int, int]] = {}
+        # the at-most-one in-flight chunk of the double-buffered loop
+        # (run()'s pipelined drain and external pump() drivers share it)
+        self._pending: Optional[_InflightChunk] = None
 
         mat = engine._materialize
         module = self.module
@@ -274,6 +277,56 @@ class ServingEngine:
         if not self.scheduler.submit(req):
             self.metrics.on_rejected()
         return req
+
+    def cancel(self, req: Request) -> bool:
+        """Caller-initiated termination: a queued request never prefills;
+        a running one frees its slot immediately (host side) and its
+        device lane is deactivated at the NEXT chunk launch through the
+        host-event patch path (``_deact_slots``), so at most K-1 tokens of
+        speculative device work are wasted — and none are delivered,
+        because the launch-time slot->uid snapshot drops tokens from
+        retired occupants. Returns False if the request was already
+        terminal."""
+        slot = req.slot if req.status == "running" else None
+        cancelled = self.scheduler.cancel(req)
+        if cancelled and slot is not None:
+            self._deact_slots.add(slot)
+            self._admit_patches.pop(slot, None)
+        return cancelled
+
+    def pump(self) -> List[Request]:
+        """One iteration of the double-buffered serve loop for EXTERNAL
+        drivers (the serving frontend's engine thread): admit, keep one
+        chunk in flight, and return every request that reached a terminal
+        state during the call. Unlike ``step()`` this does not force a
+        launch+sync pair per call — the in-flight chunk carries over
+        between calls, so an external driver gets the same device-paced
+        overlap ``run()`` has. Call until ``has_work()`` is False AND the
+        last call returned with nothing in flight to drain completely."""
+        before = len(self.scheduler.finished)
+        if self.decode_chunk <= 1:
+            self._admit()
+            self._decode_once()
+            return self.scheduler.finished[before:]
+        if self._pending is None:
+            self._admit()
+            if self.scheduler.running:
+                self._pending = self._launch_chunk(self._host_state())
+            return self.scheduler.finished[before:]
+        nxt = None
+        if self._may_outlive_chunk():
+            nxt = self._launch_chunk(self._device_state(self._pending))
+        self._consume_chunk(self._pending)
+        self._admit()
+        self._pending = nxt
+        return self.scheduler.finished[before:]
+
+    @property
+    def chunk_in_flight(self) -> bool:
+        """True while a launched decode chunk has not been consumed —
+        drain loops must keep pumping until this clears even after the
+        scheduler reports no work."""
+        return self._pending is not None
 
     def step(self) -> List[Request]:
         """One synchronous continuous-batching iteration: admit
@@ -507,20 +560,10 @@ class ServingEngine:
         """The async host loop: always keep one chunk in flight, and
         enqueue its successor (from device-carried state) BEFORE blocking
         on its token buffer — host-side scheduling/bookkeeping overlaps
-        device compute. Host-only events (deadline expiry, admissions)
-        take effect one chunk late; device-detected stops (EOS, budget)
-        take effect immediately via the carried active mask."""
-        sched = self.scheduler
-        pending: Optional[_InflightChunk] = None
-        while sched.has_work() or pending is not None:
-            if pending is None:
-                self._admit()
-                if sched.running:
-                    pending = self._launch_chunk(self._host_state())
-                continue
-            nxt = None
-            if self._may_outlive_chunk():
-                nxt = self._launch_chunk(self._device_state(pending))
-            self._consume_chunk(pending)
-            self._admit()
-            pending = nxt
+        device compute. Host-only events (deadline expiry, cancellation,
+        admissions) take effect one chunk late; device-detected stops
+        (EOS, budget) take effect immediately via the carried active
+        mask. One ``pump()`` call per iteration — the same loop an
+        external driver (the serving frontend) runs incrementally."""
+        while self.scheduler.has_work() or self._pending is not None:
+            self.pump()

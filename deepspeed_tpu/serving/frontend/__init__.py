@@ -1,0 +1,18 @@
+"""Serving frontend: streaming handles, SLO-aware admission, tracing.
+
+Layers on top of the continuous-batching core (``serving.engine``):
+``ServingFrontend`` owns a background engine-driver thread and exposes a
+thread-safe ``submit -> StreamHandle`` API with priority/deadline-aware
+admission (``admission.py``) and per-request span tracing
+(``tracing.py``). See docs/serving.md ("Frontend").
+"""
+
+from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
+                        ChunkThroughputEstimator, PRIORITY_HIGH,
+                        PRIORITY_LOW, PRIORITY_NORMAL,
+                        REJECT_DEADLINE_INFEASIBLE, REJECT_FRONTEND_CLOSED,
+                        REJECT_FRONTEND_QUEUE_FULL, REJECT_RATE_LIMITED,
+                        Ticket, TokenBucket)
+from .tracing import EVENTS, RequestTrace, TraceLog  # noqa: F401
+from .frontend import (ServingFrontend, StreamHandle,  # noqa: F401
+                       TERMINAL_STATUSES)

@@ -1,0 +1,266 @@
+"""SLO-aware admission control ahead of the continuous-batching scheduler.
+
+The scheduler's own backpressure (serving/scheduler.py) is a bounded
+FIFO: it protects the engine, not the SLO. Under overload a FIFO admits
+whatever arrived first, so a latency-tolerant bulk request can hold a
+slot while an interactive request misses its deadline in the queue. This
+controller sits between ``ServingFrontend.submit`` and
+``ContinuousBatchScheduler.submit`` and makes the decisions a FIFO
+cannot:
+
+* **priority classes** — pending requests are held in a priority heap
+  (lower value admits first, FIFO within a class), so under overload
+  high-priority traffic admits ahead of earlier-arrived low-priority
+  traffic;
+* **deadline-feasibility shedding** — each request carries a token-cost
+  estimate (weighted prompt-bucket prefill cost + ``max_new_tokens``);
+  against the measured chunk throughput and the current token backlog,
+  a request that would miss its deadline *even if admitted right now* is
+  rejected immediately with a machine-readable reason instead of wasting
+  a prefill and dying at a chunk boundary;
+* **token-bucket rate limiting** — per-tenant buckets throttle an
+  aggressive tenant at submission time so one caller cannot starve the
+  pending queue.
+
+Everything here is host-side Python with an injectable clock — no JAX,
+unit-testable at CPU speed. Thread safety: ``offer`` / ``remove`` /
+``pop`` serialize behind one internal lock (offers arrive on caller
+threads, pops on the frontend's driver thread).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# machine-readable rejection reasons (the scheduler's REJECT_* constants
+# cover its own queue_full / prompt_too_long / deadline_expired reasons)
+REJECT_RATE_LIMITED = "rate_limited"
+REJECT_FRONTEND_QUEUE_FULL = "frontend_queue_full"
+REJECT_DEADLINE_INFEASIBLE = "deadline_infeasible"
+REJECT_FRONTEND_CLOSED = "frontend_closed"
+
+# priority classes: any int works (lower admits first); these names are
+# the conventional three
+PRIORITY_HIGH = 0
+PRIORITY_NORMAL = 1
+PRIORITY_LOW = 2
+
+_seq_counter = itertools.count()
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``
+    capacity; ``try_acquire`` is all-or-nothing and never blocks (the
+    frontend rejects instead of queueing throttled work)."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        if rate <= 0 or burst <= 0:
+            raise ValueError(f"rate and burst must be > 0, "
+                             f"got rate={rate} burst={burst}")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.clock = clock
+        self._tokens = float(burst)
+        self._last = clock()
+
+    def try_acquire(self, cost: float = 1.0) -> bool:
+        now = self.clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= cost:
+            self._tokens -= cost
+            return True
+        return False
+
+
+class ChunkThroughputEstimator:
+    """EWMA of decode throughput (tokens/s) observed per consumed chunk.
+    ``rate()`` is None until the first observation — the controller never
+    sheds on an unmeasured system (cold starts admit optimistically)."""
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = float(alpha)
+        self._rate: Optional[float] = None
+        self.n_samples = 0
+
+    def record(self, tokens: int, dt_s: float) -> None:
+        if tokens <= 0 or dt_s <= 0:
+            return
+        sample = tokens / dt_s
+        self._rate = sample if self._rate is None else (
+            self.alpha * sample + (1.0 - self.alpha) * self._rate)
+        self.n_samples += 1
+
+    def rate(self) -> Optional[float]:
+        return self._rate
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Knobs for the controller. ``rate_per_tenant`` is requests/s (None
+    disables rate limiting); ``tenant_limits`` overrides (rate, burst)
+    per tenant id. ``prefill_token_weight`` scales prompt tokens into
+    decode-token-equivalents for the cost estimate — prefill processes
+    its tokens in one batched program, so a prompt token costs a fraction
+    of a decode token. ``feasibility_slack_s`` absorbs estimate noise
+    before a deadline shed fires."""
+    max_pending: int = 256
+    prefill_token_weight: float = 0.15
+    feasibility_slack_s: float = 0.0
+    rate_per_tenant: Optional[float] = None
+    burst_per_tenant: float = 8.0
+    tenant_limits: Dict[str, Tuple[float, float]] = \
+        dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One pending admission decision. ``payload`` is opaque to the
+    controller (the frontend stores its StreamHandle there)."""
+    prompt_len: int
+    max_new_tokens: int
+    priority: int = PRIORITY_NORMAL
+    tenant: str = "default"
+    deadline_s: Optional[float] = None       # absolute clock time
+    slo_ttft_s: Optional[float] = None       # target, tracked not enforced
+    payload: Any = None
+    seq: int = dataclasses.field(default_factory=lambda: next(_seq_counter))
+    cancelled: bool = False                  # tombstone (lazy heap removal)
+
+    def cost_tokens(self, prefill_weight: float) -> float:
+        """Estimated decode-token-equivalent cost of serving this
+        request to completion."""
+        return self.prompt_len * prefill_weight + self.max_new_tokens
+
+
+class AdmissionController:
+    """Priority-ordered, SLO-aware admission queue.
+
+    Flow: callers ``offer`` tickets (rate limit + pending bound + dead
+    deadline checked immediately → reason or enqueued); the driver
+    ``pop``s up to ``room`` tickets per iteration in (priority, seq)
+    order, shedding any whose deadline has become infeasible against the
+    measured throughput; ``remove`` tombstones a ticket a caller
+    cancelled while it was still pending."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None, *,
+                 clock: Callable[[], float] = time.monotonic):
+        self.config = config or AdmissionConfig()
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[int, int, Ticket]] = []
+        self._pending = 0                    # live (non-tombstone) tickets
+        self._buckets: Dict[str, TokenBucket] = {}
+        self.n_offered = 0
+        self.n_rate_limited = 0
+        self.n_shed = 0
+
+    # ------------------------------------------------------------ offers
+    def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
+        cfg = self.config
+        limits = cfg.tenant_limits.get(tenant)
+        if limits is None and cfg.rate_per_tenant is None:
+            return None
+        bucket = self._buckets.get(tenant)
+        if bucket is None:
+            rate, burst = limits if limits is not None else (
+                cfg.rate_per_tenant, cfg.burst_per_tenant)
+            bucket = self._buckets[tenant] = TokenBucket(
+                rate, burst, clock=self.clock)
+        return bucket
+
+    def offer(self, ticket: Ticket) -> Optional[str]:
+        """Admit ``ticket`` into the pending queue, or return a rejection
+        reason. The rate-limit token is consumed only on acceptance
+        paths (a bound-rejected request does not burn tenant budget)."""
+        with self._lock:
+            self.n_offered += 1
+            if ticket.deadline_s is not None and \
+                    self.clock() >= ticket.deadline_s:
+                from ..scheduler import REJECT_DEADLINE_EXPIRED
+                return REJECT_DEADLINE_EXPIRED
+            if self._pending >= self.config.max_pending:
+                return REJECT_FRONTEND_QUEUE_FULL
+            bucket = self._bucket_for(ticket.tenant)
+            if bucket is not None and not bucket.try_acquire():
+                self.n_rate_limited += 1
+                return REJECT_RATE_LIMITED
+            heapq.heappush(self._heap,
+                           (ticket.priority, ticket.seq, ticket))
+            self._pending += 1
+            return None
+
+    def remove(self, ticket: Ticket) -> bool:
+        """Tombstone a still-pending ticket (cancellation before it ever
+        reached the scheduler). Returns False if it already left the
+        queue."""
+        with self._lock:
+            if ticket.cancelled:
+                return False
+            for _, _, t in self._heap:
+                if t is ticket:
+                    ticket.cancelled = True
+                    self._pending -= 1
+                    return True
+            return False
+
+    # -------------------------------------------------------------- pops
+    def pop(self, *, room: int, rate: Optional[float],
+            backlog_tokens: float
+            ) -> Tuple[List[Ticket], List[Tuple[Ticket, str]]]:
+        """Pop up to ``room`` admissible tickets in priority order.
+        ``rate`` is the measured decode throughput (tokens/s, None before
+        any measurement); ``backlog_tokens`` is the token-equivalent work
+        already admitted ahead of these tickets (running remainders +
+        scheduler queue). Returns (admits, [(shed, reason), ...]) — a
+        shed ticket would miss its deadline even if admitted now, so it
+        is rejected early rather than served late."""
+        from ..scheduler import REJECT_DEADLINE_EXPIRED
+        cfg = self.config
+        admits: List[Ticket] = []
+        sheds: List[Tuple[Ticket, str]] = []
+        now = self.clock()
+        with self._lock:
+            while self._heap and len(admits) < room:
+                _, _, ticket = heapq.heappop(self._heap)
+                if ticket.cancelled:
+                    continue
+                self._pending -= 1
+                if ticket.deadline_s is not None and \
+                        now >= ticket.deadline_s:
+                    self.n_shed += 1
+                    sheds.append((ticket, REJECT_DEADLINE_EXPIRED))
+                    continue
+                if ticket.deadline_s is not None and rate:
+                    cost = ticket.cost_tokens(cfg.prefill_token_weight)
+                    eta = now + (backlog_tokens + cost) / rate
+                    if eta > ticket.deadline_s + cfg.feasibility_slack_s:
+                        self.n_shed += 1
+                        sheds.append((ticket, REJECT_DEADLINE_INFEASIBLE))
+                        continue
+                admits.append(ticket)
+                backlog_tokens += ticket.cost_tokens(
+                    cfg.prefill_token_weight)
+        return admits, sheds
+
+    # ----------------------------------------------------------- queries
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def drain(self) -> List[Ticket]:
+        """Remove and return every live pending ticket (crash/teardown:
+        the frontend resolves their handles with a terminal status)."""
+        with self._lock:
+            out = [t for _, _, t in self._heap if not t.cancelled]
+            self._heap = []
+            self._pending = 0
+            return out

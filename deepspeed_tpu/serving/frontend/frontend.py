@@ -1,0 +1,498 @@
+"""ServingFrontend: the thread-safe control plane between many callers
+and one ``ServingEngine``.
+
+PRs 1-2 built a fast continuous-batching core, but it is a synchronous,
+single-caller loop: ``run()`` owns the engine until it drains. A serving
+tier needs the opposite shape — many concurrent callers, each getting an
+incremental token stream, with admission shaped against priorities and
+SLOs instead of arrival order. This module adds that shape without
+touching the device programs:
+
+* ``ServingFrontend.submit(prompt, *, priority, slo_ttft_s, deadline_s)``
+  returns a :class:`StreamHandle` immediately from any thread;
+* one background **engine-driver thread** owns every engine/scheduler
+  touch (the core stays single-threaded by construction) and runs the
+  same double-buffered chunk loop ``run()`` uses, via
+  ``ServingEngine.pump()``;
+* tokens stream to handles as each decode chunk retires (blocking
+  iterator or non-blocking ``poll``), at chunk granularity — one
+  delivery per ``decode_chunk`` tokens;
+* ``cancel()`` frees the slot within one chunk through the engine's
+  host-event patch path; ``close()`` drains in-flight work; a driver
+  crash resolves every outstanding handle with an ``error`` status
+  instead of hanging callers;
+* admission decisions (priority ordering, deadline-feasibility shedding,
+  per-tenant rate limits) live in :mod:`.admission`; per-request spans
+  and latency histograms in :mod:`.tracing`.
+
+Terminal handle statuses: ``done | cancelled | rejected | error |
+expired`` (``expired`` = admitted but its deadline passed mid-stream —
+distinguished from ``rejected``, which never consumed device time).
+
+Granularity contract: the driver observes the engine only at chunk
+boundaries, so cancellation and deadline expiry take effect within one
+decode chunk (up to ``decode_chunk - 1`` tokens of device work are
+wasted, never delivered), and streamed tokens arrive in bursts of up to
+``decode_chunk``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...utils.logging import logger
+from ..scheduler import Request
+from .admission import (AdmissionConfig, AdmissionController,
+                        ChunkThroughputEstimator, PRIORITY_NORMAL,
+                        REJECT_FRONTEND_CLOSED, Ticket)
+from .tracing import TraceLog
+
+#: statuses after which a handle will never change again
+TERMINAL_STATUSES = ("done", "cancelled", "rejected", "error", "expired")
+
+
+class StreamHandle:
+    """One caller's view of one request: a thread-safe incremental token
+    stream plus the terminal status. Produced by
+    :meth:`ServingFrontend.submit`; all methods are safe from any
+    thread."""
+
+    def __init__(self, request: Request, frontend: "ServingFrontend", *,
+                 tenant: str, priority: int,
+                 slo_ttft_s: Optional[float], submit_t: float):
+        self._request = request
+        self._frontend = frontend
+        self.tenant = tenant
+        self.priority = priority
+        self.slo_ttft_s = slo_ttft_s
+        self.submit_t = submit_t
+        self._cond = threading.Condition()
+        self._tokens: List[int] = []
+        self._cursor = 0               # poll()/iterator read position
+        self._status: Optional[str] = None
+        self._reject_reason: Optional[str] = None
+        self._error: Optional[str] = None
+        # driver-thread-only bookkeeping (never touched by callers)
+        self._ticket: Optional[Ticket] = None
+        self._pushed = 0               # tokens handed to _push so far
+        self._prefill_marked = False
+
+    # ----------------------------------------------------- driver side
+    def _push(self, tokens: Sequence[int]) -> None:
+        with self._cond:
+            if self._status is not None:
+                return                 # terminal: late tokens are dropped
+            self._tokens.extend(int(t) for t in tokens)
+            self._cond.notify_all()
+
+    def _resolve(self, status: str, *, reject_reason: Optional[str] = None,
+                 error: Optional[str] = None) -> None:
+        with self._cond:
+            if self._status is not None:
+                return                 # first terminal status wins
+            self._status = status
+            self._reject_reason = reject_reason
+            self._error = error
+            self._cond.notify_all()
+
+    # ----------------------------------------------------- caller side
+    @property
+    def uid(self) -> int:
+        return self._request.uid
+
+    @property
+    def status(self) -> str:
+        """``"pending"`` until terminal, then one of
+        :data:`TERMINAL_STATUSES`."""
+        with self._cond:
+            return self._status or "pending"
+
+    @property
+    def done(self) -> bool:
+        with self._cond:
+            return self._status is not None
+
+    @property
+    def reject_reason(self) -> Optional[str]:
+        with self._cond:
+            return self._reject_reason
+
+    @property
+    def error(self) -> Optional[str]:
+        with self._cond:
+            return self._error
+
+    @property
+    def tokens(self) -> List[int]:
+        """All tokens streamed so far (copy; does not consume the
+        ``poll``/iterator cursor)."""
+        with self._cond:
+            return list(self._tokens)
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """prompt + streamed tokens — the ``Request.output_ids``
+        contract, so streamed results compare bit-for-bit against
+        ``ServingEngine.run``."""
+        with self._cond:
+            toks = np.asarray(self._tokens, np.int32)
+        return np.concatenate([self._request.prompt, toks])
+
+    def poll(self) -> List[int]:
+        """Non-blocking: tokens that arrived since the last
+        ``poll``/iteration step (empty list when none)."""
+        with self._cond:
+            new = self._tokens[self._cursor:]
+            self._cursor = len(self._tokens)
+            return [int(t) for t in new]
+
+    def __iter__(self):
+        """Blocking token stream; ends when the request reaches a
+        terminal status (after yielding every delivered token)."""
+        while True:
+            with self._cond:
+                while self._cursor >= len(self._tokens) and \
+                        self._status is None:
+                    self._cond.wait()
+                if self._cursor < len(self._tokens):
+                    tok = int(self._tokens[self._cursor])
+                    self._cursor += 1
+                else:
+                    return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        """Block until terminal; returns the terminal status. Raises
+        ``TimeoutError`` if the deadline passes first."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._status is not None,
+                                       timeout):
+                raise TimeoutError(
+                    f"request uid={self.uid} not terminal after "
+                    f"{timeout}s (status=pending)")
+            return self._status
+
+    def cancel(self) -> None:
+        """Request cancellation (idempotent, safe from any thread). The
+        handle resolves to ``cancelled`` once the driver processes it —
+        within one decode chunk."""
+        self._frontend.cancel(self)
+
+
+class ServingFrontend:
+    """Thread-safe serving front end over one :class:`ServingEngine`.
+
+    The frontend OWNS the engine's execution: after construction, no
+    other code may call ``run``/``step``/``pump`` on it. A single daemon
+    driver thread performs every engine and scheduler access; callers
+    interact only through thread-safe ``submit``/``cancel``/``close``
+    and StreamHandles.
+
+    ``feed_depth`` bounds how many admission winners sit in the engine
+    scheduler's FIFO at once (default ``max_batch``): priority decisions
+    stay in the frontend's heap until the engine can actually use the
+    request, keeping the priority-inversion window one batch wide.
+    """
+
+    def __init__(self, engine, *,
+                 admission: Optional[AdmissionConfig] = None,
+                 monitor=None,
+                 feed_depth: Optional[int] = None,
+                 idle_wait_s: float = 0.005,
+                 emit_every_s: float = 1.0,
+                 trace_keep_last: int = 256,
+                 clock=time.monotonic):
+        self._engine = engine
+        self._clock = clock
+        self._controller = AdmissionController(admission, clock=clock)
+        self._estimator = ChunkThroughputEstimator()
+        self.tracing = TraceLog(monitor, keep_last=trace_keep_last,
+                                clock=clock)
+        self._feed_depth = int(feed_depth or engine.max_batch)
+        self._idle_wait_s = float(idle_wait_s)
+        self._emit_every_s = float(emit_every_s)
+        self._last_emit_t = clock()
+
+        self._wake = threading.Condition()
+        self._cancel_requests: List[StreamHandle] = []
+        self._closing = False
+        self._closed = False
+        self._crashed = False
+        self._crash_error: Optional[BaseException] = None
+        # uid -> handle for requests inside the engine (driver-only)
+        self._handles: Dict[int, StreamHandle] = {}
+        self.n_submitted = 0
+
+        self._thread = threading.Thread(
+            target=self._drive, name="serving-frontend-driver", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------- public API
+    def submit(self, prompt: Union[Sequence[int], np.ndarray], *,
+               priority: int = PRIORITY_NORMAL,
+               tenant: str = "default",
+               slo_ttft_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> StreamHandle:
+        """Enqueue one generation request; returns immediately.
+
+        ``deadline_s`` is a RELATIVE budget ("finish within this many
+        seconds"), converted to the absolute clock deadline the scheduler
+        tracks. ``slo_ttft_s`` is the TTFT target: it is recorded and
+        scored in tracing (``slo_ttft_met``), not enforced — deadlines
+        enforce. Rejections (rate limit, pending bound, dead/infeasible
+        deadline, closed frontend) resolve the handle to ``rejected``
+        with a machine-readable ``reject_reason``; no exception."""
+        now = self._clock()
+        req = Request(prompt=np.asarray(prompt, np.int32),
+                      max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id,
+                      deadline_s=(now + deadline_s)
+                      if deadline_s is not None else None)
+        handle = StreamHandle(req, self, tenant=tenant, priority=priority,
+                              slo_ttft_s=slo_ttft_s, submit_t=now)
+        meta = dict(tenant=tenant, priority=priority,
+                    prompt_len=req.prompt_len,
+                    max_new_tokens=req.max_new_tokens,
+                    slo_ttft_s=slo_ttft_s, deadline_s=req.deadline_s)
+        self.n_submitted += 1
+        with self._wake:
+            dead = self._closing or self._crashed
+        if dead:
+            self.tracing.record_rejected(req.uid, REJECT_FRONTEND_CLOSED,
+                                         **meta)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_CLOSED)
+            return handle
+        ticket = Ticket(prompt_len=req.prompt_len,
+                        max_new_tokens=req.max_new_tokens,
+                        priority=priority, tenant=tenant,
+                        deadline_s=req.deadline_s, slo_ttft_s=slo_ttft_s,
+                        payload=handle)
+        handle._ticket = ticket
+        reason = self._controller.offer(ticket)
+        if reason is not None:
+            self.tracing.record_rejected(req.uid, reason, **meta)
+            handle._resolve("rejected", reject_reason=reason)
+            return handle
+        self.tracing.start(req.uid, **meta)
+        self.tracing.mark(req.uid, "submitted", t=now)
+        with self._wake:
+            self._wake.notify()
+        return handle
+
+    def cancel(self, handle: StreamHandle) -> None:
+        if handle.done:
+            return
+        with self._wake:
+            self._cancel_requests.append(handle)
+            self._wake.notify()
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: stop accepting new work, serve everything
+        already accepted to completion, then stop the driver thread.
+        Idempotent. After a driver crash this just reaps the thread."""
+        with self._wake:
+            if self._closed:
+                return
+            self._closing = True
+            self._wake.notify()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            logger.warning("serving frontend driver did not drain within "
+                           f"{timeout}s; handles may still resolve late")
+            return
+        # post-join sweep: a submit() that raced the close can leave a
+        # ticket the driver never saw
+        for ticket in self._controller.drain():
+            handle = ticket.payload
+            self.tracing.record_rejected(
+                handle.uid, REJECT_FRONTEND_CLOSED)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_CLOSED)
+        self._closed = True
+        self.tracing.emit()
+
+    def __enter__(self) -> "ServingFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ----------------------------------------------------------- queries
+    @property
+    def crashed(self) -> bool:
+        with self._wake:
+            return self._crashed
+
+    @property
+    def crash_error(self) -> Optional[BaseException]:
+        with self._wake:
+            return self._crash_error
+
+    def stats(self) -> Dict[str, Any]:
+        """Control-plane counters (thread-safe, approximate under
+        concurrency)."""
+        return {
+            "submitted": self.n_submitted,
+            "pending_admission": self._controller.pending,
+            "offered": self._controller.n_offered,
+            "rate_limited": self._controller.n_rate_limited,
+            "shed": self._controller.n_shed,
+            "decode_rate_tokens_per_s": self._estimator.rate(),
+            "terminal": dict(self.tracing.counters),
+        }
+
+    # ------------------------------------------------------ driver loop
+    def _drive(self) -> None:
+        try:
+            while self._drive_once():
+                pass
+        except BaseException as e:  # noqa: BLE001 — converted to results
+            self._fail_all(e)
+
+    def _drive_once(self) -> bool:
+        eng = self._engine
+        with self._wake:
+            if not (self._cancel_requests or self._closing
+                    or self._controller.pending
+                    or eng.scheduler.has_work() or eng.chunk_in_flight):
+                self._wake.wait(self._idle_wait_s)
+            cancels, self._cancel_requests = self._cancel_requests, []
+            closing = self._closing
+        for handle in cancels:
+            self._do_cancel(handle)
+        self._feed()
+        if eng.scheduler.has_work() or eng.chunk_in_flight:
+            tokens_before = eng.metrics.tokens_out
+            t0 = time.perf_counter()
+            finished = eng.pump()
+            dt = time.perf_counter() - t0
+            self._estimator.record(eng.metrics.tokens_out - tokens_before,
+                                   dt)
+            self._deliver(finished)
+            # the scheduler's finished list is an append-only log; the
+            # frontend is its only consumer, so trim it here or a
+            # long-running server grows without bound
+            eng.scheduler.finished.clear()
+        self._maybe_emit()
+        if closing and not (self._controller.pending
+                            or eng.scheduler.has_work()
+                            or eng.chunk_in_flight
+                            or self._cancel_requests or self._handles):
+            return False
+        return True
+
+    def _feed(self) -> None:
+        """Move admission winners into the engine scheduler, keeping its
+        FIFO at most ``feed_depth`` deep so priority order keeps ruling
+        the backlog."""
+        eng = self._engine
+        sched = eng.scheduler
+        room = self._feed_depth - len(sched.queue)
+        if room <= 0 or self._controller.pending == 0:
+            return
+        w = self._controller.config.prefill_token_weight
+        backlog = sum(r.max_new_tokens - len(r.tokens)
+                      for r in sched.running.values())
+        backlog += sum(q.max_new_tokens + q.prompt_len * w
+                       for q in sched.queue)
+        admits, sheds = self._controller.pop(
+            room=room, rate=self._estimator.rate(), backlog_tokens=backlog)
+        for ticket, reason in sheds:
+            self._resolve_rejected(ticket, reason)
+        for ticket in admits:
+            handle: StreamHandle = ticket.payload
+            req = handle._request
+            eng.submit(req)
+            if req.status == "rejected":      # scheduler-side reject
+                self._resolve_rejected(ticket, req.reject_reason)
+            else:
+                self._handles[req.uid] = handle
+                self.tracing.mark(req.uid, "admitted")
+
+    def _resolve_rejected(self, ticket: Ticket, reason: str) -> None:
+        handle: StreamHandle = ticket.payload
+        self.tracing.finish(handle.uid, "rejected", reject_reason=reason)
+        handle._resolve("rejected", reject_reason=reason)
+
+    def _push_progress(self, req: Request,
+                       handle: Optional[StreamHandle] = None) -> None:
+        handle = handle or self._handles.get(req.uid)
+        if handle is None:
+            return
+        if not handle._prefill_marked and req.first_token_t is not None:
+            # prefill completion = the first sampled token's scheduler
+            # timestamp (same monotonic timebase as the frontend clock)
+            self.tracing.mark(req.uid, "prefill", t=req.first_token_t)
+            handle._prefill_marked = True
+        n = len(req.tokens)
+        if n > handle._pushed:
+            new = req.tokens[handle._pushed:n]
+            handle._pushed = n
+            self.tracing.chunk(req.uid, len(new))
+            handle._push(new)
+
+    def _deliver(self, finished: List[Request]) -> None:
+        eng = self._engine
+        for req in list(eng.scheduler.running.values()):
+            self._push_progress(req)
+        for req in finished:
+            handle = self._handles.pop(req.uid, None)
+            if handle is None:
+                continue              # cancelled earlier this iteration
+            self._push_progress(req, handle)
+            self.tracing.finish(req.uid, req.status)
+            handle._resolve(req.status)
+
+    def _do_cancel(self, handle: StreamHandle) -> None:
+        if handle.done:
+            return
+        ticket = handle._ticket
+        if ticket is not None and self._controller.remove(ticket):
+            # never reached the engine: no slot, no device work
+            self.tracing.finish(handle.uid, "cancelled")
+            handle._resolve("cancelled")
+            return
+        req = handle._request
+        if self._engine.cancel(req):
+            self._handles.pop(req.uid, None)
+            self._push_progress(req, handle)
+            self.tracing.finish(handle.uid, "cancelled")
+            handle._resolve("cancelled")
+        # else: the request reached a terminal state in the scheduler
+        # already — the regular _deliver path resolves the handle
+
+    def _maybe_emit(self) -> None:
+        now = self._clock()
+        if now - self._last_emit_t >= self._emit_every_s:
+            self._last_emit_t = now
+            self.tracing.emit()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        """Driver crash: convert every outstanding request — pending
+        admission, queued, running — into a structured ``error`` result
+        so no caller blocks forever, then mark the frontend dead (new
+        submits reject with ``frontend_closed``)."""
+        msg = f"{type(exc).__name__}: {exc}"
+        logger.error(f"serving frontend driver crashed: {msg}")
+        with self._wake:
+            self._crashed = True
+            self._crash_error = exc
+            cancels, self._cancel_requests = self._cancel_requests, []
+        for ticket in self._controller.drain():
+            handle: StreamHandle = ticket.payload
+            self.tracing.finish(handle.uid, "error", error=msg)
+            handle._resolve("error", error=msg)
+        for uid, handle in list(self._handles.items()):
+            self.tracing.finish(uid, "error", error=msg)
+            handle._resolve("error", error=msg)
+        self._handles.clear()
+        for handle in cancels:
+            self.tracing.finish(handle.uid, "error", error=msg)
+            handle._resolve("error", error=msg)

@@ -1,0 +1,261 @@
+"""Per-request tracing for the serving frontend.
+
+``ServingMetrics`` (serving/metrics.py) aggregates engine-side counters;
+this module records the *per-request* control-plane story the frontend
+owns: a span record per request
+
+    submitted -> admitted -> prefill -> first_token -> chunk[i] -> finish
+
+with derived latency stats (TTFT, TPOT, queue wait) folded into
+reservoir-backed p50/p95/p99 histograms (the same ``Reservoir`` the
+engine metrics use). Snapshots emit through the existing monitor fan-out
+(``(label, value, sample)`` events — CSV/TensorBoard/W&B pick them up
+unchanged) and the whole log dumps as JSON for offline analysis
+(``frontend_bench.py`` embeds it in ``BENCH_frontend.json``).
+
+Latency fields (all seconds):
+  ttft_s        submit -> first streamed token (the user-visible TTFT —
+                measured from ``ServingFrontend.submit``, so it includes
+                admission queueing, unlike the engine's scheduler-side
+                TTFT)
+  queue_wait_s  submit -> prefill start (time spent waiting for
+                admission + a slot)
+  tpot_s        mean time per output token after the first
+                (first_token -> finish over n_tokens - 1)
+
+Thread safety: one lock around all mutation — marks arrive from the
+frontend driver thread while ``snapshot``/``to_json`` may be read from
+callers.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from ..metrics import Reservoir
+
+#: canonical span event names, in lifecycle order
+EVENTS = ("submitted", "admitted", "prefill", "first_token", "finish")
+
+
+class RequestTrace:
+    """One request's span record. ``events`` maps event name -> absolute
+    clock time; chunk deliveries append to ``chunks`` as (t, n_tokens)
+    pairs rather than one event each (a 512-token stream stays a compact
+    record)."""
+
+    __slots__ = ("uid", "tenant", "priority", "prompt_len",
+                 "max_new_tokens", "slo_ttft_s", "deadline_s", "events",
+                 "chunks", "status", "reject_reason", "error", "n_tokens")
+
+    def __init__(self, uid: int, *, tenant: str = "default",
+                 priority: int = 1, prompt_len: int = 0,
+                 max_new_tokens: int = 0,
+                 slo_ttft_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None):
+        self.uid = uid
+        self.tenant = tenant
+        self.priority = priority
+        self.prompt_len = prompt_len
+        self.max_new_tokens = max_new_tokens
+        self.slo_ttft_s = slo_ttft_s
+        self.deadline_s = deadline_s
+        self.events: Dict[str, float] = {}
+        self.chunks: List[List[float]] = []      # [t, n_tokens] pairs
+        self.status: Optional[str] = None        # terminal status
+        self.reject_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.n_tokens = 0
+
+    # ------------------------------------------------------- derived
+    def _delta(self, a: str, b: str) -> Optional[float]:
+        if a in self.events and b in self.events:
+            return self.events[b] - self.events[a]
+        return None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return self._delta("submitted", "first_token")
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        return self._delta("submitted", "prefill")
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        dt = self._delta("first_token", "finish")
+        if dt is None or self.n_tokens < 2:
+            return None
+        return dt / (self.n_tokens - 1)
+
+    @property
+    def slo_ttft_met(self) -> Optional[bool]:
+        """Whether the measured TTFT met the request's SLO target; None
+        when no target was set or no token was produced."""
+        if self.slo_ttft_s is None or self.ttft_s is None:
+            return None
+        return self.ttft_s <= self.slo_ttft_s
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "uid": self.uid,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "prompt_len": self.prompt_len,
+            "max_new_tokens": self.max_new_tokens,
+            "status": self.status,
+            "reject_reason": self.reject_reason,
+            "error": self.error,
+            "n_tokens": self.n_tokens,
+            "slo_ttft_s": self.slo_ttft_s,
+            "deadline_s": self.deadline_s,
+            "events": dict(self.events),
+            "chunks": [list(c) for c in self.chunks],
+            "ttft_s": self.ttft_s,
+            "queue_wait_s": self.queue_wait_s,
+            "tpot_s": self.tpot_s,
+            "slo_ttft_met": self.slo_ttft_met,
+        }
+
+
+class TraceLog:
+    """Bounded per-request span store + latency histograms + terminal
+    counters, with monitor fan-out emission.
+
+    ``keep_last`` bounds the retained *finished* span records (the
+    histograms and counters keep aggregating past it — a long-running
+    server never grows unboundedly)."""
+
+    #: histogram name -> RequestTrace property feeding it
+    _HISTOGRAMS = ("ttft_s", "tpot_s", "queue_wait_s")
+
+    def __init__(self, monitor=None, *, keep_last: int = 256,
+                 reservoir_capacity: int = 1024,
+                 clock: Callable[[], float] = time.monotonic):
+        self.monitor = monitor
+        self.clock = clock
+        self.keep_last = int(keep_last)
+        self._lock = threading.Lock()
+        self._live: "OrderedDict[int, RequestTrace]" = OrderedDict()
+        self._done: Deque[RequestTrace] = deque(maxlen=self.keep_last)
+        self.histograms: Dict[str, Reservoir] = {
+            name: Reservoir(reservoir_capacity)
+            for name in self._HISTOGRAMS}
+        self.counters: Dict[str, int] = {}
+        self._emit_seq = 0
+
+    # ---------------------------------------------------------- recording
+    def start(self, uid: int, **meta) -> RequestTrace:
+        """Open a span (event ``submitted`` stamped now unless an
+        explicit time is threaded via ``mark`` later)."""
+        trace = RequestTrace(uid, **meta)
+        with self._lock:
+            self._live[uid] = trace
+        return trace
+
+    def mark(self, uid: int, event: str,
+             t: Optional[float] = None) -> None:
+        with self._lock:
+            trace = self._live.get(uid)
+            if trace is not None and event not in trace.events:
+                trace.events[event] = self.clock() if t is None else t
+
+    def chunk(self, uid: int, n_tokens: int,
+              t: Optional[float] = None) -> None:
+        """One delivery of ``n_tokens`` streamed tokens (one decode chunk
+        retiring). The first delivery also stamps ``first_token``."""
+        with self._lock:
+            trace = self._live.get(uid)
+            if trace is None or n_tokens <= 0:
+                return
+            now = self.clock() if t is None else t
+            if "first_token" not in trace.events:
+                trace.events["first_token"] = now
+            trace.chunks.append([now, int(n_tokens)])
+            trace.n_tokens += int(n_tokens)
+
+    def finish(self, uid: int, status: str, *,
+               reject_reason: Optional[str] = None,
+               error: Optional[str] = None,
+               t: Optional[float] = None) -> Optional[RequestTrace]:
+        """Close a span with its terminal status; folds its latencies
+        into the histograms and bumps the terminal counters."""
+        with self._lock:
+            trace = self._live.pop(uid, None)
+            if trace is None:
+                return None
+            trace.events["finish"] = self.clock() if t is None else t
+            trace.status = status
+            trace.reject_reason = reject_reason
+            trace.error = error
+            self.counters[status] = self.counters.get(status, 0) + 1
+            if reject_reason:
+                key = f"rejected:{reject_reason}"
+                self.counters[key] = self.counters.get(key, 0) + 1
+            met = trace.slo_ttft_met
+            if met is not None:
+                key = "slo_ttft_met" if met else "slo_ttft_missed"
+                self.counters[key] = self.counters.get(key, 0) + 1
+            for name in self._HISTOGRAMS:
+                v = getattr(trace, name)
+                if v is not None:
+                    self.histograms[name].add(v)
+            self._done.append(trace)
+            return trace
+
+    def record_rejected(self, uid: int, reason: str, **meta) -> None:
+        """Shorthand for a request rejected before it ever opened a live
+        span (submit-side gate rejections)."""
+        self.start(uid, **meta)
+        self.mark(uid, "submitted")
+        self.finish(uid, "rejected", reject_reason=reason)
+
+    # ------------------------------------------------------------ reading
+    def snapshot(self) -> Dict[str, float]:
+        """Flat label -> value map (the monitor event payload)."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for name, res in self.histograms.items():
+                pct = res.percentiles((50, 95, 99))
+                base = name[:-2] if name.endswith("_s") else name
+                out[f"frontend/{base}_p50_s"] = pct[50]
+                out[f"frontend/{base}_p95_s"] = pct[95]
+                out[f"frontend/{base}_p99_s"] = pct[99]
+            for status, n in self.counters.items():
+                out[f"frontend/{status.replace(':', '_')}"] = float(n)
+            return out
+
+    def emit(self, sample: Optional[int] = None) -> Dict[str, float]:
+        """Write the snapshot through the monitor fan-out (no-op without
+        a monitor; still returns the snapshot)."""
+        snap = self.snapshot()
+        if self.monitor is not None:
+            self._emit_seq = self._emit_seq + 1 if sample is None \
+                else int(sample)
+            self.monitor.write_events(
+                [(label, value, self._emit_seq)
+                 for label, value in snap.items()])
+        return snap
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "histograms": {
+                    name: {
+                        "p50": res.percentile(50),
+                        "p95": res.percentile(95),
+                        "p99": res.percentile(99),
+                        "n": res.n_seen,
+                    } for name, res in self.histograms.items()},
+                "counters": dict(self.counters),
+                "requests": [t.to_dict() for t in self._done],
+                "live": [t.to_dict() for t in self._live.values()],
+            }
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2)

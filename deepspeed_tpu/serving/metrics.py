@@ -9,6 +9,9 @@ against the same x-axis the training monitor uses for steps.
 Labels:
   serving/tokens_per_s      aggregate decode throughput since start
   serving/ttft_s            mean time-to-first-token over finished requests
+  serving/ttft_p50_s        reservoir-sampled TTFT percentiles (p50/p95/
+  serving/ttft_p95_s        p99) — tail latency, the number SLOs are
+  serving/ttft_p99_s        written against; the mean stays for dashboards
   serving/queue_depth       requests waiting for a slot
   serving/slot_occupancy    fraction of KV slots leased [0, 1]
   serving/requests_done     completed requests (cumulative)
@@ -25,9 +28,53 @@ Labels:
 
 from __future__ import annotations
 
+import random
 import time
 from types import SimpleNamespace
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
+
+
+class Reservoir:
+    """Fixed-size uniform reservoir (Vitter's algorithm R) for streaming
+    percentile estimates. Under ``capacity`` observations the percentiles
+    are EXACT; past it each seen value has equal probability of being in
+    the sample, so long-running servers keep an unbiased tail estimate in
+    O(capacity) memory. Host-side only; seeded so runs are
+    reproducible."""
+
+    def __init__(self, capacity: int = 1024, seed: int = 0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._rng = random.Random(seed)
+        self.values: List[float] = []
+        self.n_seen = 0
+
+    def add(self, x: float) -> None:
+        self.n_seen += 1
+        if len(self.values) < self.capacity:
+            self.values.append(float(x))
+        else:
+            j = self._rng.randrange(self.n_seen)
+            if j < self.capacity:
+                self.values[j] = float(x)
+
+    def percentile(self, q: float) -> float:
+        """Linear-interpolated percentile over the sample, q in [0, 100];
+        0.0 when empty (matches the mean-TTFT zero default)."""
+        if not self.values:
+            return 0.0
+        xs = sorted(self.values)
+        if len(xs) == 1:
+            return xs[0]
+        pos = (q / 100.0) * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        return xs[lo] + (pos - lo) * (xs[hi] - xs[lo])
+
+    def percentiles(self, qs: Sequence[float] = (50, 95, 99)
+                    ) -> Dict[float, float]:
+        return {q: self.percentile(q) for q in qs}
 
 
 def csv_monitor_master(output_path: str, job_name: str = "serving"):
@@ -58,6 +105,7 @@ class ServingMetrics:
         self.rejected = 0
         self._ttft_sum = 0.0
         self._ttft_n = 0
+        self.ttft_reservoir = Reservoir()
         self.prefill_prompt_tokens = 0
         self.prefill_padded_tokens = 0
         self.prefill_programs = 0
@@ -79,6 +127,7 @@ class ServingMetrics:
             if req.ttft_s is not None:
                 self._ttft_sum += req.ttft_s
                 self._ttft_n += 1
+                self.ttft_reservoir.add(req.ttft_s)
 
     def on_rejected(self, n: int = 1) -> None:
         self.rejected += int(n)
@@ -113,9 +162,13 @@ class ServingMetrics:
         return self.tokens_out / dt if dt > 0 else 0.0
 
     def snapshot(self, queue_depth: int, occupancy: float) -> Dict[str, float]:
+        pct = self.ttft_reservoir.percentiles((50, 95, 99))
         return {
             "serving/tokens_per_s": self.tokens_per_s(),
             "serving/ttft_s": self.mean_ttft_s,
+            "serving/ttft_p50_s": pct[50],
+            "serving/ttft_p95_s": pct[95],
+            "serving/ttft_p99_s": pct[99],
             "serving/queue_depth": float(queue_depth),
             "serving/slot_occupancy": float(occupancy),
             "serving/requests_done": float(self.requests_done),

@@ -34,6 +34,7 @@ import numpy as np
 
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_PROMPT_TOO_LONG = "prompt_too_long"
+REJECT_DEADLINE_EXPIRED = "deadline_expired"
 
 _uid_counter = itertools.count()
 
@@ -48,7 +49,7 @@ class Request:
     uid: int = dataclasses.field(default_factory=lambda: next(_uid_counter))
 
     # ---- filled in by the scheduler ----
-    status: str = "new"        # new|queued|running|done|expired|rejected
+    status: str = "new"   # new|queued|running|done|expired|rejected|cancelled
     reject_reason: Optional[str] = None
     slot: Optional[int] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
@@ -101,6 +102,7 @@ class ContinuousBatchScheduler:
         self.finished: List[Request] = []
         self.n_rejected = 0
         self.n_expired = 0
+        self.n_cancelled = 0
 
     # ------------------------------------------------------------- submit
     def submit(self, req: Request) -> bool:
@@ -114,6 +116,10 @@ class ContinuousBatchScheduler:
             and req.prompt_len + req.max_new_tokens > seq_cap)
         if too_long:
             return self._reject(req, REJECT_PROMPT_TOO_LONG)
+        # an already-expired deadline can never be met: reject here rather
+        # than admit, prefill, and kill at the first chunk boundary
+        if req.deadline_s is not None and req.submit_t >= req.deadline_s:
+            return self._reject(req, REJECT_DEADLINE_EXPIRED)
         if len(self.queue) >= self.max_queue:
             return self._reject(req, REJECT_QUEUE_FULL)
         req.status = "queued"
@@ -211,11 +217,33 @@ class ContinuousBatchScheduler:
         elif done:
             self._finish(req, "done")
 
+    def cancel(self, req: Request) -> bool:
+        """Caller-initiated termination. A queued request is removed
+        before it ever prefills; a running request frees its slot for the
+        very next admission pass (the engine deactivates the device lane
+        at the next chunk launch). Returns False when the request is
+        already terminal (or was never submitted here)."""
+        if req.status == "queued":
+            # identity scan, not deque.remove: the dataclass __eq__
+            # compares the numpy prompt arrays, which raises on bool()
+            for i, queued in enumerate(self.queue):
+                if queued is req:
+                    del self.queue[i]
+                    self._finish(req, "cancelled")
+                    return True
+            return False
+        if req.status == "running" and self.running.get(req.slot) is req:
+            self._finish(req, "cancelled")
+            return True
+        return False
+
     def _finish(self, req: Request, status: str) -> None:
         req.status = status
         req.finish_t = self.clock()
         if status == "expired":
             self.n_expired += 1
+        elif status == "cancelled":
+            self.n_cancelled += 1
         if req.slot is not None:
             self.running.pop(req.slot, None)
             self.allocator.free(req.slot)

@@ -12,10 +12,12 @@ import os
 import numpy as np
 import pytest
 
-from deepspeed_tpu.serving import (REJECT_PROMPT_TOO_LONG,
+from deepspeed_tpu.serving import (REJECT_DEADLINE_EXPIRED,
+                                   REJECT_PROMPT_TOO_LONG,
                                    REJECT_QUEUE_FULL,
                                    ContinuousBatchScheduler, Request,
-                                   ServingEngine, SlotAllocator,
+                                   Reservoir, ServingEngine,
+                                   ServingMetrics, SlotAllocator,
                                    csv_monitor_master)
 
 
@@ -167,6 +169,39 @@ class TestScheduler:
         assert done == [r] and r.status == "expired"
         assert alloc.n_free == 1
 
+    def test_already_expired_deadline_rejected_at_submit(self):
+        """A deadline in the past can never be met: submit must reject
+        with a reason instead of queueing work that would prefill and die
+        at the first chunk boundary."""
+        clock = FakeClock(10.0)
+        sched, _, _ = _sched(max_batch=1, clock=clock)
+        r = Request(prompt=[1], max_new_tokens=4, deadline_s=9.0)
+        assert not sched.submit(r)
+        assert r.status == "rejected"
+        assert r.reject_reason == REJECT_DEADLINE_EXPIRED
+        assert sched.n_rejected == 1 and sched.queue_depth == 0
+        # a deadline exactly at now is equally unmeetable
+        r2 = Request(prompt=[1], max_new_tokens=4, deadline_s=10.0)
+        assert not sched.submit(r2)
+        assert r2.reject_reason == REJECT_DEADLINE_EXPIRED
+
+    def test_cancel_queued_and_running(self):
+        sched, alloc, _ = _sched(max_batch=1)
+        a = Request(prompt=[1], max_new_tokens=8)
+        b = Request(prompt=[2], max_new_tokens=8)
+        sched.submit(a)
+        sched.submit(b)
+        sched.admit()
+        sched.record_first_token(a, 1)
+        assert sched.cancel(b) is True              # still queued
+        assert b.status == "cancelled" and sched.queue_depth == 0
+        assert sched.cancel(a) is True              # running: frees slot
+        assert a.status == "cancelled" and alloc.n_free == 1
+        assert sched.n_cancelled == 2
+        assert sched.cancel(a) is False             # already terminal
+        assert not sched.has_work()
+        assert sched.finished == [b, a]
+
     def test_slot_reuse_admits_next_queued(self):
         sched, _, _ = _sched(max_batch=1)
         a = Request(prompt=[1], max_new_tokens=1)
@@ -235,6 +270,60 @@ class TestScheduler:
         done = sched.step_tokens_chunk({r.slot: [7, 8, 9, 9]})
         assert done == [r] and r.status == "done"
         assert r.tokens == [4, 5, 6, 7, 8, 9]
+
+
+# ----------------------------------------------------- metrics reservoir
+class TestReservoir:
+    def test_exact_percentiles_under_capacity(self):
+        res = Reservoir(capacity=1024)
+        for x in range(1, 101):                     # 1..100
+            res.add(float(x))
+        assert res.percentile(50) == pytest.approx(50.5)
+        assert res.percentile(0) == 1.0
+        assert res.percentile(100) == 100.0
+        assert res.percentile(99) == pytest.approx(99.01)
+
+    def test_empty_and_singleton(self):
+        res = Reservoir(capacity=4)
+        assert res.percentile(99) == 0.0            # matches mean default
+        res.add(3.5)
+        assert res.percentiles((50, 95, 99)) == {50: 3.5, 95: 3.5, 99: 3.5}
+
+    def test_memory_bounded_and_unbiased_range(self):
+        res = Reservoir(capacity=16, seed=0)
+        for x in range(10_000):
+            res.add(float(x))
+        assert len(res.values) == 16 and res.n_seen == 10_000
+        # the sample is drawn from the whole stream, not just the head
+        assert max(res.values) > 1000
+
+    def test_deterministic_under_seed(self):
+        def fill(seed):
+            r = Reservoir(capacity=8, seed=seed)
+            for x in range(1000):
+                r.add(float(x))
+            return r.values
+        assert fill(0) == fill(0)
+        assert fill(0) != fill(1)
+
+    def test_metrics_snapshot_has_percentile_keys(self):
+        """snapshot() gains reservoir-backed TTFT percentiles WITHOUT
+        breaking any pre-existing key serving_bench.py reads."""
+        m = ServingMetrics()
+        for ttft in (0.1, 0.2, 0.3):
+            req = Request(prompt=[1], max_new_tokens=1)
+            req.submit_t, req.first_token_t = 0.0, ttft
+            m.on_finished([req])
+        snap = m.snapshot(queue_depth=0, occupancy=0.0)
+        assert snap["serving/ttft_p50_s"] == pytest.approx(0.2)
+        assert snap["serving/ttft_p95_s"] == pytest.approx(0.29)
+        assert snap["serving/ttft_p99_s"] == pytest.approx(0.298)
+        for legacy in ("serving/tokens_per_s", "serving/ttft_s",
+                       "serving/queue_depth", "serving/slot_occupancy",
+                       "serving/requests_done", "serving/rejected_total",
+                       "serving/prefill_padding_waste",
+                       "serving/prefill_programs"):
+            assert legacy in snap
 
 
 # --------------------------------------------------- engine (integration)
