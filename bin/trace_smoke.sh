@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Trace smoke: one captured serving-bench run must yield a
+# Perfetto-loadable Chrome trace — phase spans, TraceAuditor retrace
+# instants, counter tracks — and bin/tputrace must both validate its
+# shape and summarize it. Exits nonzero on bench failure, a malformed
+# trace, or a trace missing the expected content.
+#
+# Usage: bin/trace_smoke.sh        (from the repo root, or anywhere)
+
+set -e
+cd "$(dirname "$0")/.." || exit 1
+
+TRACE=/tmp/trace_smoke.json
+
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m deepspeed_tpu.benchmarks.serving_bench \
+    --n-requests 4 --max-new-tokens 16 --max-batch 4 \
+    --decode-chunk 4 --skip-sequential \
+    --out-dir /tmp/trace_smoke_csv --trace-out "$TRACE" > /dev/null
+
+bin/tputrace validate "$TRACE"
+bin/tputrace summary "$TRACE" --top 8
+
+# the trace must actually contain the advertised content
+python - "$TRACE" <<'EOF'
+import json, sys
+obj = json.load(open(sys.argv[1]))
+evs = obj["traceEvents"]
+phs = {e["ph"] for e in evs}
+names = {e["name"] for e in evs}
+assert "X" in phs, "no spans captured"
+assert "C" in phs, "no counter tracks captured"
+assert any(n.startswith("serve/") for n in names), "no serve phase spans"
+assert "tracelint/retrace" in names, "no TraceAuditor retrace instants"
+print(f"trace content ok: {len(evs)} events, "
+      f"{sum(e['ph'] == 'X' for e in evs)} spans, "
+      f"{sum(e['ph'] == 'i' for e in evs)} instants, "
+      f"{sum(e['ph'] == 'C' for e in evs)} counter samples")
+EOF

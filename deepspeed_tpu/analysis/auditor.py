@@ -114,6 +114,7 @@ class _AuditedFunction:
         after = self._cache_size_safe()
         if after is not None and before is not None and after > before:
             rec.compiles += after - before
+            self._emit_retrace(after - before, args, kwargs)
             if rec.budget is not None and rec.compiles > rec.budget:
                 raise RetraceBudgetError(
                     f"tracelint: program '{rec.name}' compiled "
@@ -132,6 +133,26 @@ class _AuditedFunction:
             return self._jitted._cache_size()
         except Exception:
             return None
+
+    def _emit_retrace(self, n: int, args, kwargs) -> None:
+        """Mark each detected compile on the telemetry timeline — a
+        ``tracelint/retrace`` instant (with the triggering program +
+        signature) and a counter track — so Perfetto shows WHEN the pay
+        happened, next to the span that paid it. Telemetry is imported
+        lazily and failures are swallowed: the auditor must keep working
+        in minimal environments and must never turn a perfectly
+        budgeted compile into a crash."""
+        try:
+            from ..telemetry import core as _tel
+            if not _tel.get_runtime().enabled:
+                return
+            rec = self._record
+            _tel.instant("tracelint/retrace", program=rec.name,
+                         compiles=rec.compiles,
+                         signature=_arg_signature(args, kwargs))
+            _tel.count("tracelint/compiles", float(n))
+        except Exception:
+            pass
 
 
 class TraceAuditor:

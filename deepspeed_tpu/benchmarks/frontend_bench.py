@@ -39,7 +39,7 @@ import time
 
 import numpy as np
 
-from .serving_bench import _tiny_model
+from .serving_bench import _round_tree, _tiny_model
 
 
 def _percentile(xs, q):
@@ -52,12 +52,17 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
               prompt_len: int = 16, decode_chunk: int = 4,
               high_fraction: float = 0.25, ttft_bound_s: float = 10.0,
               seed: int = 0, model=None, params=None,
-              timeout_s: float = 300.0) -> dict:
+              timeout_s: float = 300.0, trace_out: str = None) -> dict:
     import jax.numpy as jnp
     import deepspeed_tpu as ds
+    from .. import telemetry
+    from ..telemetry.mfu import mfu_report
+    from ..telemetry.summary import phase_breakdown
     from ..serving import ServingEngine
     from ..serving.frontend import (AdmissionConfig, PRIORITY_HIGH,
                                     PRIORITY_LOW, ServingFrontend)
+
+    telemetry.enable()
 
     if model is None:
         model, params = _tiny_model()
@@ -127,6 +132,7 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     interval = 1.0 / offered_rps
     n_high = 0
     load_handles = []
+    stats_before = telemetry.get_runtime().span_stats()
     t_start = time.perf_counter()
     for i in range(n_requests):
         # open loop: the i-th arrival is scheduled at t_start + i*interval
@@ -151,6 +157,28 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         h.result(timeout=max(0.1, deadline - time.monotonic()))
     wall_s = time.perf_counter() - t_start
     frontend.close()
+    # overload-phase-only span breakdown (telemetry aggregate deltas;
+    # the engine-driver thread's serve/* spans land in their own lane)
+    overload_phases = phase_breakdown(
+        stats_before, telemetry.get_runtime().span_stats(), wall_s=wall_s)
+    # MFU for the decode-chunk program over the overload window. Costed
+    # AFTER all serving work — cost analysis pays one extra XLA compile
+    # (see ServingEngine.estimate_chunk_cost)
+    mfu = None
+    cost = fe_engine.estimate_chunk_cost()
+    if cost is not None:
+        n_chunks = int(overload_phases.get("serve/chunk_launch",
+                                           {}).get("count", 0))
+        mfu = mfu_report(flops_per_call=cost["flops_per_chunk"],
+                         calls=n_chunks, wall_s=wall_s,
+                         peak_flops=cost["peak_flops_per_device"],
+                         label="decode_chunk@overload")
+        mfu["flops_per_token"] = cost["flops_per_token"]
+        mfu["scan_body_counted_once"] = cost["scan_body_counted_once"]
+    if trace_out:
+        # one Perfetto file: engine/driver thread lanes + per-request
+        # frontend lanes with submit->finish flow arrows
+        frontend.tracing.export_chrome(trace_out)
 
     traces = {t["uid"]: t
               for t in frontend.tracing.to_json()["requests"]}
@@ -207,6 +235,10 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         "high_ttft_p99_s": round(p99_high, 4) if p99_high else None,
         "frontend_snapshot": frontend.tracing.snapshot(),
         "frontend_stats": frontend.stats(),
+        # overload-phase-only span breakdown + decode-chunk MFU estimate
+        "phase_breakdown": _round_tree(overload_phases),
+        "mfu": _round_tree(mfu) if mfu else None,
+        "trace_file": trace_out,
     }
 
 
@@ -222,6 +254,10 @@ def main(argv=None):
     ap.add_argument("--ttft-bound-s", type=float, default=10.0)
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="write a Perfetto-loadable Chrome trace "
+                    "(engine lanes + per-request flow lanes) to this "
+                    "path (inspect with bin/tputrace)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
     result = run_bench(n_requests=args.n_requests,
@@ -232,7 +268,7 @@ def main(argv=None):
                        decode_chunk=args.decode_chunk,
                        high_fraction=args.high_fraction,
                        ttft_bound_s=args.ttft_bound_s,
-                       seed=args.seed)
+                       seed=args.seed, trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -71,13 +71,28 @@ def _timed_serving_run(serving, prompts, max_new_tokens):
     inserts, decode); the second stabilizes buffer shardings — the
     freshly built arena and a decode program's output arena differ in
     sharding metadata, so programs taking the arena retrace once more
-    before steady state. Returns (results, seconds, tokens)."""
+    before steady state. Returns (results, seconds, tokens, phases)
+    where ``phases`` is the telemetry span breakdown attributable to the
+    timed pass only (aggregate deltas — warmup spans excluded)."""
+    from .. import telemetry
+    from ..telemetry.summary import phase_breakdown
     serving.run(list(prompts), max_new_tokens=max_new_tokens)
     serving.run(list(prompts), max_new_tokens=max_new_tokens)
+    rt = telemetry.get_runtime()
+    before = rt.span_stats()
     t0 = time.perf_counter()
     results = serving.run(list(prompts), max_new_tokens=max_new_tokens)
     dt = time.perf_counter() - t0
-    return results, dt, sum(len(r.tokens) for r in results)
+    phases = phase_breakdown(before, rt.span_stats(), wall_s=dt)
+    return results, dt, sum(len(r.tokens) for r in results), phases
+
+
+def _round_tree(obj, nd=6):
+    if isinstance(obj, dict):
+        return {k: _round_tree(v, nd) for k, v in obj.items()}
+    if isinstance(obj, float):
+        return round(obj, nd)
+    return obj
 
 
 def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
@@ -85,14 +100,25 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               decode_chunk: int = 8,
               out_dir: str = "serving_bench_csv", seed: int = 0,
               model=None, params=None,
-              with_sequential: bool = True) -> dict:
+              with_sequential: bool = True,
+              trace_out: str = None) -> dict:
     """Returns a result dict; writes serving metrics CSVs under
     ``out_dir`` through the monitor fan-out. ``prompt_len`` is the MAX
     prompt length; actual prompts are mixed lengths in [4, prompt_len]
-    so the bucketed prefill path is exercised."""
+    so the bucketed prefill path is exercised.
+
+    Telemetry capture is ON for the serving runs: the result gains a
+    per-phase breakdown of the timed passes and an MFU estimate for the
+    decode-chunk program, and ``trace_out`` (if given) receives the
+    whole run as a Perfetto-loadable Chrome trace — phase spans,
+    TraceAuditor retrace instants, counter tracks."""
     import jax.numpy as jnp
     import deepspeed_tpu as ds
+    from .. import telemetry
+    from ..telemetry.mfu import mfu_report
     from ..serving import ServingEngine, csv_monitor_master
+
+    telemetry.enable()
 
     if model is None:
         model, params = _tiny_model()
@@ -127,7 +153,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
     per_token = ServingEngine(engine=engine, max_batch=max_batch,
                               max_prompt_len=prompt_len, decode_chunk=1,
                               max_queue=max(n_requests, 8))
-    pt_results, pt_dt, pt_tokens = _timed_serving_run(
+    pt_results, pt_dt, pt_tokens, pt_phases = _timed_serving_run(
         per_token, prompts, max_new_tokens)
     pt_tps = pt_tokens / pt_dt
 
@@ -152,10 +178,9 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
                                 decode_chunk=decode_chunk,
                                 max_queue=max(n_requests, 8),
                                 monitor=monitor, emit_every_steps=4)
-        ck_results, ck_dt, ck_tokens = _timed_serving_run(
+        ck_results, ck_dt, ck_tokens, ck_phases = _timed_serving_run(
             chunked, prompts, max_new_tokens)
     ck_tps = ck_tokens / ck_dt
-    monitor.close()
     decode_compiles = auditor.compiles("decode_chunk_fn")
     if decode_compiles != DECODE_PROGRAM_BUDGET:
         raise RuntimeError(
@@ -163,6 +188,31 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             f"{DECODE_PROGRAM_BUDGET} (initial trace + two arena-metadata "
             "retraces across the double-warm) — the warmup strategy no "
             "longer matches the program's retrace behavior")
+
+    # MFU: strictly AFTER the audited/timed region — cost analysis pays
+    # one extra XLA compile that must not perturb the pinned budget
+    mfu = None
+    cost = chunked.estimate_chunk_cost()
+    if cost is not None:
+        n_chunks = int(ck_phases.get("serve/chunk_launch",
+                                     {}).get("count", 0))
+        mfu = mfu_report(flops_per_call=cost["flops_per_chunk"],
+                         calls=n_chunks, wall_s=ck_dt,
+                         peak_flops=cost["peak_flops_per_device"],
+                         label="decode_chunk")
+        mfu["flops_per_token"] = cost["flops_per_token"]
+        mfu["bytes_accessed"] = cost["bytes_accessed"]
+        # XLA counts the chunk's lax.scan body once; flops_per_chunk is
+        # the xK estimate (see ServingEngine.estimate_chunk_cost)
+        mfu["scan_body_counted_once"] = cost["scan_body_counted_once"]
+    telemetry.emit_summary(monitor, telemetry.get_runtime())
+    monitor.close()
+    if trace_out:
+        telemetry.write_chrome_trace(
+            trace_out, telemetry.get_runtime(),
+            metadata={"bench": "serving_bench",
+                      "decode_chunk": decode_chunk,
+                      "n_requests": n_requests})
 
     parity = all(
         np.array_equal(a.output_ids, b.output_ids)
@@ -199,6 +249,11 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         "decode_chunk_compiles": decode_compiles,
         "decode_chunk_budget": DECODE_PROGRAM_BUDGET,
         "mean_ttft_s": round(float(np.mean(ttfts)), 4) if ttfts else None,
+        # timed-pass-only span breakdowns (telemetry aggregate deltas)
+        "phase_breakdown": {"per_token": _round_tree(pt_phases),
+                            "chunked": _round_tree(ck_phases)},
+        "mfu": _round_tree(mfu) if mfu else None,
+        "trace_file": trace_out,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],
     }
@@ -217,6 +272,9 @@ def main(argv=None):
                     "(smoke runs compare only the two serving loops)")
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="write a Perfetto-loadable Chrome trace of the "
+                    "whole run to this path (inspect with bin/tputrace)")
     ap.add_argument("--out-dir", type=str, default="serving_bench_csv")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
@@ -226,7 +284,8 @@ def main(argv=None):
                        prompt_len=args.prompt_len,
                        decode_chunk=args.decode_chunk,
                        out_dir=args.out_dir, seed=args.seed,
-                       with_sequential=not args.skip_sequential)
+                       with_sequential=not args.skip_sequential,
+                       trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

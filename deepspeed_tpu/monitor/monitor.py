@@ -16,6 +16,7 @@ concurrent emits never interleave rows or race a close."""
 from __future__ import annotations
 
 import atexit
+import binascii
 import csv
 import os
 import threading
@@ -46,13 +47,25 @@ class CsvWriter(_BaseWriter):
         self.out_dir = os.path.join(cfg.output_path or "csv_monitor", cfg.job_name)
         os.makedirs(self.out_dir, exist_ok=True)
         self._files = {}         # label -> (file handle, csv writer)
+        self._claimed = {}       # sanitized filename -> owning label
         self._lock = threading.RLock()
+
+    def _filename(self, label):
+        # "/" -> "_" is lossy: labels "a/b" and "a_b" used to land in
+        # the SAME csv, silently interleaving two metric streams. The
+        # first label to claim a sanitized name keeps it (existing
+        # on-disk filenames stay stable); later colliding labels get a
+        # short stable hash suffix.
+        base = label.replace("/", "_")
+        owner = self._claimed.setdefault(base, label)
+        if owner != label:
+            base = f"{base}-{binascii.crc32(label.encode()) & 0xffffffff:08x}"
+        return os.path.join(self.out_dir, base + ".csv")
 
     def _writer(self, label):
         entry = self._files.get(label)
         if entry is None:
-            fname = os.path.join(self.out_dir,
-                                 label.replace("/", "_") + ".csv")
+            fname = self._filename(label)
             new = not os.path.exists(fname)
             fh = open(fname, "a", newline="")
             w = csv.writer(fh)

@@ -45,6 +45,7 @@ class FlopsProfiler:
         self.flops_per_step = flops_per_step
         self._t0 = None
         self.latency = None
+        self.mfu = None          # populated by print_profile when known
 
     def on_forward(self, batch):
         if self.engine.global_steps == self.cfg.profile_step and self._t0 is None:
@@ -54,7 +55,22 @@ class FlopsProfiler:
         if self._t0 is not None and global_step > self.cfg.profile_step:
             self.latency = time.perf_counter() - self._t0
             self._t0 = None
+            if self.flops_per_step is None:
+                # profiler is explicitly enabled, so the one extra XLA
+                # compile this costs is opted into (telemetry/mfu.py)
+                est = self._estimate_step_flops()
+                if est:
+                    self.flops_per_step = est.get("flops")
             self.print_profile()
+
+    def _estimate_step_flops(self) -> Optional[Dict[str, Any]]:
+        est_fn = getattr(self.engine, "estimate_step_flops", None)
+        if est_fn is None:
+            return None
+        try:
+            return est_fn()
+        except Exception:
+            return None
 
     def set_flops_per_step(self, flops: float):
         self.flops_per_step = flops
@@ -64,8 +80,17 @@ class FlopsProfiler:
             return
         msg = f"flops profiler: step latency {self.latency*1e3:.1f} ms"
         if self.flops_per_step:
-            tflops = self.flops_per_step / self.latency / 1e12
+            from ..telemetry.mfu import mfu_report, peak_flops_per_device
+            report = mfu_report(
+                flops_per_call=self.flops_per_step, calls=1,
+                wall_s=self.latency,
+                n_devices=jax.local_device_count(),
+                peak_flops=peak_flops_per_device(), label="train_step")
+            self.mfu = report["mfu"]
+            tflops = report["achieved_tflops_per_s"]
             msg += f", {tflops:.2f} TFLOPs"
+            if report["mfu"] is not None:
+                msg += f", MFU {report['mfu'] * 100:.1f}%"
         log_dist(msg, ranks=[0])
 
 

@@ -40,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import comm
 from ..checkpoint import saving as ckpt_saving
+from ..telemetry import core as telemetry
 from ..ops.adam import fused_adagrad, fused_adam
 from ..ops.lamb import fused_lamb
 from ..parallel import mesh as mesh_lib
@@ -931,11 +932,12 @@ class DeepSpeedEngine:
                 self._train_iter = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._train_iter
         gas = self.gradient_accumulation_steps()
-        micros = [next(data_iter) for _ in range(gas)]
-        batches = jax.tree.map(lambda *xs: np.stack(xs), *micros)
-        if self.curriculum_scheduler is not None:
-            batches = self._apply_curriculum(batches, stacked=True)
-        batches = self._shard_batch(batches, stacked=True)
+        with telemetry.span("train/data", gas=gas):
+            micros = [next(data_iter) for _ in range(gas)]
+            batches = jax.tree.map(lambda *xs: np.stack(xs), *micros)
+            if self.curriculum_scheduler is not None:
+                batches = self._apply_curriculum(batches, stacked=True)
+            batches = self._shard_batch(batches, stacked=True)
         # only the eigenvalue refresh consumes a sample batch — don't pin one
         # in HBM for plain MoQ
         self._last_micro = jax.tree.map(lambda x: x[0], batches) \
@@ -976,8 +978,11 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         if wcb:
             self.timers("train_batch_dispatch").start()
-        self.state, metrics = self._jit_train(self.state, batches,
-                                              self._forward_extras())
+        # dispatch-only span BY DESIGN: JAX returns before the device
+        # finishes; the device time lands in train/sync on report steps
+        with telemetry.span("train/dispatch", step=self.global_steps):
+            self.state, metrics = self._jit_train(self.state, batches,
+                                                  self._forward_extras())
         if wcb:
             self.timers("train_batch_dispatch").stop()
             self.timers("train_batch_device").start()
@@ -986,7 +991,24 @@ class DeepSpeedEngine:
         # sync only on report steps: a per-step block_until_ready would
         # serialize dispatch against the device and stall the pipeline
         will_report = (self.global_steps + 1) % self.steps_per_print() == 0
-        self.tput_timer.stop(sync=metrics["loss"] if will_report else None)
+        with telemetry.span("train/sync", report=will_report):
+            self.tput_timer.stop(sync=metrics["loss"] if will_report
+                                 else None)
+        if will_report and telemetry.get_runtime().enabled:
+            # already synced above, so this device_get is a cheap host
+            # copy; off report steps nothing reads the device
+            skipped = int(jax.device_get(self.state["skipped"]))  # tracelint: disable=host-sync
+            prev = getattr(self, "_tel_skipped", 0)
+            if skipped > prev:
+                telemetry.instant("train/loss_scale_skip",
+                                  total_skipped=skipped,
+                                  new=skipped - prev)
+            telemetry.gauge("train/skipped_steps", float(skipped))
+            self._tel_skipped = skipped
+        # shapes of the last stacked+sharded batch, kept abstract for
+        # estimate_step_flops (MFU) — no device buffers retained
+        self._step_aval_batches = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batches)
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += self.train_batch_size()
@@ -995,6 +1017,42 @@ class DeepSpeedEngine:
             self._apply_moq(metrics)
         self._after_step(metrics)
         return metrics["loss"]
+
+    def estimate_step_flops(self) -> Optional[Dict[str, Any]]:
+        """XLA cost analysis of one fused train-step program, for MFU
+        reporting (telemetry.mfu / the flops profiler). Requires at
+        least one completed ``train_batch`` on the jitted path (the
+        batch avals are captured there). Lowers with abstract
+        ``ShapeDtypeStruct`` args — no device work — but pays one extra
+        XLA compile, so call it outside audited/timed regions. The GAS
+        micro loop is a ``lax.scan`` whose body XLA counts once;
+        ``flops_per_step`` scales by ``gradient_accumulation_steps``
+        (flagged as an estimate). Returns None when unavailable."""
+        avals = getattr(self, "_step_aval_batches", None)
+        if self._jit_train is None or avals is None:
+            return None
+        from ..telemetry import mfu as _mfu
+
+        def abst(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+            return x
+        ca = _mfu.compiled_cost_analysis(
+            self._jit_train, jax.tree.map(abst, self.state), avals,
+            jax.tree.map(abst, self._forward_extras()))
+        if ca is None:
+            return None
+        gas = self.gradient_accumulation_steps()
+        flops_per_step = ca["flops"] * gas
+        return {
+            "program_flops": ca["flops"],
+            "bytes_accessed": ca["bytes_accessed"],
+            "scan_length": gas,
+            "flops_per_step": flops_per_step,
+            "flops": flops_per_step,
+            "scan_body_counted_once": True,
+            "peak_flops_per_device": _mfu.peak_flops_per_device(),
+        }
 
     # --- 3-call parity API -------------------------------------------------
     def forward(self, batch):

@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
 
+from ..telemetry import core as telemetry
 from ..utils.logging import log_dist
 from .kv_cache import SlotKVCacheManager
 from .metrics import ServingMetrics
@@ -363,6 +364,47 @@ class ServingEngine:
                                 self.kv.occupancy, force=True)
         return submitted
 
+    def estimate_chunk_cost(self) -> Optional[Dict[str, Any]]:
+        """XLA cost analysis of one decode-chunk program invocation, for
+        MFU reporting (telemetry.mfu). Lowers ``_jit_decode_chunk`` with
+        abstract ``ShapeDtypeStruct`` args — no device buffers touched —
+        but pays ONE extra XLA compile, so benches call this strictly
+        AFTER their timed/audited passes (the pinned decode retrace
+        budget stays exact; see docs/observability.md).
+
+        XLA counts the chunk's ``lax.scan`` body once, not K times, so
+        ``flops_per_chunk`` scales the program count by K — an estimate,
+        flagged as such in the result. Returns None when the backend
+        reports no costs."""
+        import jax
+        from ..telemetry import mfu as _mfu
+
+        def abst(x):
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+        B = self.max_batch
+        i32 = jax.ShapeDtypeStruct((B,), np.int32)
+        ca = _mfu.compiled_cost_analysis(
+            self._jit_decode_chunk,
+            jax.tree.map(abst, self.engine.params),
+            jax.tree.map(abst, self.kv.cache),
+            i32, i32, jax.ShapeDtypeStruct((B,), bool), i32, i32,
+            abst(self._rng))
+        if ca is None:
+            return None
+        K = self.decode_chunk
+        flops_per_chunk = ca["flops"] * K
+        return {
+            "program_flops": ca["flops"],
+            "bytes_accessed": ca["bytes_accessed"],
+            "scan_length": K,
+            "flops_per_chunk": flops_per_chunk,
+            "flops_per_token": flops_per_chunk / (B * K),
+            "max_batch": B,
+            "scan_body_counted_once": True,
+            "peak_flops_per_device": _mfu.peak_flops_per_device(),
+        }
+
     # ---------------------------------------------------------- internals
     def _next_rng(self):
         import jax
@@ -394,12 +436,22 @@ class ServingEngine:
             for i, r in enumerate(reqs):
                 ids[i, :r.prompt_len] = r.prompt
                 lens[i] = r.prompt_len
+            if (n, bucket) not in self._prefill_shapes:
+                # first sighting of this (batch, bucket) shape = the call
+                # below compiles a fresh prefill program — mark it on the
+                # timeline so a long prefill span is explainable
+                telemetry.instant("serve/prefill_compile", n=n,
+                                  bucket=bucket)
             self._prefill_shapes.add((n, bucket))
-            toks, cache = self._jit_prefill(
-                self.engine.params, jnp.asarray(ids), jnp.asarray(lens),
-                self._next_rng())
-            self.kv.insert_batch(cache, [r.slot for r in reqs], lens)
-            toks_host = np.asarray(toks)
+            # np.asarray(toks) below is the host sync, so the span covers
+            # dispatch + device prefill + arena insert honestly
+            with telemetry.span("serve/prefill", n=n, bucket=bucket):
+                toks, cache = self._jit_prefill(
+                    self.engine.params, jnp.asarray(ids),
+                    jnp.asarray(lens), self._next_rng())
+                self.kv.insert_batch(cache, [r.slot for r in reqs], lens)
+                toks_host = np.asarray(toks)
+            telemetry.count("serve/prefill_tokens", float(lens.sum()))
             self.metrics.on_prefill(n, bucket, int(lens.sum()),
                                     len(self._prefill_shapes))
             self.metrics.on_tokens(n)
@@ -438,12 +490,15 @@ class ServingEngine:
         for s in slots:
             tokens[s] = self._last_token[s]
             positions[s] = self.kv.fill[s]
-        tok, new_cache = self._jit_decode(
-            self.engine.params, self.kv.cache, jnp.asarray(tokens),
-            jnp.asarray(positions), self._next_rng())
-        self.kv.update(new_cache)
-        self.kv.allocator.advance(slots)
-        tok_host = np.asarray(tok)
+        # np.asarray(tok) is the per-token host sync — the span covers
+        # dispatch + device step (the K=1 reference path's whole cost)
+        with telemetry.span("serve/decode_step", n=len(slots)):
+            tok, new_cache = self._jit_decode(
+                self.engine.params, self.kv.cache, jnp.asarray(tokens),
+                jnp.asarray(positions), self._next_rng())
+            self.kv.update(new_cache)
+            self.kv.allocator.advance(slots)
+            tok_host = np.asarray(tok)
         for s in slots:
             self._last_token[s] = int(tok_host[s])
         finished = self.scheduler.step_tokens(
@@ -484,9 +539,13 @@ class ServingEngine:
         state."""
         tok, pos, act, rem, eos = chunk.state
         if self._deact_slots:
+            telemetry.instant("serve/deact_patch",
+                              n=len(self._deact_slots))
             idx = np.array(sorted(self._deact_slots), np.int32)
             act = act.at[idx].set(False)
         if self._admit_patches:
+            telemetry.instant("serve/admit_patch",
+                              n=len(self._admit_patches))
             slots = np.array(sorted(self._admit_patches), np.int32)
             vals = [self._admit_patches[int(s)] for s in slots]
             tok = tok.at[slots].set(
@@ -506,13 +565,17 @@ class ServingEngine:
         """Enqueue one K-step decode chunk (returns immediately — JAX
         async dispatch; nothing here blocks on device results)."""
         import jax.numpy as jnp
-        tokens, positions, active, remaining, eos = (
-            jnp.asarray(a) for a in state)
-        toks, valid, new_cache, tok_f, pos_f, act_f, rem_f = \
-            self._jit_decode_chunk(self.engine.params, self.kv.cache,
-                                   tokens, positions, active, eos,
-                                   remaining, self._next_rng())
-        self.kv.update(new_cache)
+        # dispatch-only span BY DESIGN (no sync=): the chunk is meant to
+        # run asynchronously; the honest device wait is measured at
+        # consume time as serve/chunk_host_wait
+        with telemetry.span("serve/chunk_launch", k=self.decode_chunk):
+            tokens, positions, active, remaining, eos = (
+                jnp.asarray(a) for a in state)
+            toks, valid, new_cache, tok_f, pos_f, act_f, rem_f = \
+                self._jit_decode_chunk(self.engine.params, self.kv.cache,
+                                       tokens, positions, active, eos,
+                                       remaining, self._next_rng())
+            self.kv.update(new_cache)
         return _InflightChunk(
             slot_uids={s: r.uid for s, r in self.scheduler.running.items()},
             tokens=toks, valid=valid,
@@ -521,19 +584,27 @@ class ServingEngine:
     def _consume_chunk(self, chunk: _InflightChunk) -> List[Request]:
         """Block on the chunk's token buffer (the ONE host sync per K
         steps) and feed it through the scheduler."""
-        toks = np.asarray(chunk.tokens)
-        valid = np.asarray(chunk.valid)
-        per_slot: Dict[int, List[int]] = {}
-        for slot, uid in chunk.slot_uids.items():
-            req = self.scheduler.running.get(slot)
-            if req is None or req.uid != uid:
-                continue        # slot retired/re-leased since launch
-            seq = [int(t) for t, v in zip(toks[slot], valid[slot]) if v]
-            if seq:
-                per_slot[slot] = seq
-                self._last_token[slot] = seq[-1]
-        finished = self.scheduler.step_tokens_chunk(per_slot)
-        self.metrics.on_tokens(sum(len(v) for v in per_slot.values()))
+        with telemetry.span("serve/chunk_host_wait"):
+            toks = np.asarray(chunk.tokens)
+            valid = np.asarray(chunk.valid)
+        with telemetry.span("serve/chunk_retire"):
+            per_slot: Dict[int, List[int]] = {}
+            for slot, uid in chunk.slot_uids.items():
+                req = self.scheduler.running.get(slot)
+                if req is None or req.uid != uid:
+                    continue        # slot retired/re-leased since launch
+                seq = [int(t) for t, v in
+                       zip(toks[slot], valid[slot]) if v]
+                if seq:
+                    per_slot[slot] = seq
+                    self._last_token[slot] = seq[-1]
+            finished = self.scheduler.step_tokens_chunk(per_slot)
+        n_tokens = sum(len(v) for v in per_slot.values())
+        telemetry.count("serve/decode_tokens", float(n_tokens))
+        telemetry.gauge("serve/queue_depth",
+                        float(self.scheduler.queue_depth))
+        telemetry.gauge("serve/occupancy", float(self.kv.occupancy))
+        self.metrics.on_tokens(n_tokens)
         self.metrics.on_decode_step()
         self.metrics.on_finished(finished)
         for req in finished:

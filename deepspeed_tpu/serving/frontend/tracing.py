@@ -259,3 +259,22 @@ class TraceLog:
     def dump(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump(self.to_json(), f, indent=2)
+
+    def export_chrome(self, path: Optional[str] = None,
+                      runtime=None) -> Dict[str, Any]:
+        """One Perfetto file for the whole story: this log's per-request
+        lanes (with submit->finish flow arrows) merged with the
+        process-wide telemetry runtime's engine/driver timeline — no
+        second trace format to maintain. On Linux the two clocks
+        (``time.monotonic`` here, ``time.perf_counter`` in telemetry)
+        are both CLOCK_MONOTONIC, so the lanes line up without
+        translation. Writes to ``path`` when given; always returns the
+        trace object."""
+        from ...telemetry import (chrome_trace, request_trace_events,
+                                  write_chrome_trace)
+        from ...telemetry import core as _tcore
+        rt = runtime if runtime is not None else _tcore.get_runtime()
+        extra = request_trace_events(self.to_json())
+        if path is None:
+            return chrome_trace(rt, extra_events=extra)
+        return write_chrome_trace(path, rt, extra_events=extra)

@@ -1,0 +1,52 @@
+"""Unified telemetry: timeline spans, Perfetto export, MFU profiling.
+
+Before this package the repo's observability was fragmented — scalar
+fan-out in ``monitor/monitor.py``, request spans in
+``serving/frontend/tracing.py``, retrace accounting in
+``analysis/auditor.py``, and an unwired ``profiling/flops_profiler.py``.
+This package is the one runtime they all feed:
+
+* :mod:`.core` — a process-wide, thread-safe, lock-light
+  :class:`TelemetryRuntime`: ``span(name, **attrs)`` context managers
+  (optionally ``sync=``-honest, same contract as ``utils/timer.py``),
+  instant events, counters and gauges, recorded into a bounded ring
+  buffer. Disabled telemetry is a single flag check — the hot paths stay
+  instrumented permanently.
+* :mod:`.export` — Chrome-trace/Perfetto JSON: one thread lane per
+  emitting thread, spans + instants + counter tracks, plus the bridge
+  that renders the serving frontend's per-request ``TraceLog`` records
+  as request lanes with flow arrows in the SAME file.
+* :mod:`.summary` — per-span count/total/p50/p95/p99 (reusing the
+  serving ``Reservoir``) and counter totals; feeds the existing
+  ``MonitorMaster`` fan-out and the ``BENCH_*.json`` phase breakdowns.
+* :mod:`.mfu` — compile-time FLOPs via
+  ``jitted.lower(...).compile().cost_analysis()`` and model-FLOPs-
+  utilization reports (powers ``profiling/flops_profiler.py``).
+* :mod:`.cli` — ``bin/tputrace``: summarize/validate a captured trace
+  (stdlib-only; never imports JAX).
+
+Module-level helpers (``span`` / ``instant`` / ``count`` / ``gauge``)
+write to one process-wide default runtime so instrumentation sites never
+thread a handle around; ``enable()`` / ``disable()`` flip capture.
+
+This module imports no JAX — ``bin/tputrace`` and ``bin/tracelint``
+stay in the millisecond range. See docs/observability.md.
+"""
+
+from .core import (NOOP_SPAN, TelemetryRuntime, configure,  # noqa: F401
+                   count, disable, enable, gauge, get_runtime, instant,
+                   span)
+from .export import (chrome_trace, request_trace_events,  # noqa: F401
+                     write_chrome_trace)
+from .summary import (emit_summary, phase_breakdown,  # noqa: F401
+                      summarize)
+from .mfu import (compiled_cost_analysis, mfu_report,  # noqa: F401
+                  peak_flops_per_device)
+
+__all__ = [
+    "TelemetryRuntime", "get_runtime", "configure", "enable", "disable",
+    "span", "instant", "count", "gauge", "NOOP_SPAN",
+    "chrome_trace", "write_chrome_trace", "request_trace_events",
+    "summarize", "phase_breakdown", "emit_summary",
+    "compiled_cost_analysis", "mfu_report", "peak_flops_per_device",
+]

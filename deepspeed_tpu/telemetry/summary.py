@@ -1,0 +1,99 @@
+"""Aggregated telemetry summaries.
+
+Two consumers, one representation:
+
+* bench harnesses (``serving_bench.py`` / ``frontend_bench.py``) embed
+  :func:`summarize` / :func:`phase_breakdown` output in
+  ``BENCH_*.json`` so phase timings regress alongside throughput;
+* :func:`emit_summary` flattens the same numbers into
+  ``MonitorMaster.write_events`` triples so existing CSV/TensorBoard/
+  wandb fan-out picks them up with zero new writer code.
+
+``phase_breakdown`` works on *deltas* between two ``span_stats()``
+snapshots: aggregates are cumulative (they fold at record time and
+survive ring eviction), so the stats attributable to a timed region are
+``after - before`` for count/total, with the percentiles taken from the
+final reservoir (reservoirs cannot be subtracted; documented in the
+output as ``p*_s_cumulative``).
+
+Stdlib-only — imported by ``bin/tputrace``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+def summarize(runtime) -> Dict[str, Any]:
+    """One JSON-ready dict for the whole runtime: per-span stats,
+    counter totals, gauge levels, instant counts, ring health."""
+    return {
+        "spans": runtime.span_stats(),
+        "counters": runtime.counter_totals(),
+        "gauges": runtime.gauge_values(),
+        "instants": runtime.instant_counts(),
+        "ring": {
+            "capacity": runtime.capacity,
+            "recorded": len(runtime.events()),
+            "dropped": runtime.n_dropped,
+        },
+    }
+
+
+def phase_breakdown(before: Dict[str, Dict[str, float]],
+                    after: Dict[str, Dict[str, float]],
+                    *, wall_s: Optional[float] = None) -> Dict[str, Any]:
+    """Per-span stats attributable to the window between two
+    ``span_stats()`` snapshots (e.g. the timed pass of a bench run,
+    excluding warmup). Returns, per span name::
+
+        {count, total_s, mean_s, share_of_wall,
+         p50_s_cumulative, p95_s_cumulative, p99_s_cumulative}
+
+    ``share_of_wall`` is ``total_s / wall_s`` when ``wall_s`` is given
+    (spans may overlap or nest, so shares need not sum to 1)."""
+    out: Dict[str, Any] = {}
+    for name, a in after.items():
+        b = before.get(name, {"count": 0, "total_s": 0.0})
+        count = a["count"] - b["count"]
+        if count <= 0:
+            continue
+        total = a["total_s"] - b["total_s"]
+        entry = {
+            "count": count,
+            "total_s": total,
+            "mean_s": total / count,
+            "p50_s_cumulative": a["p50_s"],
+            "p95_s_cumulative": a["p95_s"],
+            "p99_s_cumulative": a["p99_s"],
+        }
+        if wall_s:
+            entry["share_of_wall"] = total / wall_s
+        out[name] = entry
+    return out
+
+
+def _flatten(summary: Dict[str, Any], prefix: str) -> Dict[str, float]:
+    flat: Dict[str, float] = {}
+    for name, st in summary.get("spans", {}).items():
+        for k in ("count", "total_s", "mean_s", "p50_s", "p95_s", "p99_s"):
+            flat[f"{prefix}/span/{name}/{k}"] = float(st[k])
+    for name, v in summary.get("counters", {}).items():
+        flat[f"{prefix}/counter/{name}"] = float(v)
+    for name, v in summary.get("gauges", {}).items():
+        flat[f"{prefix}/gauge/{name}"] = float(v)
+    for name, v in summary.get("instants", {}).items():
+        flat[f"{prefix}/instant/{name}"] = float(v)
+    return flat
+
+
+def emit_summary(monitor, runtime, *, sample: int = 0,
+                 prefix: str = "telemetry") -> Dict[str, float]:
+    """Fan the summary out through a ``MonitorMaster`` (or anything with
+    ``write_events([(label, value, sample), ...])``). Returns the flat
+    label->value mapping that was written."""
+    flat = _flatten(summarize(runtime), prefix)
+    if flat and monitor is not None:
+        monitor.write_events([(k, v, sample) for k, v in
+                              sorted(flat.items())])
+    return flat

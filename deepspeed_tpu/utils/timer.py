@@ -4,21 +4,27 @@ ThroughputTimer). CUDA-event timing becomes ``jax.block_until_ready`` around
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import jax
 
 from .logging import log_dist
 
+#: A serving run records one value per step forever; keep the rolling
+#: window bounded (mean() becomes a moving average over the last N).
+MAX_TIMER_RECORDS = 4096
+
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, max_records: int = MAX_TIMER_RECORDS):
         self.name = name
         self.started = False
         self._start = 0.0
         self._elapsed = 0.0
-        self._records: List[float] = []
+        self._records: deque = deque(maxlen=max_records)
 
     def start(self):
         assert not self.started, f"timer {self.name} already started"
@@ -55,11 +61,20 @@ class _Timer:
 class SynchronizedWallClockTimer:
     def __init__(self):
         self.timers: Dict[str, _Timer] = {}
+        # guards timer creation: the engine-driver thread and caller
+        # threads (serving frontend) share one registry, and the
+        # unlocked check-then-insert could hand two threads different
+        # _Timer objects for the same name (one silently dropped)
+        self._lock = threading.Lock()
 
     def __call__(self, name: str) -> _Timer:
-        if name not in self.timers:
-            self.timers[name] = _Timer(name)
-        return self.timers[name]
+        timer = self.timers.get(name)
+        if timer is None:
+            with self._lock:
+                timer = self.timers.get(name)
+                if timer is None:
+                    timer = self.timers[name] = _Timer(name)
+        return timer
 
     def has_timer(self, name) -> bool:
         return name in self.timers

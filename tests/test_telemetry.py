@@ -1,0 +1,732 @@
+"""Telemetry subsystem tests (telemetry/): ring semantics, lock
+discipline under concurrent emit, Chrome-trace golden shape, the
+TraceLog bridge, MFU estimation, and the self-overhead gate.
+
+Most tests build a private ``TelemetryRuntime`` (often with an injected
+fake clock) so nothing leaks through the process-wide default; the two
+tests that exercise the module-level helpers / auditor hook snapshot and
+restore the default runtime's state.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.telemetry import core as tel
+from deepspeed_tpu.telemetry.cli import (main as tputrace_main,
+                                         summarize_trace, validate_trace)
+from deepspeed_tpu.telemetry.export import (PID_REQUESTS, PID_RUNTIME,
+                                            chrome_trace,
+                                            request_trace_events,
+                                            runtime_events)
+from deepspeed_tpu.telemetry.mfu import (compiled_cost_analysis,
+                                         mfu_report,
+                                         peak_flops_per_device)
+from deepspeed_tpu.telemetry.summary import (emit_summary,
+                                             phase_breakdown, summarize)
+
+pytestmark = pytest.mark.telemetry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def default_runtime():
+    """The process-wide runtime, enabled and clean; restored after."""
+    rt = tel.get_runtime()
+    was_enabled = rt.enabled
+    rt.clear()
+    rt.enable()
+    yield rt
+    rt.clear()
+    rt.enabled = was_enabled
+
+
+# ------------------------------------------------------------------ ring
+class TestRing:
+    def test_ring_bounds_and_eviction(self):
+        rt = tel.TelemetryRuntime(capacity=8, enabled=True)
+        for i in range(20):
+            rt.count("c", 1.0)
+        events = rt.events()
+        assert len(events) == 8                  # bounded
+        assert rt.n_dropped == 12                # eviction counted
+        # oldest got evicted: the surviving samples are the last 8
+        assert [ev[3] for ev in events] == [float(v) for v in
+                                            range(13, 21)]
+        # the aggregate keeps folding past eviction
+        assert rt.counter_totals()["c"] == 20.0
+
+    def test_span_aggregates_survive_eviction(self):
+        clock = FakeClock()
+        rt = tel.TelemetryRuntime(capacity=4, enabled=True, clock=clock)
+        for _ in range(10):
+            with rt.span("phase"):
+                clock.advance(0.5)
+        assert len(rt.events()) == 4
+        stats = rt.span_stats()["phase"]
+        assert stats["count"] == 10              # not 4
+        assert stats["total_s"] == pytest.approx(5.0)
+        assert stats["mean_s"] == pytest.approx(0.5)
+        assert stats["p50_s"] == pytest.approx(0.5)
+
+    def test_clear_resets_everything(self):
+        rt = tel.TelemetryRuntime(capacity=4, enabled=True)
+        with rt.span("s"):
+            pass
+        rt.instant("i")
+        rt.count("c")
+        rt.gauge("g", 3.0)
+        for _ in range(10):
+            rt.count("spill")
+        rt.clear()
+        assert rt.events() == []
+        assert rt.span_stats() == {}
+        assert rt.counter_totals() == {}
+        assert rt.gauge_values() == {}
+        assert rt.instant_counts() == {}
+        assert rt.n_dropped == 0
+
+    def test_gauge_records_level_not_cumsum(self):
+        rt = tel.TelemetryRuntime(enabled=True)
+        rt.gauge("depth", 5.0)
+        rt.gauge("depth", 2.0)
+        assert rt.gauge_values()["depth"] == 2.0
+        assert [ev[3] for ev in rt.events()] == [5.0, 2.0]
+
+    def test_configure_resizes_default_ring(self, default_runtime):
+        orig = default_runtime.capacity
+        try:
+            tel.configure(capacity=4)
+            for _ in range(6):
+                tel.count("x")
+            assert len(default_runtime.events()) == 4
+        finally:
+            tel.configure(capacity=orig)
+
+
+# --------------------------------------------------------- disabled path
+class TestDisabledPath:
+    def test_disabled_span_is_shared_noop(self):
+        rt = tel.TelemetryRuntime(enabled=False)
+        s1 = rt.span("a", big="attr")
+        s2 = rt.span("b")
+        assert s1 is tel.NOOP_SPAN and s2 is tel.NOOP_SPAN
+        with s1:
+            pass
+        assert rt.events() == [] and rt.span_stats() == {}
+
+    def test_disabled_records_nothing(self):
+        rt = tel.TelemetryRuntime(enabled=False)
+        rt.instant("i")
+        rt.count("c")
+        rt.gauge("g", 1.0)
+        assert rt.events() == []
+        assert rt.counter_totals() == {}
+
+    def test_module_helpers_follow_default_enabled_flag(
+            self, default_runtime):
+        default_runtime.disable()
+        assert tel.span("x") is tel.NOOP_SPAN
+        tel.count("c")
+        assert default_runtime.events() == []
+        default_runtime.enable()
+        with tel.span("x"):
+            pass
+        tel.count("c")
+        assert default_runtime.span_stats()["x"]["count"] == 1
+        assert default_runtime.counter_totals()["c"] == 1.0
+
+
+# ------------------------------------------------------------ concurrency
+class TestConcurrentEmit:
+    N_THREADS = 6
+    PER_THREAD = 200
+
+    def test_concurrent_emit_no_torn_events(self):
+        """>= 4 threads hammer every record type; every ring entry must
+        still be a well-formed tuple and the aggregates must account for
+        every event exactly once."""
+        rt = tel.TelemetryRuntime(capacity=1 << 16, enabled=True)
+        barrier = threading.Barrier(self.N_THREADS)
+        errors = []
+
+        def worker(k):
+            try:
+                barrier.wait()
+                for i in range(self.PER_THREAD):
+                    with rt.span(f"t{k}/span", i=i):
+                        pass
+                    rt.count("shared", 1.0)
+                    rt.instant(f"t{k}/tick")
+                    rt.gauge(f"t{k}/level", float(i))
+            except Exception as exc:            # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(k,),
+                                    name=f"emit-{k}")
+                   for k in range(self.N_THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+        arity = {"X": 6, "i": 5, "C": 4}
+        events = rt.events()
+        assert len(events) == self.N_THREADS * self.PER_THREAD * 4
+        for ev in events:
+            assert len(ev) == arity[ev[0]]       # no torn tuples
+        assert rt.counter_totals()["shared"] == \
+            self.N_THREADS * self.PER_THREAD
+        for k in range(self.N_THREADS):
+            assert rt.span_stats()[f"t{k}/span"]["count"] == \
+                self.PER_THREAD
+            assert rt.instant_counts()[f"t{k}/tick"] == self.PER_THREAD
+        # each emitting thread got a lane name for the exporter
+        assert len(rt.thread_names()) >= self.N_THREADS
+
+    def test_trace_from_threads_validates(self):
+        rt = tel.TelemetryRuntime(enabled=True)
+
+        def worker():
+            for _ in range(50):
+                with rt.span("w"):
+                    pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert validate_trace(chrome_trace(rt)) == []
+
+
+# ------------------------------------------------- utils/timer satellites
+class TestTimerThreadSafety:
+    def test_concurrent_creation_single_instance(self):
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        timers = SynchronizedWallClockTimer()
+        names = [f"n{i}" for i in range(8)]
+        seen = [dict() for _ in range(12)]
+        barrier = threading.Barrier(12)
+
+        def worker(out):
+            barrier.wait()
+            for _ in range(40):
+                for name in names:
+                    out[name] = id(timers(name))
+
+        threads = [threading.Thread(target=worker, args=(seen[j],))
+                   for j in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for name in names:
+            # every thread must have resolved the SAME _Timer object —
+            # the pre-lock check-then-insert could hand out two
+            assert len({s[name] for s in seen}) == 1
+
+    def test_records_bounded(self):
+        from deepspeed_tpu.utils.timer import _Timer
+        timer = _Timer("t", max_records=4)
+        for _ in range(10):
+            timer.start()
+            timer.stop(record=True)
+        assert len(timer._records) == 4          # deque(maxlen) bound
+        assert timer.mean() >= 0.0
+
+    def test_default_bound_applied(self):
+        from deepspeed_tpu.utils.timer import (MAX_TIMER_RECORDS,
+                                               SynchronizedWallClockTimer)
+        t = SynchronizedWallClockTimer()("x")
+        assert t._records.maxlen == MAX_TIMER_RECORDS
+
+
+# ------------------------------------------- monitor CsvWriter satellite
+class TestCsvLabelCollision:
+    def _writer(self, tmp_path):
+        from types import SimpleNamespace
+        from deepspeed_tpu.monitor.monitor import CsvWriter
+        return CsvWriter(SimpleNamespace(output_path=str(tmp_path),
+                                         job_name="job"))
+
+    def test_colliding_labels_get_distinct_files(self, tmp_path):
+        """Regression: 'a/b' and 'a_b' both sanitize to 'a_b.csv' and
+        used to interleave into one file."""
+        w = self._writer(tmp_path)
+        w.write_events([("a/b", 1.0, 0), ("a_b", 2.0, 0),
+                        ("a/b", 3.0, 1)])
+        w.close()
+        csvs = sorted(p.name for p in
+                      (tmp_path / "job").glob("*.csv"))
+        assert len(csvs) == 2                    # not silently merged
+        assert "a_b.csv" in csvs                 # first claimant keeps it
+        by_header = {}
+        for p in (tmp_path / "job").glob("*.csv"):
+            rows = p.read_text().strip().splitlines()
+            by_header[rows[0].split(",")[1]] = rows[1:]
+        assert by_header["a/b"] == ["0,1.0", "1,3.0"]
+        assert by_header["a_b"] == ["0,2.0"]
+
+    def test_non_colliding_labels_unchanged(self, tmp_path):
+        w = self._writer(tmp_path)
+        w.write_events([("loss", 0.5, 0), ("serve/ttft", 0.1, 0)])
+        w.close()
+        names = sorted(p.name for p in (tmp_path / "job").glob("*.csv"))
+        assert names == ["loss.csv", "serve_ttft.csv"]
+
+    def test_suffix_stable_across_writers(self, tmp_path):
+        # reopening must map the colliding label to the SAME suffixed
+        # file (crc32 of the label, not insertion order)
+        w = self._writer(tmp_path)
+        w.write_events([("a/b", 1.0, 0), ("a_b", 2.0, 0)])
+        w.close()
+        w2 = self._writer(tmp_path)
+        w2.write_events([("a/b", 3.0, 1), ("a_b", 4.0, 1)])
+        w2.close()
+        assert len(list((tmp_path / "job").glob("*.csv"))) == 2
+
+
+# ------------------------------------------------- chrome export (golden)
+def _populated_runtime():
+    clock = FakeClock(100.0)
+    rt = tel.TelemetryRuntime(enabled=True, clock=clock)
+    with rt.span("serve/prefill", n=2, bucket=16):
+        clock.advance(0.010)
+    rt.instant("serve/prefill_compile", bucket=16)
+    rt.count("serve/decode_tokens", 4.0)
+    clock.advance(0.001)
+    with rt.span("serve/chunk_retire"):
+        clock.advance(0.002)
+    rt.gauge("serve/queue_depth", 3.0)
+    return rt
+
+
+class TestChromeTraceGoldenShape:
+    def test_required_keys_and_json_round_trip(self):
+        obj = json.loads(json.dumps(chrome_trace(_populated_runtime())))
+        events = obj["traceEvents"]
+        assert obj["displayTimeUnit"] == "ms"
+        phases = {e["ph"] for e in events}
+        assert {"X", "i", "C", "M"} <= phases
+        for ev in events:
+            assert "ph" in ev and "name" in ev
+            if ev["ph"] == "M":
+                continue
+            for key in ("ts", "pid", "tid"):
+                assert isinstance(ev[key], (int, float)), (key, ev)
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0.0
+
+    def test_metadata_first_then_ts_sorted(self):
+        events = chrome_trace(_populated_runtime())["traceEvents"]
+        kinds = [e["ph"] for e in events]
+        first_data = kinds.index(next(k for k in kinds if k != "M"))
+        assert all(k == "M" for k in kinds[:first_data])
+        ts = [e["ts"] for e in events[first_data:]]
+        assert ts == sorted(ts)                  # monotone per file,
+        # hence monotone per (pid, tid) lane — what validate checks
+        assert validate_trace({"traceEvents": events}) == []
+
+    def test_span_payload(self):
+        events = runtime_events(_populated_runtime())
+        prefill = next(e for e in events
+                       if e.get("name") == "serve/prefill")
+        assert prefill["ph"] == "X"
+        assert prefill["pid"] == PID_RUNTIME
+        assert prefill["ts"] == pytest.approx(100.0 * 1e6)
+        assert prefill["dur"] == pytest.approx(0.010 * 1e6)
+        assert prefill["args"] == {"n": 2, "bucket": 16}
+        counter = next(e for e in events if e["ph"] == "C")
+        assert counter["args"] == {"serve/decode_tokens": 4.0}
+
+    def test_validate_catches_malformed_traces(self):
+        assert validate_trace([]) != []          # wrong top level
+        bad_cases = [
+            {"name": "x"},                                   # no ph
+            {"ph": "X", "name": "x", "ts": 1.0, "pid": 1,
+             "tid": 1},                                      # X w/o dur
+            {"ph": "X", "name": "x", "ts": -5.0, "dur": 1.0,
+             "pid": 1, "tid": 1},                            # negative ts
+            {"ph": "i", "name": "x", "pid": 1, "tid": 1},    # no ts
+        ]
+        for ev in bad_cases:
+            assert validate_trace({"traceEvents": [ev]}) != [], ev
+        # out-of-order within one lane
+        lane = [{"ph": "i", "s": "t", "name": "a", "ts": 5.0,
+                 "pid": 1, "tid": 1},
+                {"ph": "i", "s": "t", "name": "b", "ts": 1.0,
+                 "pid": 1, "tid": 1}]
+        assert any("monotone" in p for p in
+                   validate_trace({"traceEvents": lane}))
+        # ...but different lanes are independent
+        lane[1]["tid"] = 2
+        assert validate_trace({"traceEvents": lane}) == []
+
+    def test_summarize_trace_tables(self):
+        s = summarize_trace(chrome_trace(_populated_runtime()))
+        assert s["spans"]["serve/prefill"]["count"] == 1
+        assert s["counters"]["serve/decode_tokens"] == 4.0
+        assert s["counters"]["serve/queue_depth"] == 3.0
+        assert s["instants"]["serve/prefill_compile"] == 1
+        # prefill_compile matches the retrace/compile filter
+        assert any(r["name"] == "serve/prefill_compile"
+                   for r in s["retraces"])
+        assert s["wall_us"] == pytest.approx(13e3, rel=1e-3)
+
+
+# ------------------------------------------------- TraceLog bridge
+def _traced_request_log():
+    from deepspeed_tpu.serving.frontend.tracing import TraceLog
+    clock = FakeClock(50.0)
+    log = TraceLog(clock=clock)
+    log.start(7, tenant="acme", prompt_len=5, max_new_tokens=8)
+    log.mark(7, "submitted")
+    clock.advance(0.002)
+    log.mark(7, "prefill")
+    clock.advance(0.003)
+    log.chunk(7, 4)                              # stamps first_token
+    clock.advance(0.004)
+    log.chunk(7, 4)
+    log.finish(7, "completed")
+    return log
+
+
+class TestRequestTraceBridge:
+    def test_request_lane_spans_flows_chunks(self):
+        events = request_trace_events(_traced_request_log().to_json())
+        by_ph = {}
+        for e in events:
+            by_ph.setdefault(e["ph"], []).append(e)
+        whole = next(e for e in by_ph["X"]
+                     if e["name"] == "request:completed")
+        assert whole["pid"] == PID_REQUESTS and whole["tid"] == 7
+        assert whole["dur"] == pytest.approx(0.009 * 1e6)
+        assert whole["args"]["n_tokens"] == 8
+        names = {e["name"] for e in by_ph["X"]}
+        assert {"queue_wait", "prefill_to_first_token",
+                "stream"} <= names
+        # flow arrows: s/f pair keyed by the uid
+        assert [e["id"] for e in by_ph["s"]] == [7]
+        assert [e["id"] for e in by_ph["f"]] == [7]
+        assert len([e for e in by_ph["i"]
+                    if e["name"].startswith("chunk(")]) == 2
+
+    def test_export_chrome_merges_both_pids(self, tmp_path):
+        log = _traced_request_log()
+        path = tmp_path / "merged.json"
+        obj = log.export_chrome(str(path), runtime=_populated_runtime())
+        on_disk = json.loads(path.read_text())
+        assert on_disk == obj
+        pids = {e.get("pid") for e in obj["traceEvents"]}
+        assert {PID_RUNTIME, PID_REQUESTS} <= pids
+        assert validate_trace(obj) == []
+
+    def test_rejected_request_renders(self):
+        from deepspeed_tpu.serving.frontend.tracing import TraceLog
+        log = TraceLog(clock=FakeClock(1.0))
+        log.record_rejected(3, "queue_full", tenant="t")
+        events = request_trace_events(log.to_json())
+        span = next(e for e in events if e["ph"] == "X")
+        assert span["name"] == "request:rejected"
+        assert span["args"]["reject_reason"] == "queue_full"
+
+
+# ----------------------------------------------------------- cli
+class TestTputraceCli:
+    def test_validate_ok_and_malformed(self, tmp_path, capsys):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(chrome_trace(_populated_runtime())))
+        assert tputrace_main(["validate", str(good)]) == 0
+        assert "OK" in capsys.readouterr().out
+
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"traceEvents": [
+            {"ph": "X", "name": "x", "ts": 1.0, "pid": 1, "tid": 1}]}))
+        assert tputrace_main(["validate", str(bad)]) == 1
+        assert "INVALID" in capsys.readouterr().err
+
+    def test_validate_unreadable_file(self, tmp_path, capsys):
+        broken = tmp_path / "broken.json"
+        broken.write_text("{not json")
+        assert tputrace_main(["validate", str(broken)]) == 1
+        assert "cannot read" in capsys.readouterr().err
+
+    def test_summary_prints_tables(self, tmp_path, capsys):
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps(chrome_trace(_populated_runtime())))
+        assert tputrace_main(["summary", str(p), "--top", "5"]) == 0
+        out = capsys.readouterr().out
+        assert "serve/prefill" in out
+        assert "serve/decode_tokens" in out
+
+    def test_convert_tracelog_dump(self, tmp_path, capsys):
+        src = tmp_path / "tracelog.json"
+        _traced_request_log().dump(str(src))
+        out = tmp_path / "trace.json"
+        assert tputrace_main(["convert", str(src), "-o",
+                              str(out)]) == 0
+        obj = json.loads(out.read_text())
+        assert validate_trace(obj) == []
+        assert any(e.get("name") == "request:completed"
+                   for e in obj["traceEvents"])
+
+
+# ----------------------------------------------------------- summaries
+class TestSummaries:
+    def test_summarize_shape(self):
+        rt = _populated_runtime()
+        s = summarize(rt)
+        assert s["spans"]["serve/prefill"]["count"] == 1
+        assert s["counters"] == {"serve/decode_tokens": 4.0}
+        assert s["gauges"] == {"serve/queue_depth": 3.0}
+        assert s["instants"] == {"serve/prefill_compile": 1}
+        assert s["ring"]["dropped"] == 0
+        assert s["ring"]["recorded"] == len(rt.events())
+
+    def test_phase_breakdown_is_delta_based(self):
+        clock = FakeClock()
+        rt = tel.TelemetryRuntime(enabled=True, clock=clock)
+        with rt.span("warmup_only"):
+            clock.advance(1.0)
+        with rt.span("decode"):
+            clock.advance(1.0)
+        before = rt.span_stats()
+        for _ in range(3):
+            with rt.span("decode"):
+                clock.advance(2.0)
+        phases = phase_breakdown(before, rt.span_stats(), wall_s=12.0)
+        assert "warmup_only" not in phases       # no delta -> excluded
+        d = phases["decode"]
+        assert d["count"] == 3                   # warmup call excluded
+        assert d["total_s"] == pytest.approx(6.0)
+        assert d["mean_s"] == pytest.approx(2.0)
+        assert d["share_of_wall"] == pytest.approx(0.5)
+        assert "p95_s_cumulative" in d           # reservoirs don't subtract
+
+    def test_emit_summary_monitor_fanout(self):
+        class FakeMonitor:
+            def __init__(self):
+                self.events = []
+
+            def write_events(self, evs):
+                self.events.extend(evs)
+
+        mon = FakeMonitor()
+        flat = emit_summary(mon, _populated_runtime(), sample=7)
+        labels = {label for label, _, _ in mon.events}
+        assert ("telemetry/span/serve/prefill/count", 1.0, 7) in \
+            mon.events
+        assert "telemetry/counter/serve/decode_tokens" in labels
+        assert "telemetry/gauge/serve/queue_depth" in labels
+        assert "telemetry/instant/serve/prefill_compile" in labels
+        assert flat["telemetry/span/serve/prefill/total_s"] == \
+            pytest.approx(0.010)
+
+
+# ----------------------------------------------------------- mfu
+class TestMfu:
+    def test_mfu_report_math(self):
+        rep = mfu_report(flops_per_call=1e12, calls=10, wall_s=2.0,
+                         n_devices=2, peak_flops=5e12, label="x")
+        assert rep["achieved_flops_per_s"] == pytest.approx(5e12)
+        assert rep["achieved_tflops_per_s"] == pytest.approx(5.0)
+        assert rep["mfu"] == pytest.approx(0.5)
+
+    def test_mfu_none_when_peak_unknown(self):
+        rep = mfu_report(flops_per_call=1e12, calls=1, wall_s=1.0,
+                         peak_flops=None)
+        assert rep["achieved_flops_per_s"] == pytest.approx(1e12)
+        assert rep["mfu"] is None
+
+    def test_mfu_none_when_flops_unknown(self):
+        rep = mfu_report(flops_per_call=None, calls=5, wall_s=1.0,
+                         peak_flops=1e12)
+        assert rep["achieved_flops_per_s"] is None
+        assert rep["mfu"] is None
+
+    def test_peak_env_override(self, monkeypatch):
+        from deepspeed_tpu.telemetry.mfu import PEAK_FLOPS_ENV
+        monkeypatch.setenv(PEAK_FLOPS_ENV, "123e9")
+        assert peak_flops_per_device() == pytest.approx(123e9)
+
+    def test_peak_unknown_on_cpu(self, monkeypatch):
+        from deepspeed_tpu.telemetry.mfu import PEAK_FLOPS_ENV
+        monkeypatch.delenv(PEAK_FLOPS_ENV, raising=False)
+        assert peak_flops_per_device() is None   # tests run on CPU
+
+    def test_peak_table_lookup(self, monkeypatch):
+        from types import SimpleNamespace
+        from deepspeed_tpu.telemetry.mfu import PEAK_FLOPS_ENV
+        monkeypatch.delenv(PEAK_FLOPS_ENV, raising=False)
+        dev = SimpleNamespace(device_kind="TPU v5e", platform="tpu")
+        assert peak_flops_per_device(dev) == pytest.approx(197e12)
+        dev = SimpleNamespace(device_kind="TPU v6 lite", platform="tpu")
+        assert peak_flops_per_device(dev) == pytest.approx(918e12)
+
+    def test_cost_analysis_tiny_gpt_sanity(self):
+        """XLA cost analysis on the tiny GPT must report flops on CPU,
+        scale ~linearly with batch, and exceed the analytic matmul
+        floor — the MFU numerator is real work, not a placeholder."""
+        import jax
+        import numpy as np
+        from test_serving import _tiny
+
+        model, params = _tiny()
+        seq = 8
+
+        def forward(p, tokens):
+            return model.apply({"params": p}, tokens)
+
+        def cost(batch):
+            tokens = jax.ShapeDtypeStruct((batch, seq), np.int32)
+            return compiled_cost_analysis(forward, params, tokens)
+
+        c1, c2 = cost(1), cost(2)
+        assert c1 is not None and c1["flops"] > 0
+        # analytic floor: the two attention-projection + MLP matmuls of
+        # one token, times tokens (2 * d_model * d_ff * seq alone)
+        assert c1["flops"] > 2 * 32 * 64 * seq
+        assert 1.5 < c2["flops"] / c1["flops"] < 3.0
+
+    def test_cost_analysis_accepts_prejitted(self):
+        import jax
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda a, b: a @ b)
+        x = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+        c = compiled_cost_analysis(f, x, x)
+        assert c is not None
+        # 16^3 multiply-adds = 2*16^3 flops, allow backend fusion slack
+        assert c["flops"] >= 16 ** 3
+
+    def test_cost_analysis_unreportable_returns_none(self):
+        # a function XLA cannot lower must yield None, not raise
+        assert compiled_cost_analysis(
+            lambda x: open(x), "not-an-array") is None
+
+
+# ------------------------------------------- auditor retrace instants
+class TestAuditorRetraceInstants:
+    def test_retraces_become_instants_and_counters(self, default_runtime):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.analysis.auditor import TraceAuditor
+
+        with TraceAuditor(fail_on_exit=False):
+            f = jax.jit(lambda x: x + 1)
+            f(jnp.zeros((2,)))
+            f(jnp.zeros((3,)))                   # shape change -> retrace
+        counts = default_runtime.instant_counts()
+        assert counts.get("tracelint/retrace", 0) >= 2
+        assert default_runtime.counter_totals()["tracelint/compiles"] \
+            >= 2.0
+        ev = next(e for e in default_runtime.events()
+                  if e[0] == "i" and e[1] == "tracelint/retrace")
+        assert "signature" in ev[4] and "compiles" in ev[4]
+
+    def test_auditor_silent_when_disabled(self):
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.analysis.auditor import TraceAuditor
+
+        rt = tel.get_runtime()
+        was_enabled = rt.enabled
+        rt.disable()
+        try:
+            before = len(rt.events())
+            with TraceAuditor(fail_on_exit=False):
+                jax.jit(lambda x: x * 2)(jnp.zeros((2,)))
+            assert len(rt.events()) == before
+        finally:
+            rt.enabled = was_enabled
+
+
+# ------------------------------------------------------ overhead gate
+class TestOverheadGate:
+    def test_disabled_span_overhead_on_dispatch_bound_loop(self):
+        """ISSUE budget: permanently-instrumented hot paths must cost
+        ~nothing while telemetry is off — <= ~1% of a dispatch-bound
+        loop iteration. Subtracting two jitted-loop timings is too
+        noisy for CI (GC/scheduler jitter swamps a sub-us delta), so
+        the gate measures the two sides separately, each stably:
+
+        * disabled-span cost = min-of-5 pure-Python micro-loop, bare
+          loop subtracted, GC off (measured ~0.2 us);
+        * iteration cost = min-of-5 over a loop dispatching a jitted
+          few-matmul program sized like a decode-chunk step
+          (~50-100 us/iter on the CPU backend).
+
+        Gate: span cost < 1% of the iteration AND < 1.5 us absolute."""
+        import gc
+
+        import jax
+        import jax.numpy as jnp
+
+        rt = tel.get_runtime()
+        was_enabled = rt.enabled
+        rt.disable()
+        n_before = len(rt.events())
+
+        def matwork(x):
+            for _ in range(2):
+                x = jnp.maximum(x @ x, 0.0) + 1e-3
+            return x
+
+        f = jax.jit(matwork)
+        x = jnp.eye(128) * 0.5
+        f(x).block_until_ready()                 # compile outside timing
+
+        n, m = 100, 20000
+
+        def dispatch_loop():
+            y = x
+            for _ in range(n):
+                y = f(y)
+            y.block_until_ready()
+
+        def span_loop():
+            for _ in range(m):
+                with tel.span("gate/step"):
+                    pass
+
+        def bare_loop():
+            for _ in range(m):
+                pass
+
+        def best(fn, iters):
+            times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                fn()
+                times.append(time.perf_counter() - t0)
+            return min(times) / iters
+
+        gc.disable()
+        try:
+            per_iter = best(dispatch_loop, n)
+            span_cost = max(best(span_loop, m) - best(bare_loop, m),
+                            0.0)
+        finally:
+            gc.enable()
+            rt.enabled = was_enabled
+
+        ratio = span_cost / per_iter
+        assert span_cost < 1.5e-6 and ratio < 0.01, (
+            f"disabled-telemetry span costs {span_cost * 1e9:.0f} ns = "
+            f"{ratio * 100:.2f}% of a {per_iter * 1e6:.0f} us "
+            f"dispatch-bound iteration (budget: <1.5 us and <1%)")
+        assert len(rt.events()) == n_before      # recorded nothing
