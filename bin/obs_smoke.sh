@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Observability smoke: the CI gate for the memory/fleet-health stack.
+#
+#   1. benchdiff self-diff — each committed BENCH_*.json baseline diffed
+#      against itself must pass (exit 0): proves the sentry parses the
+#      real documents and every watched path resolves;
+#   2. seeded synthetic regression — a baseline with the headline
+#      throughput cut in half MUST make benchdiff exit nonzero: proves
+#      the gate actually fires (a sentry that can't fail is decoration);
+#   3. live /metrics scrape — a short frontend_bench run self-scrapes
+#      its own metrics server (TTFT quantiles + arena-headroom gauge
+#      parsed out of real Prometheus text) and asserts /readyz answers
+#      200 while serving. frontend_bench raises on a failed scrape, so
+#      this doubles as the exposition integration test.
+#
+# Usage: bin/obs_smoke.sh    (from the repo root, or anywhere)
+
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+fail=0
+
+# ---- 1. committed baselines must self-diff clean -----------------------
+for bench in BENCH_serving.json BENCH_frontend.json; do
+    if [ ! -f "$bench" ]; then
+        echo "obs_smoke: MISSING baseline $bench" >&2
+        fail=1
+        continue
+    fi
+    if python bin/benchdiff "$bench" "$bench" --fail-on-missing --quiet;
+    then
+        echo "obs_smoke: benchdiff self-diff ok: $bench"
+    else
+        echo "obs_smoke: FAIL benchdiff self-diff: $bench" >&2
+        fail=1
+    fi
+done
+
+# ---- 2. a seeded regression must trip the gate -------------------------
+seeded="$(mktemp /tmp/obs_smoke_seeded.XXXXXX.json)"
+trap 'rm -f "$seeded"' EXIT
+python - "$seeded" <<'EOF'
+import json, sys
+doc = json.load(open("BENCH_serving.json"))
+doc["chunked_tokens_per_s"] = doc["chunked_tokens_per_s"] / 2.0
+json.dump(doc, open(sys.argv[1], "w"))
+EOF
+if python bin/benchdiff BENCH_serving.json "$seeded" --quiet; then
+    echo "obs_smoke: FAIL seeded regression was NOT detected" >&2
+    fail=1
+else
+    echo "obs_smoke: seeded regression correctly detected (exit 1)"
+fi
+
+# ---- 3. live scrape during a real (short) frontend bench ---------------
+if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    python -m deepspeed_tpu.benchmarks.frontend_bench \
+    --n-requests 16 --overload-factor 4.0 --max-new-tokens 8 \
+    --max-batch 2 --decode-chunk 4 \
+    --json-out /tmp/obs_smoke_frontend.json > /dev/null; then
+    python - <<'EOF'
+import json
+d = json.load(open("/tmp/obs_smoke_frontend.json"))
+s = d["metrics_scrape"]
+assert s["readyz"] == 200, s
+assert s["ttft_quantiles_s"], s
+assert s["arena_headroom_bytes"] >= 0, s
+assert d["hbm"] and d["hbm"]["decode_chunk"]["temp_bytes"] > 0, d["hbm"]
+print("obs_smoke: live /metrics scrape ok "
+      f"({s['n_families']} families, ttft p99="
+      f"{s['ttft_quantiles_s'].get('0.99')}s)")
+EOF
+    [ $? -ne 0 ] && fail=1
+else
+    echo "obs_smoke: FAIL frontend_bench live-scrape run" >&2
+    fail=1
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "obs_smoke: FAILED" >&2
+    exit 1
+fi
+echo "obs_smoke: all gates passed"

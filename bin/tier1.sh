@@ -12,6 +12,15 @@ cd "$(dirname "$0")/.." || exit 1
 # collection spends minutes. See docs/analysis.md.
 python bin/tracelint deepspeed_tpu || exit $?
 
+# benchdiff self-diff on the committed baselines (stdlib-only, <1 s):
+# every watched metric path must resolve in the archived BENCH_*.json —
+# a bench schema drift fails here, not after a full bench round. The
+# full gate (seeded regression + live scrape) is bin/obs_smoke.sh.
+for bench in BENCH_serving.json BENCH_frontend.json; do
+    [ -f "$bench" ] && { python bin/benchdiff "$bench" "$bench" \
+        --fail-on-missing --quiet || exit $?; }
+done
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

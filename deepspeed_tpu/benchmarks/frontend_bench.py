@@ -52,14 +52,19 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
               prompt_len: int = 16, decode_chunk: int = 4,
               high_fraction: float = 0.25, ttft_bound_s: float = 10.0,
               seed: int = 0, model=None, params=None,
-              timeout_s: float = 300.0, trace_out: str = None) -> dict:
+              timeout_s: float = 300.0, trace_out: str = None,
+              metrics_port: int = 0) -> dict:
+    import urllib.request
+
     import jax.numpy as jnp
     import deepspeed_tpu as ds
     from .. import telemetry
+    from ..telemetry.exposition import MetricsServer, parse_prometheus_text
     from ..telemetry.mfu import mfu_report
     from ..telemetry.summary import phase_breakdown
     from ..serving import ServingEngine
-    from ..serving.frontend import (AdmissionConfig, PRIORITY_HIGH,
+    from ..serving.frontend import (AdmissionConfig, BackendWatchdog,
+                                    HealthMonitor, PRIORITY_HIGH,
                                     PRIORITY_LOW, ServingFrontend)
 
     telemetry.enable()
@@ -109,6 +114,18 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         fe_engine,
         admission=AdmissionConfig(max_pending=n_requests + 8),
         trace_keep_last=n_requests + len(prompts) + 8)
+    # /metrics + /healthz + /readyz for the whole serving window: the
+    # acceptance check is a LIVE scrape while the bench is serving, not a
+    # post-hoc render. Watchdog heartbeats are tiny jitted ops on the
+    # same backend the engine uses.
+    watchdog = BackendWatchdog(interval_s=2.0, timeout_s=60.0)
+    watchdog.start()
+    health = HealthMonitor(frontend=frontend, watchdog=watchdog)
+    metrics_server = MetricsServer(
+        runtime=telemetry.get_runtime(), tracelog=frontend.tracing,
+        gauges_fn=lambda: fe_engine.metrics.snapshot(
+            fe_engine.scheduler.queue_depth, fe_engine.kv.occupancy),
+        health=health, port=metrics_port)
     handles = [frontend.submit(p, max_new_tokens=max_new_tokens)
                for p in prompts]
     for h, ref in zip(handles, ref_results):
@@ -156,7 +173,43 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     for h, _ in load_handles:
         h.result(timeout=max(0.1, deadline - time.monotonic()))
     wall_s = time.perf_counter() - t_start
+
+    # ---- live self-scrape: a real HTTP GET against the running server,
+    # parsed by the same golden-format parser the tests use. Must happen
+    # BEFORE frontend.close() — /readyz flips 503 once the driver stops.
+    with urllib.request.urlopen(f"{metrics_server.url}/metrics",
+                                timeout=10) as resp:
+        scrape_text = resp.read().decode("utf-8")
+    parsed = parse_prometheus_text(scrape_text)
+    ttft_family = "dstpu_frontend_ttft_seconds"
+    arena_gauge = "dstpu_serve_arena_headroom_bytes"
+    for required in (ttft_family, arena_gauge):
+        if required not in parsed["samples"]:
+            raise RuntimeError(
+                f"/metrics scrape is missing {required} — the exposition "
+                "wiring regressed")
+    ttft_quantiles = {
+        labels.get("quantile"): v
+        for labels, v in parsed["samples"][ttft_family]
+        if "quantile" in labels}
+    with urllib.request.urlopen(f"{metrics_server.url}/readyz",
+                                timeout=10) as resp:
+        readyz_code = resp.status
+    if readyz_code != 200:
+        raise RuntimeError(f"/readyz answered {readyz_code} while serving")
+    metrics_scrape = {
+        "url": metrics_server.url,
+        "n_families": len(parsed["samples"]),
+        "n_samples": sum(len(v) for v in parsed["samples"].values()),
+        "ttft_quantiles_s": {q: round(v, 4)
+                             for q, v in sorted(ttft_quantiles.items())},
+        "arena_headroom_bytes": parsed["samples"][arena_gauge][0][1],
+        "readyz": readyz_code,
+        "watchdog": watchdog.state(),
+    }
     frontend.close()
+    watchdog.stop()
+    metrics_server.stop()
     # overload-phase-only span breakdown (telemetry aggregate deltas;
     # the engine-driver thread's serve/* spans land in their own lane)
     overload_phases = phase_breakdown(
@@ -175,6 +228,8 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
                          label="decode_chunk@overload")
         mfu["flops_per_token"] = cost["flops_per_token"]
         mfu["scan_body_counted_once"] = cost["scan_body_counted_once"]
+    # HBM accounting: same after-the-audit placement as cost analysis
+    hbm = fe_engine.estimate_hbm()
     if trace_out:
         # one Perfetto file: engine/driver thread lanes + per-request
         # frontend lanes with submit->finish flow arrows
@@ -238,6 +293,8 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         # overload-phase-only span breakdown + decode-chunk MFU estimate
         "phase_breakdown": _round_tree(overload_phases),
         "mfu": _round_tree(mfu) if mfu else None,
+        "hbm": _round_tree(hbm) if hbm else None,
+        "metrics_scrape": metrics_scrape,
         "trace_file": trace_out,
     }
 
@@ -252,6 +309,10 @@ def main(argv=None):
     ap.add_argument("--decode-chunk", type=int, default=4)
     ap.add_argument("--high-fraction", type=float, default=0.25)
     ap.add_argument("--ttft-bound-s", type=float, default=10.0)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="bind /metrics + health endpoints to this port "
+                    "for the duration of the bench (0 = ephemeral; the "
+                    "bench self-scrapes either way)")
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
     ap.add_argument("--trace-out", type=str, default=None,
@@ -268,7 +329,8 @@ def main(argv=None):
                        decode_chunk=args.decode_chunk,
                        high_fraction=args.high_fraction,
                        ttft_bound_s=args.ttft_bound_s,
-                       seed=args.seed, trace_out=args.trace_out)
+                       seed=args.seed, trace_out=args.trace_out,
+                       metrics_port=args.metrics_port)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

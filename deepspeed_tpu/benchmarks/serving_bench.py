@@ -205,6 +205,9 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         # XLA counts the chunk's lax.scan body once; flops_per_chunk is
         # the xK estimate (see ServingEngine.estimate_chunk_cost)
         mfu["scan_body_counted_once"] = cost["scan_body_counted_once"]
+    # HBM accounting: same placement rule as MFU — memory_analysis pays
+    # one extra XLA compile, so it runs after the audited region too
+    hbm = chunked.estimate_hbm()
     telemetry.emit_summary(monitor, telemetry.get_runtime())
     monitor.close()
     if trace_out:
@@ -253,6 +256,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         "phase_breakdown": {"per_token": _round_tree(pt_phases),
                             "chunked": _round_tree(ck_phases)},
         "mfu": _round_tree(mfu) if mfu else None,
+        "hbm": _round_tree(hbm) if hbm else None,
         "trace_file": trace_out,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],

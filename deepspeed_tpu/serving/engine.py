@@ -260,6 +260,13 @@ class ServingEngine:
         # donate the arena: XLA updates every slot's KV rows in place
         self._jit_decode = jax.jit(decode, donate_argnums=(1,))
         self._jit_decode_chunk = jax.jit(decode_chunk_fn, donate_argnums=(1,))
+        # arena-size gauges at init: the KV footprint is fixed for the
+        # engine's lifetime, headroom varies (re-gauged per chunk)
+        arena = self.kv.arena_report()
+        self._arena_bytes_per_slot = arena["bytes_per_slot"]
+        telemetry.gauge("serve/arena_bytes", float(arena["arena_bytes"]))
+        telemetry.gauge("serve/arena_headroom_bytes",
+                        float(arena["headroom_bytes"]))
         log_dist(f"serving engine ready: slots={self.max_batch} "
                  f"prefill_buckets={self._buckets} "
                  f"decode_chunk={self.decode_chunk} "
@@ -403,6 +410,49 @@ class ServingEngine:
             "max_batch": B,
             "scan_body_counted_once": True,
             "peak_flops_per_device": _mfu.peak_flops_per_device(),
+        }
+
+    def estimate_hbm(self) -> Optional[Dict[str, Any]]:
+        """XLA memory analysis of the engine's own compiled programs
+        (telemetry.memory) plus arena accounting and a live-buffer
+        census — the ``hbm`` block in ``BENCH_serving.json``.
+
+        Same discipline as :meth:`estimate_chunk_cost`: abstract
+        lowering does not grow the audited jit cache (the pinned
+        ``decode_chunk_fn == 3`` budget stays exact) but pays one extra
+        XLA compile per analyzed program, so benches call this strictly
+        AFTER their timed/audited passes. Returns None when the backend
+        reports nothing for the decode program."""
+        import jax
+        from ..telemetry import memory as _mem
+
+        def abst(x):
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+        B = self.max_batch
+        i32 = jax.ShapeDtypeStruct((B,), np.int32)
+        params = jax.tree.map(abst, self.engine.params)
+        cache = jax.tree.map(abst, self.kv.cache)
+        rng = abst(self._rng)
+        if self.decode_chunk > 1:
+            decode = _mem.compiled_memory_analysis(
+                self._jit_decode_chunk, params, cache,
+                i32, i32, jax.ShapeDtypeStruct((B,), bool), i32, i32, rng)
+        else:
+            decode = _mem.compiled_memory_analysis(
+                self._jit_decode, params, cache, i32, i32, rng)
+        if decode is None:
+            return None
+        top = self._buckets[-1]
+        prefill = _mem.compiled_memory_analysis(
+            self._jit_prefill, params,
+            jax.ShapeDtypeStruct((B, top), np.int32), i32, rng)
+        return {
+            "decode_chunk": decode,
+            "prefill_top_bucket": prefill,
+            "prefill_bucket_len": top,
+            "arena": self.kv.arena_report(),
+            "live": _mem.live_array_census(top=8),
         }
 
     # ---------------------------------------------------------- internals
@@ -604,6 +654,9 @@ class ServingEngine:
         telemetry.gauge("serve/queue_depth",
                         float(self.scheduler.queue_depth))
         telemetry.gauge("serve/occupancy", float(self.kv.occupancy))
+        telemetry.gauge("serve/arena_headroom_bytes",
+                        float(self.kv.allocator.n_free
+                              * self._arena_bytes_per_slot))
         self.metrics.on_tokens(n_tokens)
         self.metrics.on_decode_step()
         self.metrics.on_finished(finished)

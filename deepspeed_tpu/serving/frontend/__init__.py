@@ -11,8 +11,9 @@ from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
                         ChunkThroughputEstimator, PRIORITY_HIGH,
                         PRIORITY_LOW, PRIORITY_NORMAL,
                         REJECT_DEADLINE_INFEASIBLE, REJECT_FRONTEND_CLOSED,
-                        REJECT_FRONTEND_QUEUE_FULL, REJECT_RATE_LIMITED,
-                        Ticket, TokenBucket)
+                        REJECT_FRONTEND_QUEUE_FULL, REJECT_MEMORY_INFEASIBLE,
+                        REJECT_RATE_LIMITED, Ticket, TokenBucket)
 from .tracing import EVENTS, RequestTrace, TraceLog  # noqa: F401
 from .frontend import (ServingFrontend, StreamHandle,  # noqa: F401
                        TERMINAL_STATUSES)
+from .health import BackendWatchdog, HealthMonitor  # noqa: F401

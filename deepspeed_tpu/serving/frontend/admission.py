@@ -37,12 +37,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ...telemetry import core as telemetry
+
 # machine-readable rejection reasons (the scheduler's REJECT_* constants
 # cover its own queue_full / prompt_too_long / deadline_expired reasons)
 REJECT_RATE_LIMITED = "rate_limited"
 REJECT_FRONTEND_QUEUE_FULL = "frontend_queue_full"
 REJECT_DEADLINE_INFEASIBLE = "deadline_infeasible"
 REJECT_FRONTEND_CLOSED = "frontend_closed"
+REJECT_MEMORY_INFEASIBLE = "memory_infeasible"
 
 # priority classes: any int works (lower admits first); these names are
 # the conventional three
@@ -110,7 +113,15 @@ class AdmissionConfig:
     decode-token-equivalents for the cost estimate — prefill processes
     its tokens in one batched program, so a prompt token costs a fraction
     of a decode token. ``feasibility_slack_s`` absorbs estimate noise
-    before a deadline shed fires."""
+    before a deadline shed fires.
+
+    ``shed_memory_infeasible`` adds the HBM-aware gate: a request whose
+    prompt + token budget cannot fit one KV slot row (``slot_tokens``
+    positions — wired from the engine arena's ``max_seq_len`` by the
+    frontend when left None) is rejected at offer time with
+    ``memory_infeasible`` instead of being admitted and silently
+    truncated at the arena edge. OFF by default — truncation is the
+    historical behavior."""
     max_pending: int = 256
     prefill_token_weight: float = 0.15
     feasibility_slack_s: float = 0.0
@@ -118,6 +129,8 @@ class AdmissionConfig:
     burst_per_tenant: float = 8.0
     tenant_limits: Dict[str, Tuple[float, float]] = \
         dataclasses.field(default_factory=dict)
+    shed_memory_infeasible: bool = False
+    slot_tokens: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -161,6 +174,7 @@ class AdmissionController:
         self.n_offered = 0
         self.n_rate_limited = 0
         self.n_shed = 0
+        self.n_memory_infeasible = 0
 
     # ------------------------------------------------------------ offers
     def _bucket_for(self, tenant: str) -> Optional[TokenBucket]:
@@ -180,13 +194,25 @@ class AdmissionController:
         """Admit ``ticket`` into the pending queue, or return a rejection
         reason. The rate-limit token is consumed only on acceptance
         paths (a bound-rejected request does not burn tenant budget)."""
+        reason = self._offer_locked(ticket)
+        if reason is not None:
+            telemetry.count(f"frontend/reject/{reason}", 1.0)
+        return reason
+
+    def _offer_locked(self, ticket: Ticket) -> Optional[str]:
+        cfg = self.config
         with self._lock:
             self.n_offered += 1
             if ticket.deadline_s is not None and \
                     self.clock() >= ticket.deadline_s:
                 from ..scheduler import REJECT_DEADLINE_EXPIRED
                 return REJECT_DEADLINE_EXPIRED
-            if self._pending >= self.config.max_pending:
+            if cfg.shed_memory_infeasible and cfg.slot_tokens and \
+                    ticket.prompt_len + ticket.max_new_tokens > \
+                    cfg.slot_tokens:
+                self.n_memory_infeasible += 1
+                return REJECT_MEMORY_INFEASIBLE
+            if self._pending >= cfg.max_pending:
                 return REJECT_FRONTEND_QUEUE_FULL
             bucket = self._bucket_for(ticket.tenant)
             if bucket is not None and not bucket.try_acquire():
@@ -248,6 +274,10 @@ class AdmissionController:
                 admits.append(ticket)
                 backlog_tokens += ticket.cost_tokens(
                     cfg.prefill_token_weight)
+            pending = self._pending
+        for _, reason in sheds:
+            telemetry.count(f"frontend/shed/{reason}", 1.0)
+        telemetry.gauge("frontend/pending", float(pending))
         return admits, sheds
 
     # ----------------------------------------------------------- queries

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ...telemetry import core as telemetry
 from ...utils.logging import logger
 from ..scheduler import Request
 from .admission import (AdmissionConfig, AdmissionController,
@@ -209,6 +210,11 @@ class ServingFrontend:
         self._engine = engine
         self._clock = clock
         self._controller = AdmissionController(admission, clock=clock)
+        cfg = self._controller.config
+        if cfg.shed_memory_infeasible and cfg.slot_tokens is None:
+            # memory-aware shedding sized from the engine's own arena:
+            # one slot row holds at most max_seq_len KV positions
+            cfg.slot_tokens = engine.max_seq_len
         self._estimator = ChunkThroughputEstimator()
         self.tracing = TraceLog(monitor, keep_last=trace_keep_last,
                                 clock=clock)
@@ -326,6 +332,20 @@ class ServingFrontend:
 
     # ----------------------------------------------------------- queries
     @property
+    def driver_alive(self) -> bool:
+        """The readiness signal ``/readyz`` (health.HealthMonitor) keys
+        on: the driver thread is running and has not crashed."""
+        return self._thread.is_alive() and not self.crashed
+
+    @property
+    def pending_admission(self) -> int:
+        return self._controller.pending
+
+    @property
+    def max_pending(self) -> int:
+        return self._controller.config.max_pending
+
+    @property
     def crashed(self) -> bool:
         with self._wake:
             return self._crashed
@@ -375,6 +395,11 @@ class ServingFrontend:
             dt = time.perf_counter() - t0
             self._estimator.record(eng.metrics.tokens_out - tokens_before,
                                    dt)
+            rate = self._estimator.rate()
+            if rate is not None:
+                telemetry.gauge("admission/ewma_tokens_per_s", float(rate))
+            telemetry.gauge("frontend/queue_depth",
+                            float(self._controller.pending))
             self._deliver(finished)
             # the scheduler's finished list is an append-only log; the
             # frontend is its only consumer, so trim it here or a

@@ -229,6 +229,23 @@ class TraceLog:
                 out[f"frontend/{status.replace(':', '_')}"] = float(n)
             return out
 
+    def histogram_stats(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, Any]:
+        """Locked snapshot of the latency histograms for exposition:
+        name -> {quantiles: {q: value}, count, sum}. Computed entirely
+        under the lock so a concurrent ``finish`` never mutates a
+        reservoir mid-serialization."""
+        with self._lock:
+            return {name: {"quantiles": {q: res.percentile(q * 100)
+                                         for q in qs},
+                           "count": res.n_seen,
+                           "sum": res.total}
+                    for name, res in self.histograms.items()}
+
+    def counter_totals(self) -> Dict[str, int]:
+        """Locked copy of the terminal-status counters."""
+        with self._lock:
+            return dict(self.counters)
+
     def emit(self, sample: Optional[int] = None) -> Dict[str, float]:
         """Write the snapshot through the monitor fan-out (no-op without
         a monitor; still returns the snapshot)."""

@@ -192,6 +192,43 @@ class SlotKVCacheManager:
         """Adopt the cache returned by a (donating) decode step."""
         self.cache = new_cache
 
+    # ---------------------------------------------------------- accounting
+    def arena_report(self) -> dict:
+        """HBM accounting of the arena pytree: total/kv/index bytes plus
+        the derived per-slot and per-token costs and the current
+        headroom (bytes of KV the free slots could still hold). This is
+        the ground truth the admission cost model and the bench ``hbm``
+        block read — computed from the live leaves, so dtype changes
+        (e.g. a future int8 KV) are reflected automatically."""
+        import jax
+        kv_bytes = 0
+        index_bytes = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache)[0]:
+            nbytes = getattr(leaf, "nbytes", None)
+            if nbytes is None:
+                continue
+            if "cache_index" in jax.tree_util.keystr(path):
+                index_bytes += int(nbytes)
+            else:
+                kv_bytes += int(nbytes)
+        alloc = self.allocator
+        per_slot = kv_bytes // alloc.max_batch if alloc.max_batch else 0
+        per_token = per_slot // self.max_seq_len if self.max_seq_len else 0
+        return {
+            "arena_bytes": kv_bytes + index_bytes,
+            "kv_bytes": kv_bytes,
+            "index_bytes": index_bytes,
+            "max_batch": alloc.max_batch,
+            "max_seq_len": self.max_seq_len,
+            "bytes_per_slot": per_slot,
+            "bytes_per_token": per_token,
+            "n_active": alloc.n_active,
+            "n_free": alloc.n_free,
+            "active_bytes": alloc.n_active * per_slot,
+            "headroom_bytes": alloc.n_free * per_slot,
+        }
+
     # ---------------------------------------------- allocator passthrough
     def alloc(self, fill_len: int = 0) -> Optional[int]:
         return self.allocator.alloc(fill_len)

@@ -49,9 +49,11 @@ class Reservoir:
         self._rng = random.Random(seed)
         self.values: List[float] = []
         self.n_seen = 0
+        self.total = 0.0        # running sum over ALL seen (not the sample)
 
     def add(self, x: float) -> None:
         self.n_seen += 1
+        self.total += float(x)
         if len(self.values) < self.capacity:
             self.values.append(float(x))
         else:

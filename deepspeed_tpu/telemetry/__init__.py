@@ -42,6 +42,12 @@ from .summary import (emit_summary, phase_breakdown,  # noqa: F401
                       summarize)
 from .mfu import (compiled_cost_analysis, mfu_report,  # noqa: F401
                   peak_flops_per_device)
+from .memory import (compiled_memory_analysis, format_bytes,  # noqa: F401
+                     live_array_census)
+from .exposition import (MetricsServer, parse_prometheus_text,  # noqa: F401
+                         render_prometheus)
+from .regression import (MetricSpec, detect_kind,  # noqa: F401
+                         diff_benchmarks)
 
 __all__ = [
     "TelemetryRuntime", "get_runtime", "configure", "enable", "disable",
@@ -49,4 +55,7 @@ __all__ = [
     "chrome_trace", "write_chrome_trace", "request_trace_events",
     "summarize", "phase_breakdown", "emit_summary",
     "compiled_cost_analysis", "mfu_report", "peak_flops_per_device",
+    "compiled_memory_analysis", "live_array_census", "format_bytes",
+    "render_prometheus", "parse_prometheus_text", "MetricsServer",
+    "MetricSpec", "diff_benchmarks", "detect_kind",
 ]

@@ -23,8 +23,18 @@ import sys
 from typing import Any, Dict, List, Tuple
 
 from .export import chrome_trace, request_trace_events
+from .memory import format_bytes
 
 _NUMBER = (int, float)
+
+# counter-track names that are byte-valued memory gauges (HBM arena,
+# headroom, live-buffer census) — summarized in their own section
+_MEMORYISH = ("bytes", "hbm", "headroom")
+
+
+def _memoryish(name: str) -> bool:
+    low = name.lower()
+    return any(k in low for k in _MEMORYISH)
 
 
 def _load(path: str) -> Any:
@@ -105,6 +115,7 @@ def summarize_trace(obj: Dict[str, Any]) -> Dict[str, Any]:
     events carrying a compile/retrace marker, with their args)."""
     spans: Dict[str, Dict[str, float]] = {}
     counters: Dict[str, float] = {}
+    peaks: Dict[str, float] = {}
     instants: Dict[str, int] = {}
     retraces: List[Dict[str, Any]] = []
     t_min, t_max = float("inf"), float("-inf")
@@ -125,6 +136,7 @@ def summarize_trace(obj: Dict[str, Any]) -> Dict[str, Any]:
             for k, v in (ev.get("args") or {}).items():
                 if isinstance(v, _NUMBER):
                     counters[k] = float(v)
+                    peaks[k] = max(peaks.get(k, float("-inf")), float(v))
         elif ph == "i":
             name = ev.get("name", "?")
             instants[name] = instants.get(name, 0) + 1
@@ -132,7 +144,8 @@ def summarize_trace(obj: Dict[str, Any]) -> Dict[str, Any]:
                 retraces.append({"name": name, "ts_us": ts,
                                  "args": ev.get("args") or {}})
     wall_us = (t_max - t_min) if t_max >= t_min else 0.0
-    return {"spans": spans, "counters": counters, "instants": instants,
+    return {"spans": spans, "counters": counters,
+            "counter_peaks": peaks, "instants": instants,
             "retraces": retraces, "wall_us": wall_us,
             "n_events": len(obj.get("traceEvents", ()))}
 
@@ -162,6 +175,14 @@ def cmd_summary(args) -> int:
         print("\ncounters (final value):")
         for name in sorted(s["counters"]):
             print(f"  {name:<40} {s['counters'][name]:>14g}")
+    mem = sorted(n for n in s["counters"] if _memoryish(n))
+    if mem:
+        print("\nmemory gauge tracks (final / peak):")
+        for name in mem:
+            final = s["counters"][name]
+            peak = s["counter_peaks"].get(name, final)
+            print(f"  {name:<40} {format_bytes(final):>12} / "
+                  f"{format_bytes(peak):>12}")
     if s["retraces"]:
         print(f"\nretrace/compile events ({len(s['retraces'])}):")
         for r in s["retraces"][:args.top]:

@@ -1,0 +1,251 @@
+"""Prometheus text exposition + the /metrics · /healthz · /readyz server.
+
+Everything observable in-process — :class:`TelemetryRuntime`
+counters/gauges/span reservoirs, the serving frontend's ``TraceLog``
+TTFT/TPOT/queue-wait histograms and terminal counters, and any flat
+gauge map (``ServingMetrics.snapshot``) — rendered in Prometheus text
+format 0.0.4 and served from a stdlib ``ThreadingHTTPServer``. No
+client library, no new dependency: the format is lines of
+``name{label="value"} number``.
+
+Mapping (namespace prefix ``dstpu`` by default):
+
+* runtime counters   -> ``dstpu_<name>_total``           (counter)
+* runtime gauges     -> ``dstpu_<name>``                 (gauge)
+* runtime instants   -> ``dstpu_<name>_events_total``    (counter)
+* runtime span stats -> ``dstpu_span_<name>_seconds``    (summary:
+  p50/p95/p99 quantiles + ``_count``/``_sum``)
+* TraceLog histograms-> ``dstpu_frontend_<name>_seconds``(summary)
+* TraceLog counters  -> ``dstpu_frontend_requests_total{status="..."}``
+* gauges map         -> ``dstpu_<name>``                 (gauge)
+
+Thread safety: every source is snapshotted under its own lock
+(``span_stats``/``counter_totals``/... on the runtime,
+``histogram_stats``/``counter_totals`` on the TraceLog) BEFORE
+serialization — a scrape never reads a structure mid-mutation (the
+same discipline as the PR-4 CsvWriter RLock fix).
+
+This module imports no JAX — the health server must answer even when
+the backend is wedged.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_QUANTILES = (0.5, 0.95, 0.99)
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Prometheus metric names are ``[a-zA-Z_:][a-zA-Z0-9_:]*`` — every
+    other character (the ``/`` in ``serve/queue_depth``) becomes ``_``."""
+    out = _NAME_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the text format: backslash, quote,
+    newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _line(name: str, value: float,
+          labels: Optional[Mapping[str, str]] = None) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in labels.items())
+        return f"{name}{{{inner}}} {value}"
+    return f"{name} {value}"
+
+
+def _summary(lines: List[str], name: str, *, quantiles: Mapping[float, float],
+             count: int, total: float, help_: str) -> None:
+    lines.append(f"# HELP {name} {help_}")
+    lines.append(f"# TYPE {name} summary")
+    for q, v in quantiles.items():
+        lines.append(_line(name, float(v), {"quantile": str(q)}))
+    lines.append(_line(f"{name}_count", float(count)))
+    lines.append(_line(f"{name}_sum", float(total)))
+
+
+def render_prometheus(*, runtime=None, tracelog=None,
+                      gauges: Optional[Mapping[str, float]] = None,
+                      namespace: str = "dstpu") -> str:
+    """Render every provided source as Prometheus text format 0.0.4.
+    All arguments optional — pass whatever the process has."""
+    ns = sanitize_metric_name(namespace)
+    lines: List[str] = []
+    if runtime is not None:
+        for name, total in sorted(runtime.counter_totals().items()):
+            m = f"{ns}_{sanitize_metric_name(name)}_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(_line(m, float(total)))
+        for name, value in sorted(runtime.gauge_values().items()):
+            m = f"{ns}_{sanitize_metric_name(name)}"
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(_line(m, float(value)))
+        for name, n in sorted(runtime.instant_counts().items()):
+            m = f"{ns}_{sanitize_metric_name(name)}_events_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(_line(m, float(n)))
+        for name, st in sorted(runtime.span_stats().items()):
+            m = f"{ns}_span_{sanitize_metric_name(name)}_seconds"
+            _summary(lines, m,
+                     quantiles={q: st[f"p{round(q * 100)}_s"]
+                                for q in _QUANTILES},
+                     count=st["count"], total=st["total_s"],
+                     help_=f"telemetry span {name} duration")
+    if tracelog is not None:
+        for name, st in sorted(tracelog.histogram_stats().items()):
+            base = name[:-2] if name.endswith("_s") else name
+            m = f"{ns}_frontend_{sanitize_metric_name(base)}_seconds"
+            _summary(lines, m, quantiles=st["quantiles"],
+                     count=st["count"], total=st["sum"],
+                     help_=f"frontend {base} latency")
+        counters = tracelog.counter_totals()
+        if counters:
+            m = f"{ns}_frontend_requests_total"
+            lines.append(f"# TYPE {m} counter")
+            for status, n in sorted(counters.items()):
+                lines.append(_line(m, float(n), {"status": status}))
+    for name, value in sorted((gauges or {}).items()):
+        m = f"{ns}_{sanitize_metric_name(name)}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(_line(m, float(value)))
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Any]:
+    """Light parser for tests and self-scrapes: returns
+    ``{"samples": {name: [(labels, value), ...]}, "types": {name: type}}``.
+    Raises ``ValueError`` on a malformed sample line — the golden-format
+    gate."""
+    samples: Dict[str, List] = {}
+    types: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"malformed exposition line: {raw!r}")
+        name, labelstr, value = m.groups()
+        labels = {k: v.replace('\\"', '"').replace("\\n", "\n")
+                   .replace("\\\\", "\\")
+                  for k, v in _LABEL.findall(labelstr or "")}
+        samples.setdefault(name, []).append((labels, float(value)))
+    return {"samples": samples, "types": types}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dstpu-metrics/1"
+
+    def log_message(self, *args):        # silence per-request stderr spam
+        pass
+
+    def _send(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        ms: "MetricsServer" = self.server.metrics_server  # type: ignore
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, ms.render(), CONTENT_TYPE)
+            elif path == "/healthz":
+                # liveness: the process answers -> it is alive
+                self._send(200, json.dumps({"status": "alive"}),
+                           "application/json")
+            elif path == "/readyz":
+                ready, reasons, details = ms.readiness()
+                self._send(200 if ready else 503,
+                           json.dumps({"ready": ready, "reasons": reasons,
+                                       "details": details}),
+                           "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # scrape must never kill the server
+            try:
+                self._send(500, f"exposition error: {e}\n", "text/plain")
+            except Exception:
+                pass
+
+
+class MetricsServer:
+    """Stdlib HTTP endpoint for scraping and probing one process.
+
+    ``GET /metrics`` renders every wired source (Prometheus text),
+    ``GET /healthz`` is pure liveness (200 while the process answers),
+    ``GET /readyz`` consults ``health.check()`` (a
+    :class:`~deepspeed_tpu.serving.frontend.health.HealthMonitor` or
+    anything with that signature) and answers 503 with machine-readable
+    reasons when not ready. ``port=0`` binds an ephemeral port (read it
+    back from ``.port`` — the test/bench pattern)."""
+
+    def __init__(self, *, runtime=None, tracelog=None,
+                 gauges_fn: Optional[Callable[[], Mapping[str, float]]] = None,
+                 health=None, host: str = "127.0.0.1", port: int = 0,
+                 namespace: str = "dstpu"):
+        self.runtime = runtime
+        self.tracelog = tracelog
+        self.gauges_fn = gauges_fn
+        self.health = health
+        self.namespace = namespace
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.metrics_server = self        # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dstpu-metrics",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def render(self) -> str:
+        gauges = self.gauges_fn() if self.gauges_fn is not None else None
+        return render_prometheus(runtime=self.runtime,
+                                 tracelog=self.tracelog, gauges=gauges,
+                                 namespace=self.namespace)
+
+    def readiness(self):
+        if self.health is None:
+            return True, [], {}
+        return self.health.check()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

@@ -1,0 +1,116 @@
+"""Compile-time HBM accounting and live-buffer census.
+
+The memory twin of :mod:`.mfu`: instead of guessing what a program
+holds, ask XLA — ``jitted.lower(*abstract_args).compile()
+.memory_analysis()`` reports argument/output/temp/alias bytes of the
+compiled executable. Abstract lowering over ``jax.ShapeDtypeStruct``
+trees touches no device buffers and does NOT grow the jit cache the
+``TraceAuditor`` retrace budgets count — but it pays one extra XLA
+compile, so callers under a pinned budget run accounting strictly
+AFTER the audited/timed region (the same rule, and the same reason,
+as ``compiled_cost_analysis``).
+
+Three layers:
+
+* :func:`compiled_memory_analysis` — per-program breakdown of one
+  jitted program (the engines' own, so the accounted program IS the
+  one being run);
+* :func:`live_array_census` — what is resident *right now*:
+  ``jax.live_arrays()`` bucketed by (dtype, shape), largest first, so
+  an HBM regression names the block that grew;
+* arena/headroom gauges live on ``SlotKVCacheManager.arena_report()``
+  (serving/kv_cache.py) and ``ServingEngine.estimate_hbm()`` — they
+  feed the admission cost model and the ``hbm`` block in
+  ``BENCH_*.json`` that ``bin/benchdiff`` regresses on.
+
+JAX is imported lazily — the module stays importable by the
+stdlib-only ``bin/`` launchers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: CompiledMemoryStats attribute -> report key. ``generated_code`` is
+#: the executable itself (small, but a canary for code-size blowups).
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def compiled_memory_analysis(fn, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """XLA memory analysis of ``fn(*args, **kwargs)``: a dict of
+    ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` /
+    ``alias_bytes`` / ``generated_code_bytes`` plus a derived
+    ``total_bytes`` (arguments + outputs + temps — the executable's
+    peak working set, aliased bytes already counted once on the
+    argument side). ``fn`` may be a plain callable (jitted here) or an
+    existing ``jax.jit`` wrapper; args may be real arrays or
+    ``jax.ShapeDtypeStruct`` (abstract lowering — no device work).
+    Returns ``None`` when the backend does not report."""
+    import jax
+    try:
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        ma = jitted.lower(*args, **kwargs).compile().memory_analysis()
+        if ma is None:
+            return None
+        out: Dict[str, Any] = {}
+        for attr, key in _MEMORY_FIELDS:
+            v = getattr(ma, attr, None)
+            out[key] = int(v) if v is not None else None
+        if all(v in (None, 0) for v in out.values()):
+            return None
+        out["total_bytes"] = sum(
+            out[k] or 0
+            for k in ("argument_bytes", "output_bytes", "temp_bytes"))
+        return out
+    except Exception:
+        return None
+
+
+def live_array_census(top: Optional[int] = None) -> Dict[str, Any]:
+    """Snapshot of every array the JAX runtime currently holds alive,
+    bucketed by (dtype, shape) and sorted by total bytes descending —
+    the "what is actually resident" answer ``memory_analysis`` (a
+    per-program static bound) cannot give. ``top`` truncates the block
+    list (totals always cover everything)."""
+    import jax
+    buckets: Dict[tuple, Dict[str, Any]] = {}
+    n_arrays = 0
+    total = 0
+    for arr in jax.live_arrays():
+        nbytes = getattr(arr, "nbytes", None)
+        if nbytes is None:
+            continue
+        n_arrays += 1
+        total += int(nbytes)
+        key = (str(arr.dtype), tuple(int(d) for d in arr.shape))
+        b = buckets.get(key)
+        if b is None:
+            buckets[key] = {"dtype": key[0], "shape": list(key[1]),
+                            "count": 1, "bytes": int(nbytes)}
+        else:
+            b["count"] += 1
+            b["bytes"] += int(nbytes)
+    blocks = sorted(buckets.values(), key=lambda b: -b["bytes"])
+    truncated = top is not None and len(blocks) > top
+    if truncated:
+        blocks = blocks[:top]
+    return {"n_arrays": n_arrays, "total_bytes": total,
+            "blocks": blocks, "truncated": truncated}
+
+
+def format_bytes(n: Optional[float]) -> str:
+    """Human byte count (``None`` -> ``"?"``) for CLI summaries."""
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"

@@ -1,0 +1,246 @@
+"""Bench regression sentry: diff ``BENCH_*.json`` rounds against
+tolerance bands.
+
+ROADMAP Open item 5's second failure mode: bench rounds landed numbers
+nobody compared, so a regression (throughput, phase share creep, HBM
+growth) only surfaced when someone eyeballed two JSON files. This module
+is the machine that does the comparing: named metric paths into the
+bench document, each with a direction and a tolerance band, diffed
+baseline-vs-current into a machine-readable ``regressions`` block.
+``bin/benchdiff`` is the CLI; ``bin/obs_smoke.sh`` gates CI on it
+(committed baseline vs a fresh run must pass, a seeded synthetic
+regression must fail).
+
+Stdlib-only — never imports JAX (the sentry must run on a machine with
+no accelerator stack at all).
+
+Tolerance philosophy: timing metrics (tokens/s, TTFT) get wide bands
+(30-50%) because CI machines are shared and noisy; structural metrics
+(compile counts, parity flags, phase *shares*) get exact or tight
+bands because they are deterministic — a compile-count bump is a real
+retrace regression no matter how noisy the wall clock was.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_MISSING = object()
+
+#: directions: how ``current`` may move relative to ``baseline`` before
+#: the check regresses.
+HIGHER = "higher"        # throughput-like: regression when it DROPS
+LOWER = "lower"          # latency/bytes-like: regression when it GROWS
+SHIFT = "shift"          # two-sided: |current - baseline| > abs_tol
+
+
+@dataclasses.dataclass
+class MetricSpec:
+    """One watched metric. ``path`` is a tuple of keys into the bench
+    dict (tuples, not '/'-joined strings — span names like
+    ``serve/chunk_host_wait`` contain '/'). ``rel_tol`` is the
+    fractional band for higher/lower; ``abs_tol`` (when set) is an
+    absolute band OR'd with it — the check regresses only when both
+    bands are exceeded, so near-zero baselines don't flag on noise."""
+    path: Tuple[str, ...]
+    direction: str = HIGHER
+    rel_tol: float = 0.3
+    abs_tol: Optional[float] = None
+    note: str = ""
+
+    @property
+    def name(self) -> str:
+        return ".".join(self.path)
+
+
+def lookup(doc: Any, path: Sequence[str]) -> Any:
+    for key in path:
+        if not isinstance(doc, dict) or key not in doc:
+            return _MISSING
+        doc = doc[key]
+    return doc
+
+
+SERVING_SPECS: List[MetricSpec] = [
+    MetricSpec(("chunked_tokens_per_s",), HIGHER, 0.30),
+    MetricSpec(("per_token_tokens_per_s",), HIGHER, 0.30),
+    MetricSpec(("chunk_speedup",), HIGHER, 0.25),
+    MetricSpec(("greedy_parity",), SHIFT, abs_tol=0.0,
+               note="bit-exactness is binary"),
+    MetricSpec(("decode_chunk_compiles",), SHIFT, abs_tol=0.0,
+               note="pinned retrace budget"),
+    MetricSpec(("prefill_programs",), SHIFT, abs_tol=0.0),
+    MetricSpec(("phase_breakdown", "chunked", "serve/chunk_host_wait",
+                "share_of_wall"), SHIFT, abs_tol=0.15),
+    MetricSpec(("phase_breakdown", "chunked", "serve/prefill",
+                "share_of_wall"), SHIFT, abs_tol=0.15),
+    MetricSpec(("mfu", "flops_per_token"), LOWER, 0.25,
+               note="compiled flops per token growing = model program "
+                    "got heavier"),
+    MetricSpec(("hbm", "decode_chunk", "temp_bytes"), LOWER, 0.25),
+    MetricSpec(("hbm", "decode_chunk", "argument_bytes"), LOWER, 0.25),
+    MetricSpec(("hbm", "arena", "arena_bytes"), LOWER, 0.10,
+               note="KV arena footprint is deterministic"),
+]
+
+FRONTEND_SPECS: List[MetricSpec] = [
+    MetricSpec(("capacity_tokens_per_s",), HIGHER, 0.30),
+    MetricSpec(("greedy_streaming_parity",), SHIFT, abs_tol=0.0),
+    MetricSpec(("high_ttft_p99_s",), LOWER, 0.50, abs_tol=0.25),
+    MetricSpec(("frontend_snapshot", "frontend/ttft_p99_s"),
+               LOWER, 0.50, abs_tol=0.25),
+    MetricSpec(("phase_breakdown", "serve/chunk_host_wait",
+                "share_of_wall"), SHIFT, abs_tol=0.20),
+    MetricSpec(("mfu", "flops_per_token"), LOWER, 0.25),
+    MetricSpec(("hbm", "decode_chunk", "temp_bytes"), LOWER, 0.25),
+    MetricSpec(("hbm", "arena", "arena_bytes"), LOWER, 0.10),
+]
+
+SPEC_SETS: Dict[str, List[MetricSpec]] = {
+    "serving": SERVING_SPECS,
+    "frontend": FRONTEND_SPECS,
+}
+
+
+def detect_kind(doc: Dict[str, Any]) -> Optional[str]:
+    if "chunked_tokens_per_s" in doc:
+        return "serving"
+    if "capacity_tokens_per_s" in doc:
+        return "frontend"
+    return None
+
+
+def _check_one(spec: MetricSpec, base: Any, cur: Any) -> Dict[str, Any]:
+    rec: Dict[str, Any] = {"metric": spec.name, "path": list(spec.path),
+                           "direction": spec.direction,
+                           "rel_tol": spec.rel_tol,
+                           "abs_tol": spec.abs_tol}
+    if spec.note:
+        rec["note"] = spec.note
+    if base is _MISSING or cur is _MISSING:
+        rec["status"] = "missing"
+        rec["missing_in"] = ("baseline" if base is _MISSING else "") + \
+            ("+" if base is _MISSING and cur is _MISSING else "") + \
+            ("current" if cur is _MISSING else "")
+        return rec
+    if base is None or cur is None:
+        # a legitimately-unavailable metric (mfu on CPU) — not a
+        # regression, not missing structure
+        rec["status"] = "skipped"
+        rec["baseline"], rec["current"] = base, cur
+        return rec
+    base_f, cur_f = float(base), float(cur)
+    rec["baseline"], rec["current"] = base_f, cur_f
+    delta = cur_f - base_f
+    rec["delta"] = delta
+    rec["rel_delta"] = delta / abs(base_f) if base_f else None
+    if spec.direction == SHIFT:
+        tol = spec.abs_tol if spec.abs_tol is not None else 0.0
+        bad = abs(delta) > tol
+    else:
+        drift = -delta if spec.direction == HIGHER else delta
+        bad = drift > spec.rel_tol * abs(base_f)
+        if bad and spec.abs_tol is not None:
+            bad = drift > spec.abs_tol     # both bands must be exceeded
+    rec["status"] = "regression" if bad else "ok"
+    return rec
+
+
+def diff_benchmarks(baseline: Dict[str, Any], current: Dict[str, Any],
+                    specs: Sequence[MetricSpec]) -> Dict[str, Any]:
+    """Diff two bench documents over ``specs``. Returns the
+    machine-readable block: ``checks`` (every spec's record),
+    ``regressions`` / ``missing`` (the subsets), ``ok``."""
+    checks = [_check_one(s, lookup(baseline, s.path),
+                         lookup(current, s.path)) for s in specs]
+    regressions = [c for c in checks if c["status"] == "regression"]
+    missing = [c for c in checks if c["status"] == "missing"]
+    return {"checks": checks, "regressions": regressions,
+            "missing": missing,
+            "n_ok": sum(c["status"] == "ok" for c in checks),
+            "ok": not regressions}
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="benchdiff",
+        description="Diff two BENCH_*.json rounds against tolerance "
+                    "bands; exit 1 on regression.")
+    p.add_argument("baseline", help="baseline BENCH_*.json")
+    p.add_argument("current", help="current BENCH_*.json")
+    p.add_argument("--kind", choices=["auto", "serving", "frontend"],
+                   default="auto")
+    p.add_argument("--fail-on-missing", action="store_true",
+                   help="exit 1 when a watched metric is absent from "
+                        "either document")
+    p.add_argument("--json-out", default=None,
+                   help="write the machine-readable regressions block")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        with open(args.current) as f:
+            current = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: cannot load inputs: {e}", file=sys.stderr)
+        return 2
+
+    kind = args.kind
+    if kind == "auto":
+        kind = detect_kind(current) or detect_kind(baseline)
+        if kind is None:
+            print("benchdiff: cannot auto-detect bench kind "
+                  "(pass --kind)", file=sys.stderr)
+            return 2
+    result = diff_benchmarks(baseline, current, SPEC_SETS[kind])
+    result["kind"] = kind
+    result["baseline_file"] = args.baseline
+    result["current_file"] = args.current
+
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(result, f, indent=2)
+
+    if not args.quiet:
+        for c in result["checks"]:
+            status = c["status"]
+            if status == "ok":
+                mark = "ok        "
+            elif status == "regression":
+                mark = "REGRESSION"
+            elif status == "missing":
+                mark = "missing   "
+            else:
+                mark = "skipped   "
+            detail = ""
+            if "baseline" in c and c.get("baseline") is not None:
+                detail = (f" {_fmt(c['baseline'])} -> "
+                          f"{_fmt(c.get('current'))}")
+                if c.get("rel_delta") is not None:
+                    detail += f" ({c['rel_delta']:+.1%})"
+            print(f"  {mark} [{kind}] {c['metric']}{detail}")
+        n_reg = len(result["regressions"])
+        n_miss = len(result["missing"])
+        print(f"benchdiff: {result['n_ok']} ok, {n_reg} regression(s), "
+              f"{n_miss} missing")
+    if result["regressions"]:
+        return 1
+    if args.fail_on_missing and result["missing"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

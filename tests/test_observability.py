@@ -1,0 +1,593 @@
+"""Observability tests: HBM accounting, Prometheus exposition, health
+probes, and the benchdiff regression sentry.
+
+The exposition/watchdog/health/benchdiff layers are host-side Python
+with injectable fakes and run at CPU speed with no backend at all; the
+HBM-accounting tests share one tiny compiled GPT through a module
+fixture (the `memory_analysis` numbers must come from the engine's OWN
+jitted programs, so the test goes through `ServingEngine.estimate_hbm`
+rather than a synthetic model).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu.telemetry as tel
+from deepspeed_tpu.telemetry import regression as reg
+from deepspeed_tpu.telemetry.exposition import (CONTENT_TYPE, MetricsServer,
+                                                escape_label_value,
+                                                parse_prometheus_text,
+                                                render_prometheus,
+                                                sanitize_metric_name)
+from deepspeed_tpu.serving.frontend import (AdmissionConfig,
+                                            AdmissionController,
+                                            BackendWatchdog, HealthMonitor,
+                                            REJECT_MEMORY_INFEASIBLE,
+                                            ServingFrontend, Ticket,
+                                            TraceLog)
+from deepspeed_tpu.serving.metrics import Reservoir
+
+pytestmark = pytest.mark.observability
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------- exposition
+class TestPrometheusRendering:
+    def test_sanitize_metric_name(self):
+        assert sanitize_metric_name("serve/queue depth") == \
+            "serve_queue_depth"
+        assert sanitize_metric_name("9lives") == "_9lives"
+        assert sanitize_metric_name("a:b_c") == "a:b_c"
+
+    def test_escape_label_value(self):
+        assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+    def test_golden_format_round_trip(self):
+        rt = tel.TelemetryRuntime(enabled=True)
+        with rt.span("serve/prefill"):
+            pass
+        with rt.span("serve/prefill"):
+            pass
+        rt.count("tokens/generated", 42.0)
+        rt.gauge("serve/arena_headroom_bytes", 65536.0)
+        rt.instant("engine/retrace")
+
+        log = TraceLog(clock=FakeClock())
+        log.start(1)
+        log.mark(1, "first_token")
+        log.finish(1, "done")
+        # a rejection reason with every character the escaper handles
+        log.record_rejected(2, 'quo"te\\slash\nnewline')
+
+        text = render_prometheus(runtime=rt, tracelog=log,
+                                 gauges={"serving/ttft_p99_s": 0.25})
+        parsed = parse_prometheus_text(text)
+        samples, types = parsed["samples"], parsed["types"]
+
+        assert types["dstpu_tokens_generated_total"] == "counter"
+        assert samples["dstpu_tokens_generated_total"] == [({}, 42.0)]
+        assert samples["dstpu_serve_arena_headroom_bytes"] == [({}, 65536.0)]
+        assert samples["dstpu_engine_retrace_events_total"] == [({}, 1.0)]
+        assert samples["dstpu_serving_ttft_p99_s"] == [({}, 0.25)]
+
+        # span summary: quantile samples + _count/_sum
+        fam = "dstpu_span_serve_prefill_seconds"
+        assert types[fam] == "summary"
+        quantiles = {lab["quantile"] for lab, _ in samples[fam]}
+        assert quantiles == {"0.5", "0.95", "0.99"}
+        assert samples[fam + "_count"] == [({}, 2.0)]
+        assert samples[fam + "_sum"][0][1] >= 0.0
+
+        # TraceLog terminal counters with the nasty label round-tripped
+        reqs = dict((lab["status"], v) for lab, v in
+                    samples["dstpu_frontend_requests_total"])
+        assert reqs["done"] == 1.0
+        assert reqs['rejected:quo"te\\slash\nnewline'] == 1.0
+
+        # TTFT histogram family made it out as a summary
+        assert types["dstpu_frontend_ttft_seconds"] == "summary"
+
+    def test_parser_rejects_malformed_line(self):
+        with pytest.raises(ValueError):
+            parse_prometheus_text("dstpu_ok 1\n}{garbage\n")
+
+    def test_reservoir_total_is_running_sum(self):
+        r = Reservoir(capacity=4)
+        for x in range(10):            # overflows capacity
+            r.add(float(x))
+        assert r.total == pytest.approx(sum(range(10)))
+        assert r.n_seen == 10
+
+
+class _FakeHealth:
+    def __init__(self):
+        self.ready = True
+
+    def check(self):
+        if self.ready:
+            return True, [], {"driver_alive": True}
+        return False, ["driver_crashed"], {"driver_alive": False}
+
+
+class TestMetricsServerHTTP:
+    def test_endpoints_end_to_end(self):
+        rt = tel.TelemetryRuntime(enabled=True)
+        rt.gauge("serve/arena_bytes", 1024.0)
+        health = _FakeHealth()
+        server = MetricsServer(runtime=rt, health=health)
+        try:
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == CONTENT_TYPE
+                parsed = parse_prometheus_text(resp.read().decode())
+            assert parsed["samples"]["dstpu_serve_arena_bytes"] == \
+                [({}, 1024.0)]
+
+            with urllib.request.urlopen(f"{server.url}/healthz",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+                assert json.load(resp)["status"] == "alive"
+
+            with urllib.request.urlopen(f"{server.url}/readyz",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+
+            health.ready = False       # readiness must flip to 503
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/readyz", timeout=5)
+            assert exc.value.code == 503
+            body = json.loads(exc.value.read())
+            assert body["reasons"] == ["driver_crashed"]
+
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/nope", timeout=5)
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------- watchdog
+class TestBackendWatchdog:
+    def test_healthy_heartbeat(self):
+        wd = BackendWatchdog(heartbeat_fn=lambda: None, timeout_s=5.0)
+        assert wd.beat() is True
+        st = wd.state()
+        assert st["ok"] and st["n_beats"] == 1 and st["n_failures"] == 0
+        assert st["last_beat_s"] is not None
+
+    def test_raising_heartbeat_flips_ok(self):
+        def bad():
+            raise RuntimeError("backend gone")
+        wd = BackendWatchdog(heartbeat_fn=bad, timeout_s=5.0)
+        assert wd.beat() is False
+        assert "backend gone" in wd.state()["last_error"]
+
+    def test_max_failures_debounce_and_recovery(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise RuntimeError("flake")
+        wd = BackendWatchdog(heartbeat_fn=flaky, timeout_s=5.0,
+                             max_failures=2)
+        assert wd.beat() is True       # one failure: still ok
+        assert wd.beat() is False      # second consecutive: dead
+        assert wd.beat() is True       # success: automatic recovery
+        assert wd.state()["consecutive_failures"] == 0
+
+    def test_hung_heartbeat_times_out_without_thread_pileup(self):
+        release = threading.Event()
+
+        def hang():
+            release.wait(30.0)
+        wd = BackendWatchdog(heartbeat_fn=hang, timeout_s=0.05)
+        try:
+            assert wd.beat() is False
+            assert "exceeded" in wd.state()["last_error"]
+            # the first worker is still hung: the next beat must record
+            # a failure WITHOUT spawning a second worker
+            before = sum(t.name == "backend-heartbeat"
+                         for t in threading.enumerate())
+            assert wd.beat() is False
+            after = sum(t.name == "backend-heartbeat"
+                        for t in threading.enumerate())
+            assert after <= before
+            assert "hung" in wd.state()["last_error"]
+        finally:
+            release.set()
+
+    def test_start_stop_periodic(self):
+        wd = BackendWatchdog(heartbeat_fn=lambda: None, interval_s=0.01,
+                             timeout_s=1.0)
+        wd.start()
+        deadline = time.monotonic() + 5.0
+        while wd.state()["n_beats"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.stop()
+        assert wd.state()["n_beats"] >= 3 and wd.ok
+
+
+# ------------------------------------------------------- health monitor
+class _FakeFrontend:
+    def __init__(self):
+        self.driver_alive = True
+        self.crashed = False
+        self.crash_error = None
+        self.pending_admission = 0
+        self.max_pending = 20
+
+
+class TestHealthMonitor:
+    def test_all_green(self):
+        ready, reasons, details = HealthMonitor(
+            frontend=_FakeFrontend()).check()
+        assert ready and reasons == []
+        assert details["driver_alive"] is True
+
+    def test_driver_crash(self):
+        fe = _FakeFrontend()
+        fe.crashed, fe.crash_error = True, RuntimeError("boom")
+        fe.driver_alive = False
+        ready, reasons, details = HealthMonitor(frontend=fe).check()
+        assert not ready and "driver_crashed" in reasons
+        assert "boom" in details["crash_error"]
+
+    def test_driver_dead_without_crash(self):
+        fe = _FakeFrontend()
+        fe.driver_alive = False
+        ready, reasons, _ = HealthMonitor(frontend=fe).check()
+        assert not ready and reasons == ["driver_dead"]
+
+    def test_admission_saturation(self):
+        fe = _FakeFrontend()
+        fe.pending_admission = 19      # 19 >= 0.95 * 20
+        ready, reasons, _ = HealthMonitor(frontend=fe).check()
+        assert not ready and reasons == ["admission_saturated"]
+
+    def test_watchdog_wired_in(self):
+        def bad():
+            raise RuntimeError("no device")
+        wd = BackendWatchdog(heartbeat_fn=bad, timeout_s=1.0)
+        wd.beat()
+        ready, reasons, details = HealthMonitor(watchdog=wd).check()
+        assert not ready and reasons == ["backend_unresponsive"]
+        assert details["watchdog"]["n_failures"] == 1
+
+    def test_custom_check_and_exception(self):
+        mon = HealthMonitor(checks={
+            "disk": lambda: True,
+            "quota": lambda: (_ for _ in ()).throw(OSError("full"))})
+        ready, reasons, details = mon.check()
+        assert not ready and reasons == ["quota"]
+        assert details["disk"] is True
+        assert "full" in details["quota_error"]
+
+
+# ------------------------------------------- admission memory shedding
+def _ticket(prompt_len=4, max_new=8):
+    return Ticket(prompt_len=prompt_len, max_new_tokens=max_new)
+
+
+class TestMemoryAwareAdmission:
+    def test_memory_infeasible_shed_when_enabled(self):
+        c = AdmissionController(
+            AdmissionConfig(shed_memory_infeasible=True, slot_tokens=10),
+            clock=FakeClock())
+        assert c.offer(_ticket(prompt_len=2, max_new=4)) is None
+        assert c.offer(_ticket(prompt_len=8, max_new=8)) == \
+            REJECT_MEMORY_INFEASIBLE
+        assert c.n_memory_infeasible == 1 and c.pending == 1
+
+    def test_disabled_by_default(self):
+        c = AdmissionController(AdmissionConfig(slot_tokens=10),
+                                clock=FakeClock())
+        assert c.offer(_ticket(prompt_len=8, max_new=8)) is None
+
+    def test_reject_counter_reaches_telemetry(self):
+        rt = tel.get_runtime()
+        was_enabled = rt.enabled
+        tel.enable()
+        try:
+            before = rt.counter_totals().get(
+                "frontend/reject/memory_infeasible", 0.0)
+            c = AdmissionController(
+                AdmissionConfig(shed_memory_infeasible=True,
+                                slot_tokens=10), clock=FakeClock())
+            c.offer(_ticket(prompt_len=8, max_new=8))
+            after = rt.counter_totals()["frontend/reject/memory_infeasible"]
+            assert after == before + 1.0
+        finally:
+            if not was_enabled:
+                tel.disable()
+
+
+# ----------------------------------------------------------- benchdiff
+def _serving_doc(**over):
+    doc = {
+        "chunked_tokens_per_s": 100.0,
+        "per_token_tokens_per_s": 50.0,
+        "chunk_speedup": 2.0,
+        "greedy_parity": True,
+        "decode_chunk_compiles": 3,
+        "prefill_programs": 2,
+        "phase_breakdown": {"chunked": {
+            "serve/chunk_host_wait": {"share_of_wall": 0.2},
+            "serve/prefill": {"share_of_wall": 0.3}}},
+        "mfu": {"flops_per_token": 1000.0},
+        "hbm": {"decode_chunk": {"temp_bytes": 1 << 20,
+                                 "argument_bytes": 1 << 21},
+                "arena": {"arena_bytes": 1 << 22}},
+    }
+    doc.update(over)
+    return doc
+
+
+class TestBenchdiff:
+    def test_identical_rounds_pass(self):
+        doc = _serving_doc()
+        out = reg.diff_benchmarks(doc, doc, reg.SERVING_SPECS)
+        assert out["ok"] and not out["regressions"] and not out["missing"]
+
+    def test_throughput_drop_regresses_beyond_band(self):
+        base = _serving_doc()
+        within = _serving_doc(chunked_tokens_per_s=75.0)   # -25% < 30%
+        beyond = _serving_doc(chunked_tokens_per_s=60.0)   # -40% > 30%
+        assert reg.diff_benchmarks(base, within, reg.SERVING_SPECS)["ok"]
+        out = reg.diff_benchmarks(base, beyond, reg.SERVING_SPECS)
+        assert not out["ok"]
+        assert out["regressions"][0]["metric"] == "chunked_tokens_per_s"
+
+    def test_hbm_growth_regresses(self):
+        base = _serving_doc()
+        cur = _serving_doc()
+        cur["hbm"]["decode_chunk"]["temp_bytes"] = int(1.5 * (1 << 20))
+        out = reg.diff_benchmarks(base, cur, reg.SERVING_SPECS)
+        assert [r["metric"] for r in out["regressions"]] == \
+            ["hbm.decode_chunk.temp_bytes"]
+
+    def test_compile_count_is_exact(self):
+        out = reg.diff_benchmarks(
+            _serving_doc(), _serving_doc(decode_chunk_compiles=4),
+            reg.SERVING_SPECS)
+        assert any(r["metric"] == "decode_chunk_compiles"
+                   for r in out["regressions"])
+
+    def test_missing_and_none_are_not_regressions(self):
+        base = _serving_doc()
+        cur = _serving_doc(mfu={"flops_per_token": None})
+        del cur["hbm"]
+        out = reg.diff_benchmarks(base, cur, reg.SERVING_SPECS)
+        assert out["ok"]
+        assert {m["metric"] for m in out["missing"]} == {
+            "hbm.decode_chunk.temp_bytes",
+            "hbm.decode_chunk.argument_bytes",
+            "hbm.arena.arena_bytes"}
+        skipped = [c for c in out["checks"] if c["status"] == "skipped"]
+        assert [c["metric"] for c in skipped] == ["mfu.flops_per_token"]
+
+    def test_detect_kind(self):
+        assert reg.detect_kind(_serving_doc()) == "serving"
+        assert reg.detect_kind({"capacity_tokens_per_s": 1}) == "frontend"
+        assert reg.detect_kind({}) is None
+
+    def test_cli_exit_codes(self, tmp_path):
+        base = tmp_path / "base.json"
+        good = tmp_path / "good.json"
+        bad = tmp_path / "bad.json"
+        sparse = tmp_path / "sparse.json"
+        base.write_text(json.dumps(_serving_doc()))
+        good.write_text(json.dumps(_serving_doc()))
+        bad.write_text(json.dumps(
+            _serving_doc(chunked_tokens_per_s=10.0)))
+        doc = _serving_doc()
+        del doc["hbm"]
+        sparse.write_text(json.dumps(doc))
+
+        def run(*argv):
+            return subprocess.run(
+                [sys.executable, str(_REPO / "bin" / "benchdiff"),
+                 *map(str, argv)], capture_output=True, text=True)
+        assert run(base, good).returncode == 0
+        r = run(base, bad)
+        assert r.returncode == 1 and "REGRESSION" in r.stdout
+        assert run(base, sparse).returncode == 0
+        assert run(base, sparse, "--fail-on-missing").returncode == 1
+        assert run(base, tmp_path / "absent.json").returncode == 2
+
+    def test_cli_json_out(self, tmp_path):
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(_serving_doc()))
+        out = tmp_path / "diff.json"
+        rc = reg.main([str(base), str(base), "--quiet",
+                       "--json-out", str(out)])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["ok"] and doc["kind"] == "serving"
+
+
+# ------------------------------------------------ concurrent mutation
+class TestConcurrentSerialization:
+    def test_tracelog_serializes_under_concurrent_finish(self):
+        """export_chrome / histogram_stats / render_prometheus hammered
+        while another thread finishes requests: no exception, no torn
+        reads (the PR's snapshot-under-lock hardening)."""
+        log = TraceLog(keep_last=64)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            uid = 0
+            while not stop.is_set():
+                uid += 1
+                log.start(uid)
+                log.chunk(uid, 4)
+                log.finish(uid, "done" if uid % 3 else "cancelled")
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    log.export_chrome()
+                    log.histogram_stats()
+                    log.snapshot()
+                    render_prometheus(tracelog=log)
+                except Exception as e:       # pragma: no cover
+                    errors.append(e)
+                    return
+        threads = [threading.Thread(target=writer)] + \
+            [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+
+
+# ------------------------------------------------- HBM (tiny engine)
+def _tiny(vocab=64, max_seq=64):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    cfg = GPTConfig(vocab_size=vocab, max_seq_len=max_seq, num_layers=2,
+                    num_heads=2, d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, remat=False)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    model, params = _tiny()
+    return ds.init_inference(model, model_parameters=params,
+                             dtype=jnp.float32)
+
+
+class TestMemoryAccounting:
+    def test_compiled_memory_analysis_on_plain_fn(self):
+        import jax
+        import jax.numpy as jnp
+        x = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+        rep = tel.compiled_memory_analysis(
+            lambda a: (a @ a).sum(), x)
+        assert rep is not None
+        assert rep["argument_bytes"] == 64 * 64 * 4
+        assert rep["output_bytes"] == 4
+        assert rep["total_bytes"] >= rep["argument_bytes"]
+
+    def test_estimate_hbm_sanity_on_tiny_gpt(self, tiny_engine):
+        from deepspeed_tpu.serving import ServingEngine
+        serving = ServingEngine(engine=tiny_engine, max_batch=2,
+                                max_prompt_len=16, max_queue=16,
+                                decode_chunk=4)
+        serving.run([np.arange(1, 6, dtype=np.int32)], max_new_tokens=4)
+        hbm = serving.estimate_hbm()
+        assert hbm is not None
+        dc = hbm["decode_chunk"]
+        assert dc["argument_bytes"] > 0 and dc["temp_bytes"] > 0
+
+        # the KV arena is deterministic: 2 leaves (k and v) per layer x
+        # max_batch x max_seq x d_model x 4 bytes (fp32)
+        arena = hbm["arena"]
+        assert arena["kv_bytes"] == 2 * 2 * 2 * 64 * 32 * 4
+        assert arena["bytes_per_slot"] == arena["kv_bytes"] // 2
+        assert arena["headroom_bytes"] == \
+            arena["n_free"] * arena["bytes_per_slot"]
+        assert arena["arena_bytes"] >= arena["kv_bytes"]
+
+        pf = hbm["prefill_top_bucket"]
+        assert pf is None or pf["argument_bytes"] > 0
+        assert hbm["live"]["n_arrays"] > 0
+
+    def test_live_array_census(self, tiny_engine):
+        census = tel.live_array_census()
+        assert census["n_arrays"] > 0
+        sizes = [b["bytes"] for b in census["blocks"]]
+        assert sizes == sorted(sizes, reverse=True)
+        top1 = tel.live_array_census(top=1)
+        assert len(top1["blocks"]) == 1
+        assert top1["total_bytes"] == census["total_bytes"]
+
+    def test_format_bytes(self):
+        assert tel.format_bytes(None) == "?"
+        assert tel.format_bytes(512) == "512B"
+        assert tel.format_bytes(2048) == "2.0KiB"
+        assert tel.format_bytes(3 * 1024 ** 3) == "3.0GiB"
+
+
+# ------------------------------------ readiness flips (real frontend)
+class TestReadinessIntegration:
+    def test_ready_flips_on_injected_driver_crash(self, tiny_engine):
+        from deepspeed_tpu.serving import ServingEngine
+        serving = ServingEngine(engine=tiny_engine, max_batch=2,
+                                max_prompt_len=16, max_queue=16,
+                                decode_chunk=4)
+
+        def boom(*a, **k):
+            raise RuntimeError("injected decode fault")
+
+        serving._jit_decode_chunk = boom
+        fe = ServingFrontend(serving)
+        monitor = HealthMonitor(frontend=fe)
+        server = MetricsServer(health=monitor)
+        try:
+            assert monitor.check()[0] is True
+            with urllib.request.urlopen(f"{server.url}/readyz",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+            h = fe.submit(np.arange(1, 5, dtype=np.int32),
+                          max_new_tokens=8)
+            assert h.result(timeout=30) == "error"
+            ready, reasons, details = monitor.check()
+            assert not ready and "driver_crashed" in reasons
+            assert "injected decode fault" in details["crash_error"]
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/readyz", timeout=5)
+            assert exc.value.code == 503
+            assert "driver_crashed" in json.loads(
+                exc.value.read())["reasons"]
+        finally:
+            server.stop()
+            fe.close(timeout=5)
+
+    def test_ready_flips_on_watchdog_timeout(self):
+        release = threading.Event()
+
+        def hang():
+            release.wait(30.0)
+        wd = BackendWatchdog(heartbeat_fn=hang, timeout_s=0.05)
+        monitor = HealthMonitor(watchdog=wd)
+        try:
+            assert monitor.check()[0] is True
+            wd.beat()
+            ready, reasons, _ = monitor.check()
+            assert not ready and reasons == ["backend_unresponsive"]
+        finally:
+            release.set()
