@@ -1,10 +1,14 @@
 #!/usr/bin/env bash
 # Serving smoke: tiny-model serving benchmark comparing the per-token
 # decode loop (decode_chunk=1) against the fused K-step loop
-# (decode_chunk=8), asserting bit-identical greedy outputs between them.
+# (decode_chunk=8), asserting bit-identical greedy outputs between them,
+# plus the --paged A/B (block-pool KV vs dense arena, bit-identical
+# greedy asserted; pinned paged retrace budget) and the shared-prefix
+# workload (N requests, one common prompt: prefill executed exactly
+# once, effective-concurrency multiplier >= 2 at equal KV HBM).
 # Writes BENCH_serving.json (tokens/s for both loops, chunk_speedup,
-# prefill padding waste) at the repo root and exits nonzero on parity
-# failure or any crash — fast enough for the tier-1 tier.
+# prefill padding waste, the paged block) at the repo root and exits
+# nonzero on parity failure or any crash — fast enough for tier-1.
 #
 # Usage: bin/serving_smoke.sh        (from the repo root, or anywhere)
 
@@ -13,5 +17,5 @@ cd "$(dirname "$0")/.." || exit 1
 exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m deepspeed_tpu.benchmarks.serving_bench \
     --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
-    --decode-chunk 8 --skip-sequential \
+    --decode-chunk 8 --skip-sequential --paged \
     --out-dir /tmp/serving_smoke_csv --json-out BENCH_serving.json

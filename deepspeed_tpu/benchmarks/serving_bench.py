@@ -45,6 +45,15 @@ import numpy as np
 #: (tests/test_tracelint.py) and the bench fails beyond it.
 DECODE_PROGRAM_BUDGET = 3
 
+#: the PAGED chunk program's pinned compile count: the initial trace plus
+#: ONE carry retrace (a chunk's donated-output pool differs in buffer
+#: metadata from the insert-built one). The dense budget's third compile
+#: never happens here — the paged insert scatters through the block table
+#: into a pool whose metadata is identical either way, so the insert
+#: program retraces instead of the chunk program. CI asserts this exact
+#: count (tests/test_tracelint.py) and the bench fails beyond it.
+PAGED_DECODE_PROGRAM_BUDGET = 2
+
 
 def _tiny_model(vocab_size=512, max_seq_len=64):
     """Small enough that per-step host overhead (dispatch + sync + python
@@ -87,6 +96,77 @@ def _timed_serving_run(serving, prompts, max_new_tokens):
     return results, dt, sum(len(r.tokens) for r in results), phases
 
 
+def _shared_prefix_case(engine, max_seq_len: int, n_requests: int = 8,
+                        max_new_tokens: int = 8, block_size: int = 16,
+                        seed: int = 3) -> dict:
+    """The paged headline: N requests sharing one long common prompt on a
+    FRESH paged engine. Request 1 misses and prefills; its prompt blocks
+    are published to the prefix cache, so requests 2..N admit as hits —
+    prefill runs EXACTLY once, full prompt blocks are shared by refcount,
+    and each hit privatizes only the partial tail block by COW. The
+    effective-concurrency multiplier is peak concurrent sequences times
+    blocks-per-seq over peak blocks actually used: how many more
+    sequences the same KV HBM held compared to dense slots."""
+    from ..serving import ServingEngine
+
+    blocks_per_seq = max_seq_len // block_size
+    # partial tail: a prompt that does NOT block-align exercises COW
+    prompt_len = max_seq_len - max_new_tokens - block_size // 4
+    rng = np.random.default_rng(seed)
+    common = rng.integers(0, engine.module.cfg.vocab_size,
+                          (prompt_len,)).astype(np.int32)
+    prompts = [common.copy() for _ in range(n_requests)]
+
+    serving = ServingEngine(engine=engine, max_batch=n_requests,
+                            max_prompt_len=prompt_len,
+                            prefill_buckets=(prompt_len,),
+                            max_queue=n_requests, paged=True,
+                            kv_block_size=block_size)
+    t0 = time.perf_counter()
+    results = serving.run(prompts, max_new_tokens=max_new_tokens)
+    dt = time.perf_counter() - t0
+
+    m = serving.metrics
+    rep = serving.kv.arena_report()
+    alloc = serving.kv.allocator
+    outputs_identical = all(
+        np.array_equal(results[0].output_ids, r.output_ids)
+        for r in results[1:])
+    multiplier = (alloc.peak_active * blocks_per_seq
+                  / max(1, rep["blocks_peak_used"]))
+    if m.n_prefix_hits != n_requests - 1:
+        raise RuntimeError(
+            f"shared-prefix workload expected {n_requests - 1} prefix "
+            f"cache hits, got {m.n_prefix_hits} — prefill was not shared")
+    if m.prefill_padded_tokens != prompt_len:
+        raise RuntimeError(
+            f"shared prefill ran more than once: {m.prefill_padded_tokens} "
+            f"padded tokens prefetched for a {prompt_len}-token prompt")
+    if multiplier < 2.0:
+        raise RuntimeError(
+            f"effective_seq_multiplier {multiplier:.2f} < 2.0 — prefix "
+            "sharing is not holding more sequences in the same KV HBM")
+    return {
+        "n_requests": n_requests,
+        "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens,
+        "block_size": block_size,
+        "wall_s": round(dt, 4),
+        "prefix_cache_hits": m.n_prefix_hits,
+        "prefix_cache_misses": m.n_prefix_misses,
+        "prefix_hit_rate": round(m.prefix_hit_rate, 4),
+        "cow_forks": m.n_cow_forks,
+        "prefill_programs": m.prefill_programs,
+        "prefill_prompt_tokens": m.prefill_prompt_tokens,
+        "peak_active_seqs": int(alloc.peak_active),
+        "blocks_peak_used": int(rep["blocks_peak_used"]),
+        "blocks_total": int(rep["blocks_total"]),
+        # >= 2.0 asserted: sequences held per unit of KV HBM vs dense
+        "effective_seq_multiplier": round(multiplier, 3),
+        "outputs_identical": outputs_identical,
+    }
+
+
 def _round_tree(obj, nd=6):
     if isinstance(obj, dict):
         return {k: _round_tree(v, nd) for k, v in obj.items()}
@@ -101,6 +181,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               out_dir: str = "serving_bench_csv", seed: int = 0,
               model=None, params=None,
               with_sequential: bool = True,
+              with_paged: bool = False,
               trace_out: str = None) -> dict:
     """Returns a result dict; writes serving metrics CSVs under
     ``out_dir`` through the monitor fan-out. ``prompt_len`` is the MAX
@@ -226,6 +307,64 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             f"decode_chunk={decode_chunk} — the fused loop must be "
             "bit-identical")
 
+    # ---- paged KV A/B (--paged): block-table pool vs dense arena -------
+    # Same model, same prompts, same chunk config; the prefix cache is
+    # OFF here so the A/B isolates the block-table gather/scatter cost
+    # (the cache's win is measured by the shared-prefix case below, where
+    # it is the point). The paged chunk program has its OWN pinned
+    # compile budget — asserted exactly like the dense one.
+    paged_out = None
+    if with_paged:
+        pg_auditor = TraceAuditor(
+            budgets={"decode_chunk_paged_fn": PAGED_DECODE_PROGRAM_BUDGET},
+            audit_jaxprs=False)
+        with pg_auditor:
+            paged_eng = ServingEngine(engine=engine, max_batch=max_batch,
+                                      max_prompt_len=prompt_len,
+                                      decode_chunk=decode_chunk,
+                                      max_queue=max(n_requests, 8),
+                                      paged=True, prefix_cache=False)
+            pg_results, pg_dt, pg_tokens, _pg_phases = _timed_serving_run(
+                paged_eng, prompts, max_new_tokens)
+        pg_tps = pg_tokens / pg_dt
+        paged_compiles = pg_auditor.compiles("decode_chunk_paged_fn")
+        if paged_compiles != PAGED_DECODE_PROGRAM_BUDGET:
+            raise RuntimeError(
+                f"paged decode_chunk compiled {paged_compiles}x, expected "
+                f"exactly {PAGED_DECODE_PROGRAM_BUDGET} (initial trace + "
+                "one carry retrace) — block tables or pool metadata are "
+                "leaking shape/type variation into the chunk program")
+        paged_parity = all(
+            np.array_equal(a.output_ids, b.output_ids)
+            for a, b in zip(ck_results, pg_results))
+        if not paged_parity:
+            raise RuntimeError(
+                "greedy outputs diverged between the dense arena and the "
+                "paged block pool — paged KV must be bit-identical")
+        rep = paged_eng.kv.arena_report()
+        # shared-prefix workload on a FRESH paged engine, outside the
+        # audited region (its own prefill bucket compiles lazily)
+        shared = _shared_prefix_case(engine, paged_eng.max_seq_len)
+        paged_out = {
+            "greedy_parity": paged_parity,
+            "paged_s": round(pg_dt, 4),
+            "paged_tokens_per_s": round(pg_tps, 2),
+            "paged_vs_chunked": round(pg_tps / ck_tps, 3),
+            "decode_chunk_compiles": paged_compiles,
+            "decode_chunk_budget": PAGED_DECODE_PROGRAM_BUDGET,
+            "block_pool": {
+                "block_size": rep["block_size"],
+                "bytes_per_block": rep["bytes_per_block"],
+                "blocks_total": rep["blocks_total"],
+                "blocks_peak_used": rep["blocks_peak_used"],
+                "blocks_per_seq": rep["blocks_per_seq"],
+                # pool bytes == dense arena bytes by construction: the
+                # A/B and the shared-prefix multiplier are at equal HBM
+                "arena_bytes": rep["arena_bytes"],
+            },
+            "shared_prefix": shared,
+        }
+
     ttfts = [r.ttft_s for r in ck_results if r.ttft_s is not None]
     csv_dir = os.path.join(out_dir, "serving_bench")
     out = {
@@ -257,6 +396,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
                             "chunked": _round_tree(ck_phases)},
         "mfu": _round_tree(mfu) if mfu else None,
         "hbm": _round_tree(hbm) if hbm else None,
+        "paged": paged_out,
         "trace_file": trace_out,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],
@@ -274,6 +414,11 @@ def main(argv=None):
     ap.add_argument("--skip-sequential", action="store_true",
                     help="skip the N-sequential-generate baseline "
                     "(smoke runs compare only the two serving loops)")
+    ap.add_argument("--paged", action="store_true",
+                    help="also A/B the paged block-pool KV cache against "
+                    "the dense arena (bit-identical greedy asserted) and "
+                    "run the shared-prefix workload (N requests, one "
+                    "common prompt, prefill executed once)")
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
     ap.add_argument("--trace-out", type=str, default=None,
@@ -289,6 +434,7 @@ def main(argv=None):
                        decode_chunk=args.decode_chunk,
                        out_dir=args.out_dir, seed=args.seed,
                        with_sequential=not args.skip_sequential,
+                       with_paged=args.paged,
                        trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:

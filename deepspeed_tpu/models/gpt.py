@@ -59,6 +59,25 @@ def _kv_write(cache, kv, cur):
     return jax.vmap(row)(cache, kv, cur)
 
 
+def _kv_write_paged(pool, kv, block_tables, cur):
+    """Paged counterpart of :func:`_kv_write`: scatter one token's k/v
+    through each row's block table. ``pool`` [nb, bs, h*d] is the shared
+    block pool, ``kv`` [b, h*d] this step's flattened k or v,
+    ``block_tables`` [b, T], ``cur`` [b] per-row write positions. The
+    masked-lane sentinel (``cur >= T*bs == max_seq_len``) routes to the
+    out-of-range flat index ``nb*bs`` and drops — same contract as the
+    dense path, but through the scatter's ``mode="drop"`` instead of a
+    per-row select."""
+    nb, bs, hd = pool.shape
+    b, T = block_tables.shape
+    cur = jnp.broadcast_to(jnp.asarray(cur, jnp.int32), (b,))
+    blk = jnp.take_along_axis(
+        block_tables, jnp.clip(cur // bs, 0, T - 1)[:, None], axis=1)[:, 0]
+    flat = jnp.where(cur < T * bs, blk * bs + cur % bs, nb * bs)
+    return pool.reshape(nb * bs, hd).at[flat].set(
+        kv.reshape(b, hd), mode="drop").reshape(nb, bs, hd)
+
+
 def _sp_constraint(x, spec_parts):
     """Ulysses sharding constraint against the global mesh (no-op when the
     mesh's sp axis is 1). Axes the shape doesn't divide are dropped —
@@ -393,6 +412,11 @@ class SelfAttention(nn.Module):
         passed by the caller must equal the per-row fills."""
         cfg = self.cfg
         b, s, h, d = q.shape
+        if self.has_variable("cache", "block_tables"):
+            # paged block-pool cache (serving/paged_kv.py): the engine
+            # injected per-slot block tables, so reads and writes route
+            # through them instead of slot rows
+            return self._paged_decode_attention(q, k, v)
         impl = cfg.decode_impl
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
@@ -439,6 +463,45 @@ class SelfAttention(nn.Module):
             return decode_attention(q, ck.value, cv.value, cur + s,
                                     scale=scale)
         return self._cache_einsum(q, ck.value, cv.value, cur, s, scale)
+
+    def _paged_decode_attention(self, q, k, v):
+        """Block-table decode (vLLM PagedAttention shape): the cache is a
+        flat block pool [nb, bs, h*d] shared by every slot; this slot's
+        blocks are named by its ``block_tables`` row. Writes scatter
+        through the table (:func:`_kv_write_paged`); attention gathers
+        through it (ops/pallas/decode_attention.paged_decode_attention —
+        the ``jnp.take`` reference path is bit-identical to the dense
+        masked einsum, the Pallas kernel DMAs per-(row, block)). Prefill
+        never runs here: it stays cacheless-dense and is scattered into
+        the pool by PagedKVCacheManager.insert_batch."""
+        cfg = self.cfg
+        b, s, h, d = q.shape
+        if s != 1:
+            raise NotImplementedError(
+                "paged KV decode is single-token only; prefill runs "
+                "through the dense path and is block-scattered on insert")
+        if self.window is not None:
+            raise NotImplementedError(
+                "paged KV decode has no local-window path")
+        impl = cfg.decode_impl
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        scale = (cfg.qk_scale if cfg.qk_scale is not None
+                 else 1.0 / math.sqrt(d))
+        idx = self.variable("cache", "cache_index")
+        ck = self.variable("cache", "cached_key")
+        cv = self.variable("cache", "cached_value")
+        bt = self.get_variable("cache", "block_tables")
+        cur = idx.value                       # [b] per-slot write positions
+        dt = ck.value.dtype
+        ck.value = _kv_write_paged(ck.value, k.astype(dt).reshape(b, h * d),
+                                   bt, cur)
+        cv.value = _kv_write_paged(cv.value, v.astype(dt).reshape(b, h * d),
+                                   bt, cur)
+        idx.value = cur + 1
+        from ..ops.pallas.decode_attention import paged_decode_attention
+        return paged_decode_attention(q, ck.value, cv.value, bt, cur + 1,
+                                      scale=scale, impl=impl)
 
     def _cache_einsum(self, q, ck, cv, cur, s, scale):
         from ..ops.pallas.decode_attention import masked_cache_attention

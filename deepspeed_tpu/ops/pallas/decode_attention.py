@@ -250,6 +250,178 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     return out.transpose(0, 2, 1).reshape(b, 1, h, d)
 
 
+# --------------------------------------------------------------------------
+# Paged decode attention: gather K/V through a per-row block table
+# --------------------------------------------------------------------------
+
+def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, k_hbm, v_hbm, o_ref,
+                         k_buf, v_buf, k_sem, v_sem, *,
+                         scale, b, hp, hd, bs, nb_total):
+    """Paged variant of :func:`_decode_kernel`. k_hbm/v_hbm are the FULL
+    block pools [nb_total, bs, h*d] in HBM; each fori step DMAs one
+    block PER ROW (rows no longer share a contiguous window — that is
+    the price of paging, paid as b strided copies per step instead of
+    one), double-buffered through [2, b, bs, h*d] VMEM with a (2, b)
+    semaphore grid. meta_ref: [1 + b] — [0] the live block count (max
+    over rows), [1 + bi] row bi's filled prefix. bt_ref: [b, T] block
+    tables (scalar-prefetch, so the DMA source indices are host-known
+    ints at issue time); entries past a row's reservation are clamped
+    into the pool and masked dead by the fill."""
+    nb = meta_ref[0]
+
+    def k_copy(i, slot, bi):
+        blk = jnp.minimum(bt_ref[bi, i], nb_total - 1)
+        return pltpu.make_async_copy(
+            k_hbm.at[blk], k_buf.at[slot, bi], k_sem.at[slot, bi])
+
+    def v_copy(i, slot, bi):
+        blk = jnp.minimum(bt_ref[bi, i], nb_total - 1)
+        return pltpu.make_async_copy(
+            v_hbm.at[blk], v_buf.at[slot, bi], v_sem.at[slot, bi])
+
+    for bi in range(b):                    # prologue: stage block 0
+        k_copy(0, 0, bi).start()
+        v_copy(0, 0, bi).start()
+
+    def body(i, carry):
+        m_prev, l_prev, acc = carry            # [b,hp] [b,hp] [b,hp,hd]
+        slot = jax.lax.rem(i, 2)
+        nxt = i + 1
+
+        @pl.when(nxt < nb)
+        def _prefetch():
+            ns = jax.lax.rem(nxt, 2)
+            for bi in range(b):
+                k_copy(nxt, ns, bi).start()
+                v_copy(nxt, ns, bi).start()
+
+        pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, hp), 0)
+        ms, ls, accs = [], [], []
+        for bi in range(b):                    # static unroll
+            k_copy(i, slot, bi).wait()
+            v_copy(i, slot, bi).wait()
+            live = pos < meta_ref[1 + bi]
+            kbk = k_buf[slot, bi].astype(jnp.float32)     # [bs, h*d]
+            vbk = v_buf[slot, bi].astype(jnp.float32)
+            qmat = qmat_ref[bi].astype(jnp.float32)       # [h*d, hp]
+            s = jax.lax.dot(kbk, qmat,
+                            preferred_element_type=jnp.float32) * scale
+            s = jnp.where(live, s, NEG_INF)
+            m_new = jnp.maximum(m_prev[bi], jnp.max(s, axis=0))
+            p = jnp.exp(s - m_new[None, :])
+            corr = jnp.exp(m_prev[bi] - m_new)
+            l_new = l_prev[bi] * corr + jnp.sum(p, axis=0)
+            pv = jax.lax.dot_general(p, vbk, (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ms.append(m_new)
+            ls.append(l_new)
+            accs.append(acc[bi] * corr[:, None] + pv)
+        return (jnp.stack(ms), jnp.stack(ls), jnp.stack(accs))
+
+    m0 = jnp.full((b, hp), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hp), jnp.float32)
+    a0 = jnp.zeros((b, hp, hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[...] = (acc / l_safe[:, :, None]).astype(o_ref.dtype)
+
+
+def paged_decode_supported(b: int, block_size: int, h: int, d: int,
+                           dtype) -> bool:
+    """Kernel feasibility for the paged layout: lane-aligned h*d,
+    sublane-aligned block_size (the DMA unit), and the double-buffered
+    staging window within the VMEM budget."""
+    if (h * d) % 128 != 0:
+        return False
+    itemsize = jnp.dtype(dtype).itemsize
+    sublane = max(8, 32 // itemsize)
+    if block_size % sublane != 0:
+        return False
+    return 4 * b * block_size * h * d * itemsize <= _VMEM_BUDGET
+
+
+def paged_gather_kv(pool: jnp.ndarray,
+                    block_tables: jnp.ndarray) -> jnp.ndarray:
+    """Reference gather: pool [nb, bs, h*d] through block_tables [b, T]
+    -> [b, T*bs, h*d]. Position p of row i reads flat pool index
+    ``block_tables[i, p//bs]*bs + p%bs``; table entries past a row's
+    reservation point at whatever block they name (zeros-padded tables
+    read block 0) — those positions sit past the row's fill and are
+    masked by the caller, so garbage is gathered but never attended."""
+    nb, bs, hd = pool.shape
+    b, T = block_tables.shape
+    p = jnp.arange(T * bs)
+    blk = jnp.take(block_tables, p // bs, axis=1)            # [b, S]
+    flat = blk * bs + (p % bs)[None, :]
+    return jnp.take(pool.reshape(nb * bs, hd), flat, axis=0,
+                    mode="clip")
+
+
+def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
+                           v_pool: jnp.ndarray, block_tables: jnp.ndarray,
+                           cache_len, scale: Optional[float] = None,
+                           impl: str = "xla") -> jnp.ndarray:
+    """Single-token decode attention over a PAGED cache. q: [b, 1, h, d];
+    k_pool/v_pool: [nb, bs, h*d] block pools; block_tables: [b, T];
+    cache_len: valid positions per row (including this token, already
+    written) — scalar or [b], sentinel entries past T*bs are clamped.
+
+    The reference path (CPU / unsupported shapes) gathers the pool
+    through the table and calls the SAME masked einsum as the dense
+    decode path — gathered values are bit-identical to the dense
+    arena's rows, masked positions underflow to exact zeros, so greedy
+    outputs are bit-identical to the dense oracle (the tier-1 parity
+    gate). The Pallas path DMAs per-(row, block) through the table —
+    compute and HBM traffic stay O(cache_len) per token."""
+    b, s_q, h, d = q.shape
+    nb, bs, hd = k_pool.shape
+    T = block_tables.shape[1]
+    S = T * bs
+    clen = jnp.minimum(
+        jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (b,)), S)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if (impl == "pallas" and s_q == 1
+            and paged_decode_supported(b, bs, h, d, k_pool.dtype)):
+        hp = -(-h // 8) * 8
+        qt = q[:, 0]
+        eye = jnp.eye(h, hp, dtype=q.dtype)
+        qmat = jnp.einsum("bhd,hg->bhdg", qt, eye).reshape(b, hd, hp)
+        nb_live = jnp.clip((jnp.max(clen) + bs - 1) // bs, 1, T)
+        meta = jnp.concatenate([nb_live[None], clen])
+        kernel = functools.partial(
+            _paged_decode_kernel, scale=scale, b=b, hp=hp, hd=hd,
+            bs=bs, nb_total=nb)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,          # meta + block tables
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((b, hd, hp), lambda g, meta, bt: (0, 0, 0)),
+                pl.BlockSpec(memory_space=_MEM_HBM),
+                pl.BlockSpec(memory_space=_MEM_HBM),
+            ],
+            out_specs=pl.BlockSpec((b, hp, hd),
+                                   lambda g, meta, bt: (0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, b, bs, hd), k_pool.dtype),
+                pltpu.VMEM((2, b, bs, hd), v_pool.dtype),
+                pltpu.SemaphoreType.DMA((2, b)),
+                pltpu.SemaphoreType.DMA((2, b)),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel, grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, hp, hd), q.dtype),
+            interpret=interpret_mode(),
+        )(meta, block_tables.astype(jnp.int32), qmat, k_pool, v_pool)
+        out = out[:, :h].reshape(b, h, h, d)
+        out = jnp.diagonal(out, axis1=1, axis2=2)           # [b, d, h]
+        return out.transpose(0, 2, 1).reshape(b, 1, h, d)
+    kf = paged_gather_kv(k_pool, block_tables).reshape(b, S, h, d)
+    vf = paged_gather_kv(v_pool, block_tables).reshape(b, S, h, d)
+    return masked_cache_attention(q, kf, vf, clen - s_q, scale)
+
+
 def masked_cache_attention(q, ck, cv, first_q_pos, scale, window=None):
     """The ONE masked-einsum cache attention (shared by the kernel's XLA
     fallback and the model's prefill/window paths, so the two can't drift):

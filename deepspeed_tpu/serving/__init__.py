@@ -7,9 +7,11 @@ scheduling, and a two-program jit discipline. See docs/serving.md.
 """
 
 from .kv_cache import SlotAllocator, SlotKVCacheManager  # noqa: F401
+from .paged_kv import (BlockAllocator, PagedKVCacheManager,  # noqa: F401
+                       PagedSlotAllocator, PrefixCache)
 from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
-                        REJECT_DEADLINE_EXPIRED, REJECT_PROMPT_TOO_LONG,
-                        REJECT_QUEUE_FULL)
+                        REJECT_DEADLINE_EXPIRED, REJECT_KV_OOM,
+                        REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL)
 from .metrics import (Reservoir, ServingMetrics,  # noqa: F401
                       csv_monitor_master)
 from .engine import ServingEngine  # noqa: F401

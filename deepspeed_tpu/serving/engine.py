@@ -126,6 +126,11 @@ class ServingEngine:
                  monitor=None,
                  emit_every_steps: int = 16,
                  seed: int = 0,
+                 paged: bool = False,
+                 kv_block_size: int = 16,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefix_cache_capacity: int = 64,
                  **inference_kwargs):
         import jax
         import jax.numpy as jnp
@@ -161,8 +166,19 @@ class ServingEngine:
         self.temperature = float(temperature)
         self.top_k = top_k
 
-        self.kv = SlotKVCacheManager(self.module, engine.params,
-                                     self.max_batch)
+        self.paged = bool(paged)
+        if self.paged:
+            from .paged_kv import PagedKVCacheManager
+            # prefix reuse replays a stored first token, which is only
+            # faithful when sampling is deterministic — greedy only
+            self.kv = PagedKVCacheManager(
+                self.module, engine.params, self.max_batch,
+                block_size=kv_block_size, num_blocks=kv_pool_blocks,
+                prefix_cache_capacity=prefix_cache_capacity,
+                prefix_caching=prefix_cache and self.temperature == 0.0)
+        else:
+            self.kv = SlotKVCacheManager(self.module, engine.params,
+                                         self.max_batch)
         self.scheduler = ContinuousBatchScheduler(
             self.kv.allocator, max_queue=max_queue,
             max_prompt_len=self.max_prompt_len)
@@ -201,6 +217,11 @@ class ServingEngine:
 
         def decode(params, cache, tokens, positions, rng):
             pm = mat(params)
+            # pin the write cursor exactly like the chunk program: idle
+            # lanes carry the max_seq sentinel (positions from
+            # _decode_once), so a paged lane's stale block table can never
+            # route a speculative write into a re-leased block
+            cache = _with_write_index(cache, positions)
             logits, vc = module.apply(
                 {"params": pm, "cache": cache}, tokens[:, None],
                 positions=positions[:, None], mutable=["cache"])
@@ -259,18 +280,35 @@ class ServingEngine:
         self._jit_prefill = jax.jit(prefill)
         # donate the arena: XLA updates every slot's KV rows in place
         self._jit_decode = jax.jit(decode, donate_argnums=(1,))
-        self._jit_decode_chunk = jax.jit(decode_chunk_fn, donate_argnums=(1,))
+        if self.paged:
+            # distinct function name => distinct TraceAuditor budget: the
+            # paged chunk program's retrace count is pinned separately
+            # from the dense decode_chunk_fn == 3 budget
+            def decode_chunk_paged_fn(params, cache, tokens, positions,
+                                      active, eos, remaining, rng):
+                return decode_chunk_fn(params, cache, tokens, positions,
+                                       active, eos, remaining, rng)
+            self._jit_decode_chunk = jax.jit(decode_chunk_paged_fn,
+                                             donate_argnums=(1,))
+        else:
+            self._jit_decode_chunk = jax.jit(decode_chunk_fn,
+                                             donate_argnums=(1,))
         # arena-size gauges at init: the KV footprint is fixed for the
         # engine's lifetime, headroom varies (re-gauged per chunk)
         arena = self.kv.arena_report()
-        self._arena_bytes_per_slot = arena["bytes_per_slot"]
         telemetry.gauge("serve/arena_bytes", float(arena["arena_bytes"]))
         telemetry.gauge("serve/arena_headroom_bytes",
                         float(arena["headroom_bytes"]))
+        if self.paged:
+            self._bytes_per_block = arena["bytes_per_block"]
+            self._gauge_block_pool()
+        else:
+            self._arena_bytes_per_slot = arena["bytes_per_slot"]
         log_dist(f"serving engine ready: slots={self.max_batch} "
                  f"prefill_buckets={self._buckets} "
                  f"decode_chunk={self.decode_chunk} "
-                 f"max_seq={max_seq}", ranks=[0])
+                 f"max_seq={max_seq} "
+                 f"kv={'paged' if self.paged else 'dense'}", ranks=[0])
 
     # --------------------------------------------------------------- API
     def submit(self, prompt: Union[Request, Sequence[int], np.ndarray],
@@ -468,13 +506,63 @@ class ServingEngine:
         return self._buckets[-1]    # unreachable: submit() length guard
 
     def _admit(self) -> None:
-        """Admit every currently-runnable request: group by prefill
-        bucket, ONE batched prefill call per bucket group, one fused
-        batched arena insert per group."""
-        import jax.numpy as jnp
+        """Admit every currently-runnable request. Dense: group by
+        prefill bucket, ONE batched prefill per group, one fused arena
+        insert per group. Paged: prefix-cache HITS skip prefill entirely
+        (a block-table fork + the cached first token); MISSES take the
+        dense prefill path, block-scattered on insert, then publish
+        their prompt blocks to the prefix cache. Hit forks dispatch
+        BEFORE miss inserts — dispatch order is the device write order,
+        so a fork's COW source is copied before anything could recycle
+        its block."""
         admitted = self.scheduler.admit()
         if not admitted:
             return
+        if not self.paged:
+            self._prefill_admit(admitted)
+            return
+        hits: List[Tuple[Request, Any]] = []
+        misses: List[Tuple[Request, Any]] = []
+        for req in admitted:
+            plan = self.kv.take_plan(req.slot)
+            (hits if plan.hit else misses).append((req, plan))
+        for req, plan in hits:
+            self._admit_prefix_hit(req, plan)
+        if misses:
+            self._prefill_admit([r for r, _ in misses],
+                                plans={r.slot: p for r, p in misses})
+        self._gauge_block_pool()
+
+    def _admit_prefix_hit(self, req: Request, plan) -> None:
+        """A cached prompt: share its full blocks, COW its tail, replay
+        the stored first token. No prefill program runs — the whole
+        admission is one small fork dispatch."""
+        with telemetry.span("serve/prefix_fork", slot=req.slot,
+                            n_shared=plan.n_shared):
+            self.kv.apply_fork(plan)
+        telemetry.count("serve/prefix_cache_hit")
+        self.metrics.on_prefix(True)
+        if plan.cow is not None:
+            telemetry.instant("serve/cow_fork", slot=req.slot)
+            self.metrics.on_cow()
+        first = int(plan.first_token)
+        self._last_token[req.slot] = first
+        self.metrics.on_tokens(1)
+        self.scheduler.record_first_token(req, first)
+        if self.decode_chunk > 1:
+            self._record_admit_patch(req)
+
+    def _gauge_block_pool(self) -> None:
+        blocks = self.kv.allocator.blocks
+        telemetry.gauge("serve/block_pool_used", float(blocks.n_used))
+        telemetry.gauge("serve/block_pool_free", float(blocks.n_free))
+
+    def _prefill_admit(self, admitted: List[Request],
+                       plans: Optional[Dict[int, Any]] = None) -> None:
+        """Bucketed batched prefill + fused cache insert for ``admitted``
+        (the dense path verbatim; paged misses ride it too, with the
+        block-scatter insert and a prefix-cache commit per request)."""
+        import jax.numpy as jnp
         groups: Dict[int, List[Request]] = {}
         for req in admitted:
             groups.setdefault(self._bucket_for(req.prompt_len),
@@ -508,6 +596,17 @@ class ServingEngine:
             for i, r in enumerate(reqs):
                 first = int(toks_host[i])
                 self._last_token[r.slot] = first
+                if plans is not None:
+                    # publish the prompt blocks BEFORE the request can
+                    # retire (retiring frees its slot refs; the cache
+                    # holds its own) — may dispatch the tail COW copy
+                    cow = self.kv.commit_prefix(plans[r.slot], first)
+                    if self.kv.prefix_enabled:
+                        telemetry.count("serve/prefix_cache_miss")
+                        self.metrics.on_prefix(False)
+                    if cow is not None:
+                        telemetry.instant("serve/cow_fork", slot=r.slot)
+                        self.metrics.on_cow()
                 # may retire the request immediately (max_new_tokens == 1
                 # or an instant EOS) — its slot frees before any decode
                 self.scheduler.record_first_token(r, first)
@@ -536,7 +635,13 @@ class ServingEngine:
             return
         slots = sorted(running)
         tokens = np.zeros(self.max_batch, np.int32)
-        positions = np.zeros(self.max_batch, np.int32)
+        # paged: idle lanes pin the max_seq sentinel so their speculative
+        # writes DROP — a stale block-table row may point at a block
+        # already re-leased to another slot, so a dense-style position-0
+        # write would corrupt a live request (the dense arena tolerates
+        # it: each slot owns its row, and fill masks the stale entry)
+        positions = np.full(self.max_batch, self.max_seq_len, np.int32) \
+            if self.paged else np.zeros(self.max_batch, np.int32)
         for s in slots:
             tokens[s] = self._last_token[s]
             positions[s] = self.kv.fill[s]
@@ -654,9 +759,15 @@ class ServingEngine:
         telemetry.gauge("serve/queue_depth",
                         float(self.scheduler.queue_depth))
         telemetry.gauge("serve/occupancy", float(self.kv.occupancy))
-        telemetry.gauge("serve/arena_headroom_bytes",
-                        float(self.kv.allocator.n_free
-                              * self._arena_bytes_per_slot))
+        if self.paged:
+            self._gauge_block_pool()
+            telemetry.gauge("serve/arena_headroom_bytes",
+                            float(self.kv.allocator.blocks.n_free
+                                  * self._bytes_per_block))
+        else:
+            telemetry.gauge("serve/arena_headroom_bytes",
+                            float(self.kv.allocator.n_free
+                                  * self._arena_bytes_per_slot))
         self.metrics.on_tokens(n_tokens)
         self.metrics.on_decode_step()
         self.metrics.on_finished(finished)

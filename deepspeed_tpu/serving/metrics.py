@@ -24,6 +24,15 @@ Labels:
                             program shapes so far (the compile-cache cost
                             of bucketed prefill, watched so it stays
                             bounded)
+  serving/prefix_cache_hits admissions served from the paged prefix cache
+                            (prefill skipped; blocks shared COW)
+  serving/prefix_cache_misses
+                            paged admissions that ran a real prefill
+                            (0 for both in dense mode)
+  serving/prefix_hit_rate   hits / (hits + misses), 0.0 before the first
+                            paged admission
+  serving/cow_forks         copy-on-write block forks (a shared partial
+                            block privatized for one request)
 """
 
 from __future__ import annotations
@@ -111,6 +120,9 @@ class ServingMetrics:
         self.prefill_prompt_tokens = 0
         self.prefill_padded_tokens = 0
         self.prefill_programs = 0
+        self.n_prefix_hits = 0
+        self.n_prefix_misses = 0
+        self.n_cow_forks = 0
 
     # ----------------------------------------------------------- recording
     def start(self) -> None:
@@ -144,6 +156,17 @@ class ServingMetrics:
         self.prefill_padded_tokens += int(n_prompts) * int(bucket_len)
         self.prefill_programs = int(n_programs)
 
+    def on_prefix(self, hit: bool) -> None:
+        """One paged admission resolved against the prefix cache."""
+        if hit:
+            self.n_prefix_hits += 1
+        else:
+            self.n_prefix_misses += 1
+
+    def on_cow(self) -> None:
+        """One copy-on-write block fork (shared tail privatized)."""
+        self.n_cow_forks += 1
+
     # ------------------------------------------------------------ reading
     @property
     def padding_waste(self) -> float:
@@ -163,6 +186,11 @@ class ServingMetrics:
         dt = self.clock() - self.t0
         return self.tokens_out / dt if dt > 0 else 0.0
 
+    @property
+    def prefix_hit_rate(self) -> float:
+        n = self.n_prefix_hits + self.n_prefix_misses
+        return self.n_prefix_hits / n if n else 0.0
+
     def snapshot(self, queue_depth: int, occupancy: float) -> Dict[str, float]:
         pct = self.ttft_reservoir.percentiles((50, 95, 99))
         return {
@@ -177,6 +205,10 @@ class ServingMetrics:
             "serving/rejected_total": float(self.rejected),
             "serving/prefill_padding_waste": float(self.padding_waste),
             "serving/prefill_programs": float(self.prefill_programs),
+            "serving/prefix_cache_hits": float(self.n_prefix_hits),
+            "serving/prefix_cache_misses": float(self.n_prefix_misses),
+            "serving/prefix_hit_rate": float(self.prefix_hit_rate),
+            "serving/cow_forks": float(self.n_cow_forks),
         }
 
     # ------------------------------------------------------------ emitting

@@ -35,6 +35,7 @@ import numpy as np
 REJECT_QUEUE_FULL = "queue_full"
 REJECT_PROMPT_TOO_LONG = "prompt_too_long"
 REJECT_DEADLINE_EXPIRED = "deadline_expired"
+REJECT_KV_OOM = "kv_blocks_exhausted"
 
 _uid_counter = itertools.count()
 
@@ -116,6 +117,13 @@ class ContinuousBatchScheduler:
             and req.prompt_len + req.max_new_tokens > seq_cap)
         if too_long:
             return self._reject(req, REJECT_PROMPT_TOO_LONG)
+        # paged allocators expose a finite token pool: a request no EMPTY
+        # pool could hold can never be admitted — reject-with-reason now
+        # instead of wedging the FIFO head forever
+        pool_cap = getattr(self.allocator, "pool_capacity_tokens", None)
+        if (pool_cap is not None
+                and req.prompt_len + req.max_new_tokens > pool_cap):
+            return self._reject(req, REJECT_KV_OOM)
         # an already-expired deadline can never be met: reject here rather
         # than admit, prefill, and kill at the first chunk boundary
         if req.deadline_s is not None and req.submit_t >= req.deadline_s:
@@ -146,7 +154,7 @@ class ContinuousBatchScheduler:
                 self.queue.popleft()
                 self._finish(req, "expired")
                 continue
-            slot = self.allocator.alloc(req.prompt_len)
+            slot = self._lease(req)
             if slot is None:
                 break
             self.queue.popleft()
@@ -155,6 +163,15 @@ class ContinuousBatchScheduler:
             self.running[slot] = req
             admitted.append(req)
         return admitted
+
+    def _lease(self, req: Request) -> Optional[int]:
+        """Request-shaped lease when the allocator supports it (the paged
+        allocator plans block reservations / prefix sharing per request);
+        plain fill-length lease otherwise (the dense slot arena)."""
+        alloc_request = getattr(self.allocator, "alloc_request", None)
+        if alloc_request is not None:
+            return alloc_request(req)
+        return self.allocator.alloc(req.prompt_len)
 
     # ---------------------------------------------------------- lifecycle
     def record_first_token(self, req: Request, token: int) -> None:

@@ -11,8 +11,11 @@ client library, no new dependency: the format is lines of
 Mapping (namespace prefix ``dstpu`` by default):
 
 * runtime counters   -> ``dstpu_<name>_total``           (counter)
+  (e.g. the paged KV ``serve/prefix_cache_hit|miss`` counters)
 * runtime gauges     -> ``dstpu_<name>``                 (gauge)
+  (e.g. ``serve/block_pool_used|free`` — live block-pool occupancy)
 * runtime instants   -> ``dstpu_<name>_events_total``    (counter)
+  (e.g. ``serve/cow_fork`` — copy-on-write block privatizations)
 * runtime span stats -> ``dstpu_span_<name>_seconds``    (summary:
   p50/p95/p99 quantiles + ``_count``/``_sum``)
 * TraceLog histograms-> ``dstpu_frontend_<name>_seconds``(summary)

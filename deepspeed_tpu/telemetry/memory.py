@@ -21,7 +21,11 @@ Three layers:
 * arena/headroom gauges live on ``SlotKVCacheManager.arena_report()``
   (serving/kv_cache.py) and ``ServingEngine.estimate_hbm()`` — they
   feed the admission cost model and the ``hbm`` block in
-  ``BENCH_*.json`` that ``bin/benchdiff`` regresses on.
+  ``BENCH_*.json`` that ``bin/benchdiff`` regresses on. The paged
+  manager (serving/paged_kv.py) keeps the same report keys and adds
+  the block-pool view: ``bytes_per_block``, ``blocks_total/used/
+  free/peak_used`` and the prefix-cache share, surfaced live as the
+  ``serve/block_pool_used|free`` gauges on ``/metrics``.
 
 JAX is imported lazily — the module stays importable by the
 stdlib-only ``bin/`` launchers.

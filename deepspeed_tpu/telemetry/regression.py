@@ -85,6 +85,23 @@ SERVING_SPECS: List[MetricSpec] = [
     MetricSpec(("hbm", "decode_chunk", "argument_bytes"), LOWER, 0.25),
     MetricSpec(("hbm", "arena", "arena_bytes"), LOWER, 0.10,
                note="KV arena footprint is deterministic"),
+    # ---- paged block-pool KV (--paged A/B + shared-prefix workload) ----
+    MetricSpec(("paged", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="paged vs dense bit-exactness is binary"),
+    MetricSpec(("paged", "decode_chunk_compiles"), SHIFT, abs_tol=0.0,
+               note="pinned paged retrace budget"),
+    MetricSpec(("paged", "block_pool", "bytes_per_block"), SHIFT,
+               abs_tol=0.0, note="pool geometry is deterministic"),
+    MetricSpec(("paged", "block_pool", "blocks_total"), SHIFT,
+               abs_tol=0.0),
+    MetricSpec(("paged", "shared_prefix", "prefix_cache_hits"), SHIFT,
+               abs_tol=0.0,
+               note="N-1 hits or the shared prefill ran more than once"),
+    MetricSpec(("paged", "shared_prefix", "effective_seq_multiplier"),
+               HIGHER, 0.25,
+               note="sequences held per unit of KV HBM vs dense slots"),
+    MetricSpec(("paged", "shared_prefix", "prefix_hit_rate"), HIGHER,
+               0.10, abs_tol=0.05),
 ]
 
 FRONTEND_SPECS: List[MetricSpec] = [

@@ -340,6 +340,14 @@ def _serving_doc(**over):
         "hbm": {"decode_chunk": {"temp_bytes": 1 << 20,
                                  "argument_bytes": 1 << 21},
                 "arena": {"arena_bytes": 1 << 22}},
+        "paged": {
+            "greedy_parity": True,
+            "decode_chunk_compiles": 2,
+            "block_pool": {"bytes_per_block": 16384, "blocks_total": 32},
+            "shared_prefix": {"prefix_cache_hits": 7,
+                              "prefix_hit_rate": 0.875,
+                              "effective_seq_multiplier": 2.5},
+        },
     }
     doc.update(over)
     return doc

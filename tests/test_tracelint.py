@@ -416,6 +416,45 @@ def test_serving_decode_path_at_declared_budget():
     assert aud.records["decode_chunk_fn"].calls >= 6
 
 
+def test_paged_decode_path_at_declared_budget():
+    """The PAGED chunked-decode program has its own pinned budget
+    (initial trace + ONE carry retrace, see
+    benchmarks/serving_bench.PAGED_DECODE_PROGRAM_BUDGET): block tables
+    ride inside the cache pytree as ordinary int32 leaves, so admission
+    churn, prefix-cache hits and COW forks must never leak shape or
+    dtype variation into the chunk program."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import (
+        PAGED_DECODE_PROGRAM_BUDGET, _tiny_model)
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 7, 12, 4)]
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_paged_fn": PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4, paged=True, kv_block_size=16)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert (aud.compiles("decode_chunk_paged_fn")
+            == PAGED_DECODE_PROGRAM_BUDGET)
+    # runs 2 and 3 resubmit identical prompts: every admission after the
+    # first run is a prefix-cache hit, so the decode program keeps
+    # running while prefill never compiles a second shape
+    assert serving.metrics.n_prefix_hits >= 8
+    assert aud.records["decode_chunk_paged_fn"].calls >= 6
+
+
 def test_train_step_path_at_declared_budget():
     """The fused train step compiles exactly twice — the initial trace
     (freshly initialized state) plus one retrace when call 2 feeds back
