@@ -3,12 +3,18 @@
 # decode loop (decode_chunk=1) against the fused K-step loop
 # (decode_chunk=8), asserting bit-identical greedy outputs between them,
 # plus the --paged A/B (block-pool KV vs dense arena, bit-identical
-# greedy asserted; pinned paged retrace budget) and the shared-prefix
+# greedy asserted; pinned paged retrace budget), the shared-prefix
 # workload (N requests, one common prompt: prefill executed exactly
-# once, effective-concurrency multiplier >= 2 at equal KV HBM).
+# once, effective-concurrency multiplier >= 2 at equal KV HBM), the
+# --kv-dtype int8 A/B (quantized arena at <= half the fp bytes,
+# dense-int8 vs paged-int8 bit-identical), and the COMBINED
+# --speculative case over the int8 arena (self-drafted greedy outputs
+# bit-identical to the sequential loops, dense AND paged; >= 1.3x
+# tokens/s on the repetitive workload; acceptance rate reported).
 # Writes BENCH_serving.json (tokens/s for both loops, chunk_speedup,
-# prefill padding waste, the paged block) at the repo root and exits
-# nonzero on parity failure or any crash — fast enough for tier-1.
+# prefill padding waste, the paged/speculative/int8_kv blocks) at the
+# repo root and exits nonzero on parity failure or any crash — fast
+# enough for tier-1.
 #
 # Usage: bin/serving_smoke.sh        (from the repo root, or anywhere)
 
@@ -18,4 +24,5 @@ exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m deepspeed_tpu.benchmarks.serving_bench \
     --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
     --decode-chunk 8 --skip-sequential --paged \
+    --speculative --kv-dtype int8 \
     --out-dir /tmp/serving_smoke_csv --json-out BENCH_serving.json

@@ -54,6 +54,17 @@ DECODE_PROGRAM_BUDGET = 3
 #: count (tests/test_tracelint.py) and the bench fails beyond it.
 PAGED_DECODE_PROGRAM_BUDGET = 2
 
+#: the SPECULATIVE and INT8 chunk variants inherit the same retrace
+#: physics as their base layouts — the hist carry (spec) and the extra
+#: int8 payload + scale leaves ride inside the same donated arena, so
+#: dense variants compile exactly like the dense chunk (3) and paged
+#: variants like the paged chunk (2), at every decode_chunk including 1
+#: (measured; tests/test_tracelint.py pins each variant separately).
+SPEC_DECODE_PROGRAM_BUDGET = 3
+SPEC_PAGED_DECODE_PROGRAM_BUDGET = 2
+INT8_DECODE_PROGRAM_BUDGET = 3
+INT8_PAGED_DECODE_PROGRAM_BUDGET = 2
+
 
 def _tiny_model(vocab_size=512, max_seq_len=64):
     """Small enough that per-step host overhead (dispatch + sync + python
@@ -167,6 +178,205 @@ def _shared_prefix_case(engine, max_seq_len: int, n_requests: int = 8,
     }
 
 
+def _speculative_case(engine, n_requests: int = 8, prompt_len: int = 16,
+                      max_new_tokens: int = 32, decode_chunk: int = 8,
+                      spec_k: int = 4, kv_dtype: str = "auto",
+                      seed: int = 0) -> dict:
+    """Speculative-decoding A/B on a REPETITIVE-TEXT workload (a short
+    motif tiled through every prompt — the prompt-lookup drafter's home
+    turf; greedy decode then continues the cycle, so drafts keep
+    matching). The baseline is the per-token loop (``decode_chunk=1``:
+    one host sync AND one target forward per token) — exactly the cost
+    speculation amortizes, since one spec step scores k+1 positions in
+    ONE forward and emits the whole accepted prefix per sync. Greedy
+    parity is asserted three ways: spec vs the per-token loop, vs the
+    non-spec K-step chunk loop, and (paged pool) vs the dense arena —
+    all bit-identical, so speculation is an execution strategy, not a
+    model change. The spec chunk programs carry their own pinned
+    compile budgets, asserted exactly like the dense one."""
+    from ..analysis import TraceAuditor
+    from ..serving import ServingEngine
+
+    vocab = engine.module.cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    motif = rng.integers(0, vocab, (4,)).astype(np.int32)
+    prompts = [np.tile(motif, max(1, prompt_len // 4)).astype(np.int32)
+               for _ in range(n_requests)]
+    common = dict(engine=engine, max_batch=n_requests,
+                  max_prompt_len=prompt_len, max_queue=n_requests,
+                  kv_dtype=kv_dtype)
+
+    # baseline: one sync + one forward per token
+    base = ServingEngine(decode_chunk=1, **common)
+    base_res, base_dt, base_tokens, _ = _timed_serving_run(
+        base, prompts, max_new_tokens)
+    base_tps = base_tokens / base_dt
+    # non-spec chunk-loop oracle at the production K
+    ck = ServingEngine(decode_chunk=decode_chunk, **common)
+    ck_res = ck.run([p.copy() for p in prompts],
+                    max_new_tokens=max_new_tokens)
+
+    suffix = "_int8_fn" if kv_dtype == "int8" else "_fn"
+    variant = "decode_chunk_spec" + suffix
+    auditor = TraceAuditor(budgets={variant: SPEC_DECODE_PROGRAM_BUDGET},
+                           audit_jaxprs=False)
+    with auditor:
+        spec = ServingEngine(decode_chunk=1, speculative=True,
+                             spec_k=spec_k, **common)
+        spec_res, spec_dt, spec_tokens, _ = _timed_serving_run(
+            spec, prompts, max_new_tokens)
+    spec_tps = spec_tokens / spec_dt
+    compiles = auditor.compiles(variant)
+    if compiles != SPEC_DECODE_PROGRAM_BUDGET:
+        raise RuntimeError(
+            f"{variant} compiled {compiles}x, expected exactly "
+            f"{SPEC_DECODE_PROGRAM_BUDGET} — speculative state is leaking "
+            "shape/type variation into the chunk program")
+
+    parity = (
+        all(np.array_equal(a.output_ids, b.output_ids)
+            for a, b in zip(base_res, spec_res))
+        and all(np.array_equal(a.output_ids, b.output_ids)
+                for a, b in zip(ck_res, spec_res)))
+    if not parity:
+        raise RuntimeError(
+            "greedy outputs diverged between speculative and sequential "
+            "decode — accept/verify must be bit-identical under argmax")
+
+    # paged spec: same drafts through the block pool, same outputs
+    pg_variant = "decode_chunk_spec" + suffix[:-3] + "_paged_fn"
+    pg_auditor = TraceAuditor(
+        budgets={pg_variant: SPEC_PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with pg_auditor:
+        spec_pg = ServingEngine(decode_chunk=1, speculative=True,
+                                spec_k=spec_k, paged=True,
+                                prefix_cache=False, **common)
+        pg_res = spec_pg.run([p.copy() for p in prompts],
+                             max_new_tokens=max_new_tokens)
+        pg_res = spec_pg.run([p.copy() for p in prompts],
+                             max_new_tokens=max_new_tokens)
+    pg_compiles = pg_auditor.compiles(pg_variant)
+    if pg_compiles != SPEC_PAGED_DECODE_PROGRAM_BUDGET:
+        raise RuntimeError(
+            f"{pg_variant} compiled {pg_compiles}x, expected exactly "
+            f"{SPEC_PAGED_DECODE_PROGRAM_BUDGET}")
+    paged_parity = all(np.array_equal(a.output_ids, b.output_ids)
+                       for a, b in zip(spec_res, pg_res))
+    if not paged_parity:
+        raise RuntimeError(
+            "speculative outputs diverged between the dense arena and "
+            "the paged block pool")
+
+    acceptance = spec.metrics.spec_acceptance_rate
+    speedup = spec_tps / base_tps
+    if speedup < 1.3:
+        raise RuntimeError(
+            f"speculative speedup {speedup:.2f}x < 1.3x on the "
+            f"repetitive workload (acceptance {acceptance:.2f}) — "
+            "accepted drafts are no longer buying wall-clock")
+    return {
+        "workload": "repetitive",
+        "spec_k": spec_k,
+        "drafter": f"ngram({spec.drafter.n})",
+        "kv_dtype": kv_dtype,
+        "n_requests": n_requests,
+        "max_new_tokens": max_new_tokens,
+        "base_tokens_per_s": round(base_tps, 2),
+        "spec_tokens_per_s": round(spec_tps, 2),
+        # >= 1.3 asserted: tokens per host-sync'd target step
+        "spec_speedup": round(speedup, 3),
+        "acceptance_rate": round(acceptance, 4),
+        "spec_proposed": spec.metrics.spec_proposed,
+        "spec_accepted": spec.metrics.spec_accepted,
+        "greedy_parity": parity,
+        "greedy_parity_paged": paged_parity,
+        "decode_chunk_compiles": compiles,
+        "decode_chunk_budget": SPEC_DECODE_PROGRAM_BUDGET,
+        "paged_decode_chunk_compiles": pg_compiles,
+        "paged_decode_chunk_budget": SPEC_PAGED_DECODE_PROGRAM_BUDGET,
+    }
+
+
+def _int8_case(engine, prompts, max_new_tokens: int, max_batch: int,
+               prompt_len: int, decode_chunk: int,
+               fp_arena_report: dict) -> dict:
+    """int8 KV A/B: the same mixed-length workload decoded with the
+    arena quantized to int8 payload + per-token f32 group scales. int8
+    legitimately changes numerics vs the fp oracle (quantization error),
+    so the bit-exactness gate here is DENSE-int8 vs PAGED-int8 — the two
+    layouts must still agree exactly, proving the paged scatter/gather
+    and the dense rows hold identical quantized state. The headline is
+    the arena footprint: quantized bytes must be at most half the fp
+    layout at equal batch/geometry (asserted; the tiny f32 bench model
+    lands near 0.27 = (1 byte + 4/hd scale) / 4)."""
+    from ..analysis import TraceAuditor
+    from ..serving import ServingEngine
+
+    common = dict(engine=engine, max_batch=max_batch,
+                  max_prompt_len=prompt_len, decode_chunk=decode_chunk,
+                  max_queue=max(len(prompts), 8), kv_dtype="int8")
+    auditor = TraceAuditor(
+        budgets={"decode_chunk_int8_fn": INT8_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with auditor:
+        dense = ServingEngine(**common)
+        dn_res, dn_dt, dn_tokens, _ = _timed_serving_run(
+            dense, prompts, max_new_tokens)
+    compiles = auditor.compiles("decode_chunk_int8_fn")
+    if compiles != INT8_DECODE_PROGRAM_BUDGET:
+        raise RuntimeError(
+            f"decode_chunk_int8_fn compiled {compiles}x, expected exactly "
+            f"{INT8_DECODE_PROGRAM_BUDGET} — int8/scale leaves are leaking "
+            "shape/type variation into the chunk program")
+    pg_auditor = TraceAuditor(
+        budgets={"decode_chunk_int8_paged_fn":
+                 INT8_PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with pg_auditor:
+        paged = ServingEngine(paged=True, prefix_cache=False, **common)
+        pg_res, pg_dt, pg_tokens, _ = _timed_serving_run(
+            paged, prompts, max_new_tokens)
+    pg_compiles = pg_auditor.compiles("decode_chunk_int8_paged_fn")
+    if pg_compiles != INT8_PAGED_DECODE_PROGRAM_BUDGET:
+        raise RuntimeError(
+            f"decode_chunk_int8_paged_fn compiled {pg_compiles}x, "
+            f"expected exactly {INT8_PAGED_DECODE_PROGRAM_BUDGET}")
+
+    parity = all(np.array_equal(a.output_ids, b.output_ids)
+                 for a, b in zip(dn_res, pg_res))
+    if not parity:
+        raise RuntimeError(
+            "int8 outputs diverged between the dense arena and the paged "
+            "block pool — both layouts must hold identical quantized KV")
+    rep = dense.kv.arena_report()
+    ratio = rep["kv_bytes"] / max(1, rep["kv_bytes_fp_equiv"])
+    if ratio > 0.5:
+        raise RuntimeError(
+            f"int8 arena is {ratio:.3f}x the fp layout — quantized KV "
+            "must at least halve the cache footprint")
+    if rep["kv_bytes_fp_equiv"] != fp_arena_report["kv_bytes"]:
+        raise RuntimeError(
+            "int8 fp-equivalent bytes do not match the actual fp arena — "
+            "the accounting baseline drifted from the real layout")
+    return {
+        "greedy_parity_paged": parity,
+        "int8_tokens_per_s": round(dn_tokens / dn_dt, 2),
+        "paged_int8_tokens_per_s": round(pg_tokens / pg_dt, 2),
+        # <= 0.5 asserted: quantized arena bytes over the fp layout's
+        "kv_bytes_ratio": round(ratio, 6),
+        "kv_bytes": rep["kv_bytes"],
+        "kv_bytes_fp_equiv": rep["kv_bytes_fp_equiv"],
+        "kv_bytes_saved": rep["kv_bytes_saved"],
+        "int8_payload_bytes": rep["int8_payload_bytes"],
+        "scale_bytes": rep["scale_bytes"],
+        "decode_chunk_compiles": compiles,
+        "decode_chunk_budget": INT8_DECODE_PROGRAM_BUDGET,
+        "paged_decode_chunk_compiles": pg_compiles,
+        "paged_decode_chunk_budget": INT8_PAGED_DECODE_PROGRAM_BUDGET,
+    }
+
+
 def _round_tree(obj, nd=6):
     if isinstance(obj, dict):
         return {k: _round_tree(v, nd) for k, v in obj.items()}
@@ -182,6 +392,9 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               model=None, params=None,
               with_sequential: bool = True,
               with_paged: bool = False,
+              with_speculative: bool = False,
+              spec_k: int = 4,
+              kv_dtype: str = "auto",
               trace_out: str = None) -> dict:
     """Returns a result dict; writes serving metrics CSVs under
     ``out_dir`` through the monitor fan-out. ``prompt_len`` is the MAX
@@ -365,6 +578,24 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             "shared_prefix": shared,
         }
 
+    # ---- speculative decoding A/B (--speculative) ----------------------
+    # Own workload (repetitive text) and own audited engines, strictly
+    # after the main audited region. With --kv-dtype int8 this becomes
+    # the COMBINED case: speculation over the quantized arena.
+    speculative_out = None
+    if with_speculative:
+        speculative_out = _speculative_case(
+            engine, n_requests=n_requests, prompt_len=prompt_len,
+            max_new_tokens=max_new_tokens, decode_chunk=decode_chunk,
+            spec_k=spec_k, kv_dtype=kv_dtype, seed=seed)
+
+    # ---- int8 KV A/B (--kv-dtype int8) ---------------------------------
+    int8_out = None
+    if kv_dtype == "int8":
+        int8_out = _int8_case(
+            engine, prompts, max_new_tokens, max_batch, prompt_len,
+            decode_chunk, fp_arena_report=chunked.kv.arena_report())
+
     ttfts = [r.ttft_s for r in ck_results if r.ttft_s is not None]
     csv_dir = os.path.join(out_dir, "serving_bench")
     out = {
@@ -397,6 +628,8 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         "mfu": _round_tree(mfu) if mfu else None,
         "hbm": _round_tree(hbm) if hbm else None,
         "paged": paged_out,
+        "speculative": speculative_out,
+        "int8_kv": int8_out,
         "trace_file": trace_out,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],
@@ -419,6 +652,19 @@ def main(argv=None):
                     "the dense arena (bit-identical greedy asserted) and "
                     "run the shared-prefix workload (N requests, one "
                     "common prompt, prefill executed once)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="also A/B self-drafting speculative decoding on "
+                    "a repetitive-text workload (greedy parity vs the "
+                    "sequential loops asserted, dense AND paged; >= 1.3x "
+                    "tokens/s asserted; acceptance rate reported)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per speculative step")
+    ap.add_argument("--kv-dtype", type=str, default="auto",
+                    choices=("auto", "int8"),
+                    help="'int8' also A/Bs the quantized KV arena "
+                    "(dense-int8 vs paged-int8 bit-identical asserted; "
+                    "arena bytes <= half the fp layout asserted) and "
+                    "makes --speculative the combined spec+int8 case")
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
     ap.add_argument("--trace-out", type=str, default=None,
@@ -435,6 +681,9 @@ def main(argv=None):
                        out_dir=args.out_dir, seed=args.seed,
                        with_sequential=not args.skip_sequential,
                        with_paged=args.paged,
+                       with_speculative=args.speculative,
+                       spec_k=args.spec_k,
+                       kv_dtype=args.kv_dtype,
                        trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:

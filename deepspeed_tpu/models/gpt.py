@@ -53,29 +53,40 @@ def _kv_write(cache, kv, cur):
         return jax.lax.dynamic_update_slice(cache, kv, start)
 
     def row(c, x, p):
-        upd = jax.lax.dynamic_update_slice(c, x, (p,) + (0,) * (c.ndim - 1))
-        return jnp.where(p < c.shape[0], upd, c)
+        # per-position scatter, NOT dynamic_update_slice: dus CLAMPS its
+        # start index, so a multi-token write near the row end (or at the
+        # sentinel) would silently land on the last s positions instead of
+        # dropping — mode="drop" discards exactly the out-of-range
+        # positions and is bit-identical to dus for in-range writes
+        idx = p + jnp.arange(x.shape[0], dtype=jnp.int32)
+        return c.at[idx].set(x, mode="drop")
 
     return jax.vmap(row)(cache, kv, cur)
 
 
 def _kv_write_paged(pool, kv, block_tables, cur):
-    """Paged counterpart of :func:`_kv_write`: scatter one token's k/v
+    """Paged counterpart of :func:`_kv_write`: scatter ``s`` tokens' k/v
     through each row's block table. ``pool`` [nb, bs, h*d] is the shared
-    block pool, ``kv`` [b, h*d] this step's flattened k or v,
+    block pool, ``kv`` [b, s, h*d] this step's flattened k or v,
     ``block_tables`` [b, T], ``cur`` [b] per-row write positions. The
     masked-lane sentinel (``cur >= T*bs == max_seq_len``) routes to the
     out-of-range flat index ``nb*bs`` and drops — same contract as the
     dense path, but through the scatter's ``mode="drop"`` instead of a
-    per-row select."""
+    per-row select. Table entries past a row's reservation are padded
+    with the ``num_blocks`` sentinel (paged_kv.padded_table), so a
+    speculative position beyond the leased blocks also routes to
+    ``nb*bs`` and drops instead of dirtying block 0."""
     nb, bs, hd = pool.shape
     b, T = block_tables.shape
+    s = kv.shape[1]
     cur = jnp.broadcast_to(jnp.asarray(cur, jnp.int32), (b,))
+    pos = cur[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]   # [b, s]
     blk = jnp.take_along_axis(
-        block_tables, jnp.clip(cur // bs, 0, T - 1)[:, None], axis=1)[:, 0]
-    flat = jnp.where(cur < T * bs, blk * bs + cur % bs, nb * bs)
-    return pool.reshape(nb * bs, hd).at[flat].set(
-        kv.reshape(b, hd), mode="drop").reshape(nb, bs, hd)
+        block_tables, jnp.clip(pos // bs, 0, T - 1), axis=1)       # [b, s]
+    flat = jnp.where((pos < T * bs) & (blk < nb),
+                     blk * bs + pos % bs, nb * bs)
+    return pool.reshape(nb * bs, hd).at[flat.reshape(-1)].set(
+        kv.reshape(b * s, hd), mode="drop").reshape(nb, bs, hd)
 
 
 def _sp_constraint(x, spec_parts):
@@ -203,6 +214,14 @@ class GPTConfig:
     # "xla" until the kernel shows a measured win on hardware (the r2 grid
     # version lost to XLA; this rewrite is pending chip re-measurement).
     decode_impl: str = "xla"         # auto | xla | pallas
+    # KV-cache storage dtype: "auto" stores at the compute dtype; "int8"
+    # stores symmetric per-token-group int8 (ops/quantizer.quantize_kv —
+    # one scale per position's concatenated heads, kept in f32
+    # ``key_scale``/``value_scale`` cache leaves) and dequantizes inside
+    # the attention jit, halving KV HBM and bandwidth vs bf16 (KIVI/
+    # LLM.int8-style cache compression). Decode-path only: prefill always
+    # computes at full precision and quantizes on the cache write.
+    kv_cache_dtype: str = "auto"     # auto | int8
     # Ulysses-style sequence parallelism over the mesh's `sp` axis (the
     # long-context strategy beyond the reference's block-sparse attention;
     # DeepSpeed-Ulysses all-to-all design, here expressed as sharding
@@ -246,6 +265,10 @@ class GPTConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.decode_impl not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown decode_impl {self.decode_impl!r}")
+        if self.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}: "
+                f"use 'auto' or 'int8'")
 
     @property
     def head_dim(self) -> int:
@@ -409,7 +432,17 @@ class SelfAttention(nn.Module):
         single-stream generate path) or a [b] vector (per-slot fills — the
         continuous-batching serving arena, serving/kv_cache.py): writes and
         masks are elementwise per row in the vector case, and positions
-        passed by the caller must equal the per-row fills."""
+        passed by the caller must equal the per-row fills. ``s > 1`` with a
+        vector ``cache_index`` is the speculative-verify shape
+        (serving/speculative.py): each row writes s candidate positions
+        starting at its own fill, and attention masks causally from the
+        per-row first query position.
+
+        ``kv_cache_dtype="int8"``: the payload leaves store int8 with
+        per-position f32 ``key_scale``/``value_scale`` leaves [b, S, 1]
+        (one symmetric group per token's concatenated heads,
+        ops/quantizer.quantize_kv); dequant happens inside this jit so XLA
+        fuses the scale-multiply into the attention contractions."""
         cfg = self.cfg
         b, s, h, d = q.shape
         if self.has_variable("cache", "block_tables"):
@@ -421,6 +454,8 @@ class SelfAttention(nn.Module):
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         from ..ops.pallas.decode_attention import pallas_decode_supported
+        int8 = cfg.kv_cache_dtype == "int8"
+        kv_dt = jnp.int8 if int8 else cfg.dtype
         use_flat = (impl == "pallas" and self.window is None
                     and pallas_decode_supported(b, cfg.max_seq_len, h, d,
                                                 cfg.dtype))
@@ -429,40 +464,72 @@ class SelfAttention(nn.Module):
         idx = self.variable("cache", "cache_index",
                             lambda: jnp.zeros((), jnp.int32))
         cur = idx.value
+        ksc = vsc = None
+        if int8:
+            from ..ops.quantizer import quantize_kv
+            ksc = self.variable("cache", "key_scale", jnp.zeros,
+                                (b, cfg.max_seq_len, 1), jnp.float32)
+            vsc = self.variable("cache", "value_scale", jnp.zeros,
+                                (b, cfg.max_seq_len, 1), jnp.float32)
+            kq, ks = quantize_kv(k.reshape(b, s, h * d))
+            vq, vs = quantize_kv(v.reshape(b, s, h * d))
+            ksc.value = _kv_write(ksc.value, ks, cur)
+            vsc.value = _kv_write(vsc.value, vs, cur)
         if use_flat:
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, cfg.max_seq_len, h * d), cfg.dtype)
+                               (b, cfg.max_seq_len, h * d), kv_dt)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, cfg.max_seq_len, h * d), cfg.dtype)
-            ck.value = _kv_write(ck.value,
-                                 k.astype(cfg.dtype).reshape(b, s, h * d),
-                                 cur)
-            cv.value = _kv_write(cv.value,
-                                 v.astype(cfg.dtype).reshape(b, s, h * d),
-                                 cur)
+                               (b, cfg.max_seq_len, h * d), kv_dt)
+            if int8:
+                ck.value = _kv_write(ck.value, kq, cur)
+                cv.value = _kv_write(cv.value, vq, cur)
+            else:
+                ck.value = _kv_write(
+                    ck.value, k.astype(cfg.dtype).reshape(b, s, h * d), cur)
+                cv.value = _kv_write(
+                    cv.value, v.astype(cfg.dtype).reshape(b, s, h * d), cur)
             idx.value = cur + s
             from ..ops.pallas.decode_attention import decode_attention
             if s == 1:
                 # fused prefix-only decode (reference softmax_context):
-                # O(cache_len) compute AND HBM traffic per token
-                return decode_attention(q, ck.value, cv.value, cur + s,
-                                        scale=scale)
-            # prefill: one relayout of the cache view per prefill call
-            ck4 = ck.value.reshape(b, cfg.max_seq_len, h, d)
-            cv4 = cv.value.reshape(b, cfg.max_seq_len, h, d)
+                # O(cache_len) compute AND HBM traffic per token — int8
+                # blocks are DMA-streamed and dequantized in VMEM
+                return decode_attention(
+                    q, ck.value, cv.value, cur + s, scale=scale,
+                    k_scale=ksc.value[..., 0] if int8 else None,
+                    v_scale=vsc.value[..., 0] if int8 else None)
+            # prefill / spec-verify: one relayout of the cache view per call
+            if int8:
+                from ..ops.quantizer import dequantize_kv
+                kf = dequantize_kv(ck.value, ksc.value, cfg.dtype)
+                vf = dequantize_kv(cv.value, vsc.value, cfg.dtype)
+            else:
+                kf, vf = ck.value, cv.value
+            ck4 = kf.reshape(b, cfg.max_seq_len, h, d)
+            cv4 = vf.reshape(b, cfg.max_seq_len, h, d)
             return self._cache_einsum(q, ck4, cv4, cur, s, scale)
         ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (b, cfg.max_seq_len, h, d), cfg.dtype)
+                           (b, cfg.max_seq_len, h, d), kv_dt)
         cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (b, cfg.max_seq_len, h, d), cfg.dtype)
-        ck.value = _kv_write(ck.value, k.astype(cfg.dtype), cur)
-        cv.value = _kv_write(cv.value, v.astype(cfg.dtype), cur)
+                           (b, cfg.max_seq_len, h, d), kv_dt)
+        if int8:
+            ck.value = _kv_write(ck.value, kq.reshape(b, s, h, d), cur)
+            cv.value = _kv_write(cv.value, vq.reshape(b, s, h, d), cur)
+        else:
+            ck.value = _kv_write(ck.value, k.astype(cfg.dtype), cur)
+            cv.value = _kv_write(cv.value, v.astype(cfg.dtype), cur)
         idx.value = cur + s
-        if s == 1 and self.window is None and impl == "pallas":
+        if s == 1 and self.window is None and impl == "pallas" and not int8:
             from ..ops.pallas.decode_attention import decode_attention
             return decode_attention(q, ck.value, cv.value, cur + s,
                                     scale=scale)
-        return self._cache_einsum(q, ck.value, cv.value, cur, s, scale)
+        if int8:
+            from ..ops.quantizer import dequantize_kv
+            kf = dequantize_kv(ck.value, ksc.value[..., None], cfg.dtype)
+            vf = dequantize_kv(cv.value, vsc.value[..., None], cfg.dtype)
+        else:
+            kf, vf = ck.value, cv.value
+        return self._cache_einsum(q, kf, vf, cur, s, scale)
 
     def _paged_decode_attention(self, q, k, v):
         """Block-table decode (vLLM PagedAttention shape): the cache is a
@@ -473,19 +540,20 @@ class SelfAttention(nn.Module):
         the ``jnp.take`` reference path is bit-identical to the dense
         masked einsum, the Pallas kernel DMAs per-(row, block)). Prefill
         never runs here: it stays cacheless-dense and is scattered into
-        the pool by PagedKVCacheManager.insert_batch."""
+        the pool by PagedKVCacheManager.insert_batch. ``s > 1`` is the
+        speculative-verify shape: s candidate positions write through the
+        table per row (out-of-reservation positions hit the sentinel-padded
+        table entries and drop) and the gather-attention masks causally
+        from each row's own first query position."""
         cfg = self.cfg
         b, s, h, d = q.shape
-        if s != 1:
-            raise NotImplementedError(
-                "paged KV decode is single-token only; prefill runs "
-                "through the dense path and is block-scattered on insert")
         if self.window is not None:
             raise NotImplementedError(
                 "paged KV decode has no local-window path")
         impl = cfg.decode_impl
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        int8 = cfg.kv_cache_dtype == "int8"
         scale = (cfg.qk_scale if cfg.qk_scale is not None
                  else 1.0 / math.sqrt(d))
         idx = self.variable("cache", "cache_index")
@@ -493,15 +561,29 @@ class SelfAttention(nn.Module):
         cv = self.variable("cache", "cached_value")
         bt = self.get_variable("cache", "block_tables")
         cur = idx.value                       # [b] per-slot write positions
-        dt = ck.value.dtype
-        ck.value = _kv_write_paged(ck.value, k.astype(dt).reshape(b, h * d),
-                                   bt, cur)
-        cv.value = _kv_write_paged(cv.value, v.astype(dt).reshape(b, h * d),
-                                   bt, cur)
-        idx.value = cur + 1
+        ksc = vsc = None
+        if int8:
+            from ..ops.quantizer import quantize_kv
+            ksc = self.variable("cache", "key_scale")
+            vsc = self.variable("cache", "value_scale")
+            kq, ks = quantize_kv(k.reshape(b, s, h * d))
+            vq, vs = quantize_kv(v.reshape(b, s, h * d))
+            ck.value = _kv_write_paged(ck.value, kq, bt, cur)
+            cv.value = _kv_write_paged(cv.value, vq, bt, cur)
+            ksc.value = _kv_write_paged(ksc.value, ks, bt, cur)
+            vsc.value = _kv_write_paged(vsc.value, vs, bt, cur)
+        else:
+            dt = ck.value.dtype
+            ck.value = _kv_write_paged(
+                ck.value, k.astype(dt).reshape(b, s, h * d), bt, cur)
+            cv.value = _kv_write_paged(
+                cv.value, v.astype(dt).reshape(b, s, h * d), bt, cur)
+        idx.value = cur + s
         from ..ops.pallas.decode_attention import paged_decode_attention
-        return paged_decode_attention(q, ck.value, cv.value, bt, cur + 1,
-                                      scale=scale, impl=impl)
+        return paged_decode_attention(
+            q, ck.value, cv.value, bt, cur + s, scale=scale, impl=impl,
+            k_scale=ksc.value[..., 0] if int8 else None,
+            v_scale=vsc.value[..., 0] if int8 else None)
 
     def _cache_einsum(self, q, ck, cv, cur, s, scale):
         from ..ops.pallas.decode_attention import masked_cache_attention

@@ -53,8 +53,8 @@ else:
 NEG_INF = float(np.finfo(np.float32).min)
 
 
-def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
-                   k_buf, v_buf, k_sem, v_sem, *, scale, block_k, b, hp, hd):
+def _decode_kernel(meta_ref, qmat_ref, *refs, scale, block_k, b, hp, hd,
+                   quantized=False):
     """Single program. k_hbm/v_hbm: full [b, S, h*d] refs in HBM;
     k_buf/v_buf: [2, b, block_k, h*d] VMEM slots — ALL batch rows ride one
     (strided) DMA per block, so the DMA count is O(live blocks), not
@@ -64,22 +64,37 @@ def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
     meta_ref: [1 + b] scalars — [0] is the live block count (max over
     rows), [1 + bi] row bi's filled prefix length. Per-row lengths are what
     continuous-batching serving needs: every slot sits at its own fill, so
-    the mask is per-row while the DMA window is sized by the deepest slot."""
+    the mask is per-row while the DMA window is sized by the deepest slot.
+
+    ``quantized``: the cache rides int8 with per-position f32 dequant
+    multipliers ks_hbm/vs_hbm [b, S] — int8 blocks are DMA-streamed
+    (half/quarter the HBM bytes) and the scale-multiply happens here in
+    VMEM right before the MXU dot."""
+    if quantized:
+        (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         k_sem, v_sem, ks_sem, vs_sem) = refs
+    else:
+        k_hbm, v_hbm, o_ref, k_buf, v_buf, k_sem, v_sem = refs
     nb = meta_ref[0]       # live kv blocks (max over batch rows)
 
-    def k_copy(i, slot):
-        return pltpu.make_async_copy(
-            k_hbm.at[:, pl.ds(i * block_k, block_k)],
-            k_buf.at[slot], k_sem.at[slot])
-
-    def v_copy(i, slot):
-        return pltpu.make_async_copy(
-            v_hbm.at[:, pl.ds(i * block_k, block_k)],
-            v_buf.at[slot], v_sem.at[slot])
+    def block_copies(i, slot):
+        win = pl.ds(i * block_k, block_k)
+        out = [
+            pltpu.make_async_copy(k_hbm.at[:, win], k_buf.at[slot],
+                                  k_sem.at[slot]),
+            pltpu.make_async_copy(v_hbm.at[:, win], v_buf.at[slot],
+                                  v_sem.at[slot]),
+        ]
+        if quantized:
+            out.append(pltpu.make_async_copy(
+                ks_hbm.at[:, win], ks_buf.at[slot], ks_sem.at[slot]))
+            out.append(pltpu.make_async_copy(
+                vs_hbm.at[:, win], vs_buf.at[slot], vs_sem.at[slot]))
+        return out
 
     # prologue: stage block 0 into slot 0
-    k_copy(0, 0).start()
-    v_copy(0, 0).start()
+    for c in block_copies(0, 0):
+        c.start()
 
     def body(i, carry):
         m_prev, l_prev, acc = carry                # [b,hp] [b,hp] [b,hp,hd]
@@ -89,11 +104,11 @@ def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
         @pl.when(nxt < nb)
         def _prefetch():
             ns = jax.lax.rem(nxt, 2)
-            k_copy(nxt, ns).start()
-            v_copy(nxt, ns).start()
+            for c in block_copies(nxt, ns):
+                c.start()
 
-        k_copy(i, slot).wait()
-        v_copy(i, slot).wait()
+        for c in block_copies(i, slot):
+            c.wait()
         pos = i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, hp), 0)
         ms, ls, accs = [], [], []
@@ -101,6 +116,9 @@ def _decode_kernel(meta_ref, qmat_ref, k_hbm, v_hbm, o_ref,
             live = pos < meta_ref[1 + bi]          # row bi's filled prefix
             kbk = k_buf[slot, bi].astype(jnp.float32)   # [bk, h*d]
             vbk = v_buf[slot, bi].astype(jnp.float32)
+            if quantized:
+                kbk = kbk * ks_buf[slot, bi][:, None]
+                vbk = vbk * vs_buf[slot, bi][:, None]
             qmat = qmat_ref[bi].astype(jnp.float32)     # [h*d, hp]
             s = jax.lax.dot(kbk, qmat,
                             preferred_element_type=jnp.float32) * scale
@@ -173,7 +191,9 @@ def pallas_decode_supported(b: int, S: int, h: int, d: int, dtype) -> bool:
 def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
                      cached_value: jnp.ndarray, cache_len,
                      scale: Optional[float] = None,
-                     block_k: Optional[int] = None) -> jnp.ndarray:
+                     block_k: Optional[int] = None,
+                     k_scale: Optional[jnp.ndarray] = None,
+                     v_scale: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """q: [b, 1, h, d]. cached_key/value: PREFERABLY the flat [b, S, h*d]
     cache layout — rank-4 [b, S, h, d] caches are accepted but XLA
     lane-pads their d dim (64 -> 128), so every call pays a full-cache
@@ -186,6 +206,9 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     engine's retired-lane sentinel is ``max_seq_len``); they are clamped
     to S here so the DMA window / mask math stays in range — the lane's
     output is garbage the caller discards, never an OOB access.
+    ``k_scale``/``v_scale`` [b, S] f32 mark an int8 cache
+    (kv_cache_dtype="int8"): per-position dequant multipliers, applied in
+    VMEM on the Pallas path and before the masked einsum on the fallback.
     Returns [b, 1, h, d]."""
     b, s_q, h, d = q.shape
     S = cached_key.shape[1]
@@ -195,7 +218,14 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     bk = _choose_block(b, S, h, d, jnp.dtype(cached_key.dtype).itemsize,
                        block_k)
     flat = cached_key.ndim == 3
+    quantized = k_scale is not None
     if s_q != 1 or bk is None or (h * d) % 128 != 0:
+        if quantized:
+            from ..quantizer import dequantize_kv
+            sk = k_scale[..., None] if flat else k_scale[..., None, None]
+            sv = v_scale[..., None] if flat else v_scale[..., None, None]
+            cached_key = dequantize_kv(cached_key, sk, q.dtype)
+            cached_value = dequantize_kv(cached_value, sv, q.dtype)
         if flat:
             cached_key = cached_key.reshape(b, S, h, d)
             cached_value = cached_value.reshape(b, S, h, d)
@@ -220,30 +250,41 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
         vf = cached_value.reshape(b, S, hd)
 
     kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk,
-                               b=b, hp=hp, hd=hd)
+                               b=b, hp=hp, hd=hd, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((b, hd, hp), lambda g, meta: (0, 0, 0)),
+        # the cache never enters VMEM wholesale: the kernel DMAs only
+        # live blocks out of HBM
+        pl.BlockSpec(memory_space=_MEM_HBM),
+        pl.BlockSpec(memory_space=_MEM_HBM),
+    ]
+    scratch = [
+        pltpu.VMEM((2, b, bk, hd), cached_key.dtype),
+        pltpu.VMEM((2, b, bk, hd), cached_value.dtype),
+    ]
+    sems = [pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,))]
+    operands = [meta, qmat, kf, vf]
+    if quantized:
+        in_specs += [pl.BlockSpec(memory_space=_MEM_HBM),
+                     pl.BlockSpec(memory_space=_MEM_HBM)]
+        scratch += [pltpu.VMEM((2, b, bk), jnp.float32),
+                    pltpu.VMEM((2, b, bk), jnp.float32)]
+        sems += [pltpu.SemaphoreType.DMA((2,)),
+                 pltpu.SemaphoreType.DMA((2,))]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(1,),
-        in_specs=[
-            pl.BlockSpec((b, hd, hp), lambda g, meta: (0, 0, 0)),
-            # the cache never enters VMEM wholesale: the kernel DMAs only
-            # live blocks out of HBM
-            pl.BlockSpec(memory_space=_MEM_HBM),
-            pl.BlockSpec(memory_space=_MEM_HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((b, hp, hd), lambda g, meta: (0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, b, bk, hd), cached_key.dtype),
-            pltpu.VMEM((2, b, bk, hd), cached_value.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch + sems,
     )
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hp, hd), q.dtype),
         interpret=interpret_mode(),
-    )(meta, qmat, kf, vf)
+    )(*operands)
     # block diagonal: head g's output is row g, segment g
     out = out[:, :h].reshape(b, h, h, d)
     out = jnp.diagonal(out, axis1=1, axis2=2)               # [b, d, h]
@@ -254,9 +295,8 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
 # Paged decode attention: gather K/V through a per-row block table
 # --------------------------------------------------------------------------
 
-def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, k_hbm, v_hbm, o_ref,
-                         k_buf, v_buf, k_sem, v_sem, *,
-                         scale, b, hp, hd, bs, nb_total):
+def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, *refs, scale, b, hp,
+                         hd, bs, nb_total, quantized=False):
     """Paged variant of :func:`_decode_kernel`. k_hbm/v_hbm are the FULL
     block pools [nb_total, bs, h*d] in HBM; each fori step DMAs one
     block PER ROW (rows no longer share a contiguous window — that is
@@ -266,22 +306,35 @@ def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, k_hbm, v_hbm, o_ref,
     over rows), [1 + bi] row bi's filled prefix. bt_ref: [b, T] block
     tables (scalar-prefetch, so the DMA source indices are host-known
     ints at issue time); entries past a row's reservation are clamped
-    into the pool and masked dead by the fill."""
+    into the pool and masked dead by the fill. ``quantized``: int8 pools
+    with per-position f32 dequant multiplier pools ks_hbm/vs_hbm
+    [nb_total, bs], DMA'd per-(row, block) alongside the payload and
+    applied in VMEM."""
+    if quantized:
+        (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+         k_sem, v_sem, ks_sem, vs_sem) = refs
+    else:
+        k_hbm, v_hbm, o_ref, k_buf, v_buf, k_sem, v_sem = refs
     nb = meta_ref[0]
 
-    def k_copy(i, slot, bi):
+    def row_copies(i, slot, bi):
         blk = jnp.minimum(bt_ref[bi, i], nb_total - 1)
-        return pltpu.make_async_copy(
-            k_hbm.at[blk], k_buf.at[slot, bi], k_sem.at[slot, bi])
-
-    def v_copy(i, slot, bi):
-        blk = jnp.minimum(bt_ref[bi, i], nb_total - 1)
-        return pltpu.make_async_copy(
-            v_hbm.at[blk], v_buf.at[slot, bi], v_sem.at[slot, bi])
+        out = [
+            pltpu.make_async_copy(k_hbm.at[blk], k_buf.at[slot, bi],
+                                  k_sem.at[slot, bi]),
+            pltpu.make_async_copy(v_hbm.at[blk], v_buf.at[slot, bi],
+                                  v_sem.at[slot, bi]),
+        ]
+        if quantized:
+            out.append(pltpu.make_async_copy(
+                ks_hbm.at[blk], ks_buf.at[slot, bi], ks_sem.at[slot, bi]))
+            out.append(pltpu.make_async_copy(
+                vs_hbm.at[blk], vs_buf.at[slot, bi], vs_sem.at[slot, bi]))
+        return out
 
     for bi in range(b):                    # prologue: stage block 0
-        k_copy(0, 0, bi).start()
-        v_copy(0, 0, bi).start()
+        for c in row_copies(0, 0, bi):
+            c.start()
 
     def body(i, carry):
         m_prev, l_prev, acc = carry            # [b,hp] [b,hp] [b,hp,hd]
@@ -292,17 +345,20 @@ def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, k_hbm, v_hbm, o_ref,
         def _prefetch():
             ns = jax.lax.rem(nxt, 2)
             for bi in range(b):
-                k_copy(nxt, ns, bi).start()
-                v_copy(nxt, ns, bi).start()
+                for c in row_copies(nxt, ns, bi):
+                    c.start()
 
         pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, hp), 0)
         ms, ls, accs = [], [], []
         for bi in range(b):                    # static unroll
-            k_copy(i, slot, bi).wait()
-            v_copy(i, slot, bi).wait()
+            for c in row_copies(i, slot, bi):
+                c.wait()
             live = pos < meta_ref[1 + bi]
             kbk = k_buf[slot, bi].astype(jnp.float32)     # [bs, h*d]
             vbk = v_buf[slot, bi].astype(jnp.float32)
+            if quantized:
+                kbk = kbk * ks_buf[slot, bi][:, None]
+                vbk = vbk * vs_buf[slot, bi][:, None]
             qmat = qmat_ref[bi].astype(jnp.float32)       # [h*d, hp]
             s = jax.lax.dot(kbk, qmat,
                             preferred_element_type=jnp.float32) * scale
@@ -360,11 +416,16 @@ def paged_gather_kv(pool: jnp.ndarray,
 def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
                            v_pool: jnp.ndarray, block_tables: jnp.ndarray,
                            cache_len, scale: Optional[float] = None,
-                           impl: str = "xla") -> jnp.ndarray:
-    """Single-token decode attention over a PAGED cache. q: [b, 1, h, d];
-    k_pool/v_pool: [nb, bs, h*d] block pools; block_tables: [b, T];
-    cache_len: valid positions per row (including this token, already
-    written) — scalar or [b], sentinel entries past T*bs are clamped.
+                           impl: str = "xla",
+                           k_scale: Optional[jnp.ndarray] = None,
+                           v_scale: Optional[jnp.ndarray] = None
+                           ) -> jnp.ndarray:
+    """Decode attention over a PAGED cache. q: [b, s_q, h, d] (s_q > 1 is
+    the speculative-verify shape); k_pool/v_pool: [nb, bs, h*d] block
+    pools; block_tables: [b, T]; cache_len: valid positions per row
+    (including this call's tokens, already written) — scalar or [b],
+    sentinel entries past T*bs are clamped. ``k_scale``/``v_scale``
+    [nb, bs] f32 mark int8 pools (per-position dequant multipliers).
 
     The reference path (CPU / unsupported shapes) gathers the pool
     through the table and calls the SAME masked einsum as the dense
@@ -381,6 +442,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (b,)), S)
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    quantized = k_scale is not None
     if (impl == "pallas" and s_q == 1
             and paged_decode_supported(b, bs, h, d, k_pool.dtype)):
         hp = -(-h // 8) * 8
@@ -391,34 +453,57 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
         meta = jnp.concatenate([nb_live[None], clen])
         kernel = functools.partial(
             _paged_decode_kernel, scale=scale, b=b, hp=hp, hd=hd,
-            bs=bs, nb_total=nb)
+            bs=bs, nb_total=nb, quantized=quantized)
+        in_specs = [
+            pl.BlockSpec((b, hd, hp), lambda g, meta, bt: (0, 0, 0)),
+            pl.BlockSpec(memory_space=_MEM_HBM),
+            pl.BlockSpec(memory_space=_MEM_HBM),
+        ]
+        scratch = [
+            pltpu.VMEM((2, b, bs, hd), k_pool.dtype),
+            pltpu.VMEM((2, b, bs, hd), v_pool.dtype),
+        ]
+        sems = [pltpu.SemaphoreType.DMA((2, b)),
+                pltpu.SemaphoreType.DMA((2, b))]
+        operands = [meta, block_tables.astype(jnp.int32), qmat,
+                    k_pool, v_pool]
+        if quantized:
+            in_specs += [pl.BlockSpec(memory_space=_MEM_HBM),
+                         pl.BlockSpec(memory_space=_MEM_HBM)]
+            scratch += [pltpu.VMEM((2, b, bs), jnp.float32),
+                        pltpu.VMEM((2, b, bs), jnp.float32)]
+            sems += [pltpu.SemaphoreType.DMA((2, b)),
+                     pltpu.SemaphoreType.DMA((2, b))]
+            operands += [k_scale.astype(jnp.float32),
+                         v_scale.astype(jnp.float32)]
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,          # meta + block tables
             grid=(1,),
-            in_specs=[
-                pl.BlockSpec((b, hd, hp), lambda g, meta, bt: (0, 0, 0)),
-                pl.BlockSpec(memory_space=_MEM_HBM),
-                pl.BlockSpec(memory_space=_MEM_HBM),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((b, hp, hd),
                                    lambda g, meta, bt: (0, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((2, b, bs, hd), k_pool.dtype),
-                pltpu.VMEM((2, b, bs, hd), v_pool.dtype),
-                pltpu.SemaphoreType.DMA((2, b)),
-                pltpu.SemaphoreType.DMA((2, b)),
-            ],
+            scratch_shapes=scratch + sems,
         )
         out = pl.pallas_call(
             kernel, grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, hp, hd), q.dtype),
             interpret=interpret_mode(),
-        )(meta, block_tables.astype(jnp.int32), qmat, k_pool, v_pool)
+        )(*operands)
         out = out[:, :h].reshape(b, h, h, d)
         out = jnp.diagonal(out, axis1=1, axis2=2)           # [b, d, h]
         return out.transpose(0, 2, 1).reshape(b, 1, h, d)
-    kf = paged_gather_kv(k_pool, block_tables).reshape(b, S, h, d)
-    vf = paged_gather_kv(v_pool, block_tables).reshape(b, S, h, d)
+    kflat = paged_gather_kv(k_pool, block_tables)
+    vflat = paged_gather_kv(v_pool, block_tables)
+    if quantized:
+        from ..quantizer import dequantize_kv
+        ks = paged_gather_kv(k_scale[..., None].astype(jnp.float32),
+                             block_tables)
+        vs = paged_gather_kv(v_scale[..., None].astype(jnp.float32),
+                             block_tables)
+        kflat = dequantize_kv(kflat, ks, q.dtype)
+        vflat = dequantize_kv(vflat, vs, q.dtype)
+    kf = kflat.reshape(b, S, h, d)
+    vf = vflat.reshape(b, S, h, d)
     return masked_cache_attention(q, kf, vf, clen - s_q, scale)
 
 

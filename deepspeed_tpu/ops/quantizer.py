@@ -133,6 +133,30 @@ def ds_quantize(vals: jnp.ndarray, groups: int, bits: int = 8,
     return out.reshape(vals.shape).astype(vals.dtype)
 
 
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 KV-cache quantization, one scale group per token
+    vector (the last axis — a single position's concatenated heads, the
+    granularity at which cache rows are written and gathered). Same
+    saturating semantics as ``ds_quantize``'s symmetric branch:
+    q_scale = 2^8 / (2*absmax + 1e-5), round, clamp to [-128, 127] so the
+    group extreme doesn't wrap. Returns ``(q int8 [..., D],
+    scale f32 [..., 1])`` where ``scale`` is the DEQUANT multiplier —
+    stored next to the int8 payload so reads are ``q * scale`` with no
+    division on the hot path."""
+    flat = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(flat), axis=-1, keepdims=True)
+    q_scale = 256.0 / (2.0 * absmax + 1e-5)
+    q = jnp.clip(jnp.round(flat * q_scale), -128.0, 127.0).astype(jnp.int8)
+    return q, (1.0 / q_scale).astype(jnp.float32)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                  dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Inverse of ``quantize_kv``; traced inside the consuming attention
+    jit so XLA fuses the broadcast-multiply into the QK/PV contractions."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def stochastic_round_bf16(x: jnp.ndarray, key) -> jnp.ndarray:
     """fp32 -> bf16 with STOCHASTIC rounding: add a uniform 16-bit value
     below the truncation point, then truncate the mantissa — unbiased in

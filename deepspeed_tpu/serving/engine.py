@@ -55,17 +55,49 @@ from .metrics import ServingMetrics
 from .scheduler import ContinuousBatchScheduler, Request
 
 
-def sample_tokens(logits, rng, temperature: float, top_k: Optional[int]):
-    """Greedy / temperature / top-k sampling over [b, V] logits — the same
-    policy as InferenceEngine.generate's sampler."""
+def filter_logits(logits, temperature: float, top_k: Optional[int],
+                  top_p: Optional[float] = None):
+    """Temperature / top-k / nucleus (top-p) filtering over [..., V]
+    logits, in f32. The filtered logits DEFINE the sampling distribution:
+    ``sample_tokens`` draws ``categorical(filter_logits(...))``, and the
+    speculative verifier (serving/speculative.verify_rejection) softmaxes
+    the same function — acceptance math matches the sampler exactly
+    because they share this code.
+
+    Every temperature != 0 takes the same path (x / 1.0 is the bitwise
+    identity, so temperature=1.0 no longer skips the scaling branch — the
+    old ``not in (0.0, 1.0)`` guard forked the code path for no numeric
+    effect). top-p keeps the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (the argmax token always survives);
+    applied after top-k when both are set."""
     import jax
     import jax.numpy as jnp
     logits = logits.astype(jnp.float32)
-    if temperature not in (0.0, 1.0):
+    if temperature != 0.0:
         logits = logits / temperature
     if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e10, logits)
+    if top_p is not None:
+        srt = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep token i while the mass BEFORE it is < top_p: the first
+        # token is always kept, and the set is the minimal one covering p
+        keep = (cum - probs) < top_p
+        kth = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                      keepdims=True)
+        logits = jnp.where(logits < kth, -1e10, logits)
+    return logits
+
+
+def sample_tokens(logits, rng, temperature: float, top_k: Optional[int],
+                  top_p: Optional[float] = None):
+    """Greedy / temperature / top-k / top-p sampling over [b, V] logits —
+    the same policy as InferenceEngine.generate's sampler."""
+    import jax
+    import jax.numpy as jnp
+    logits = filter_logits(logits, temperature, top_k, top_p)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
@@ -90,9 +122,10 @@ class _InflightChunk:
     the slot->request-uid snapshot at launch time, so tokens are never
     attributed to a slot's NEXT occupant."""
     slot_uids: Dict[int, int]
-    tokens: Any          # [B, K] device
+    tokens: Any          # [B, K] device ([B, K*(k+1)] speculative)
     valid: Any           # [B, K] device (lane was live entering the step)
-    state: Tuple         # (tok[B], pos[B], act[B], rem[B], eos[B]) device
+    state: Tuple         # (tok[B], pos[B], act[B], rem[B], eos[B]) device,
+    #                      + hist[B, S] in speculative mode
 
 
 class ServingEngine:
@@ -123,6 +156,12 @@ class ServingEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0,
                  top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 speculative: bool = False,
+                 spec_k: int = 4,
+                 spec_ngram: int = 2,
+                 drafter=None,
+                 kv_dtype: str = "auto",
                  monitor=None,
                  emit_every_steps: int = 16,
                  seed: int = 0,
@@ -146,6 +185,17 @@ class ServingEngine:
         if max_seq is None:
             raise ValueError("ServingEngine needs a model with "
                              "cfg.max_seq_len (the KV arena extent)")
+        self.kv_dtype = str(kv_dtype)
+        if self.kv_dtype not in ("auto", "int8"):
+            raise ValueError(f"kv_dtype must be 'auto' or 'int8', "
+                             f"got {kv_dtype!r}")
+        if self.kv_dtype == "int8":
+            # rebuild the module with the int8 cache config BEFORE the
+            # arena is shaped from it: every cache leaf the engine
+            # compiles against (int8 payload + f32 scale leaves) comes
+            # from this module's eval_shape
+            cfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+            self.module = type(self.module)(cfg)
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq)
         self.max_prompt_len = int(max_prompt_len or max_seq)
@@ -165,6 +215,19 @@ class ServingEngine:
                 | {self.max_prompt_len})
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.top_p = top_p
+        self.speculative = bool(speculative)
+        if self.speculative:
+            from .speculative import NGramDrafter
+            self.drafter = (drafter if drafter is not None
+                            else NGramDrafter(spec_k, spec_ngram))
+            self.spec_k = int(self.drafter.k)
+        else:
+            self.drafter = None
+            self.spec_k = 0
+        # speculative decode always runs the chunked scan program (the
+        # verify forward is a multi-token apply; K=1 is a length-1 scan)
+        self._chunked = self.decode_chunk > 1 or self.speculative
 
         self.paged = bool(paged)
         if self.paged:
@@ -200,7 +263,11 @@ class ServingEngine:
         mat = engine._materialize
         module = self.module
         temperature_, top_k_ = self.temperature, self.top_k
+        top_p_ = self.top_p
         max_seq_ = self.max_seq_len
+        B_ = self.max_batch
+        spec_k_ = self.spec_k
+        drafter_ = self.drafter
         K = self.decode_chunk
 
         def prefill(params, ids, true_lens, rng):
@@ -212,7 +279,7 @@ class ServingEngine:
                 logits = logits[0]
             last = jnp.take_along_axis(
                 logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [n,V]
-            tok = sample_tokens(last, rng, temperature_, top_k_)
+            tok = sample_tokens(last, rng, temperature_, top_k_, top_p_)
             return tok, vc["cache"]
 
         def decode(params, cache, tokens, positions, rng):
@@ -227,7 +294,8 @@ class ServingEngine:
                 positions=positions[:, None], mutable=["cache"])
             if isinstance(logits, tuple):
                 logits = logits[0]
-            tok = sample_tokens(logits[:, -1], rng, temperature_, top_k_)
+            tok = sample_tokens(logits[:, -1], rng, temperature_, top_k_,
+                                top_p_)
             return tok, vc["cache"]
 
         def _with_write_index(cache, write_pos):
@@ -258,7 +326,7 @@ class ServingEngine:
                     logits = logits[0]
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens(logits[:, -1], sub,
-                                    temperature_, top_k_)
+                                    temperature_, top_k_, top_p_)
                 nxt = jnp.where(act, nxt, tok)       # frozen lanes hold
                 emitted = act                        # validity of nxt
                 rem = jnp.where(act, rem - 1, rem)
@@ -275,30 +343,116 @@ class ServingEngine:
             return (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(valid, 0, 1),
                     c, tok_f, pos_f, act_f, rem_f)
 
+        def decode_chunk_spec_fn(params, cache, tokens, positions, active,
+                                 eos, remaining, hist, rng):
+            """Speculative chunk: each scan step drafts k tokens per lane
+            (drafter gathers over the device-resident [B, S] history),
+            scores all k+1 positions in ONE target forward, and emits the
+            accepted prefix + correction token — up to k+1 tokens per lane
+            per step, with exactly the sampler's distribution (greedy:
+            bit-identical to the sequential loop; see
+            serving/speculative.py for the argument). The per-lane
+            accepted length n advances the write cursor and positions;
+            KV rows written for rejected drafts sit ABOVE the new fill,
+            so they are dead (masked by every later read) until a later
+            step overwrites them."""
+            from .speculative import verify_greedy, verify_rejection
+            pm = mat(params)
+            kp1 = spec_k_ + 1
+            rows = jnp.arange(B_, dtype=jnp.int32)
+            j = jnp.arange(kp1, dtype=jnp.int32)[None, :]
+
+            def body(carry, _):
+                c, tok, pos, act, rem, key, h = carry
+                # keep the invariant hist[b, pos[b]] == tok[b] (idempotent
+                # after the first step; fresh admits are patched by the
+                # host, this covers the launch-time carry)
+                h = h.at[rows, jnp.where(act, pos, jnp.int32(max_seq_))
+                         ].set(tok, mode="drop")
+                drafts = drafter_.propose(h, tok, pos)          # [B, k]
+                inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
+                write_pos = jnp.where(act, pos, jnp.int32(max_seq_))
+                c = _with_write_index(c, write_pos)
+                qpos = pos[:, None] + j
+                logits, vc = module.apply(
+                    {"params": pm, "cache": c}, inputs,
+                    positions=qpos, mutable=["cache"])
+                if isinstance(logits, tuple):
+                    logits = logits[0]                          # [B,k+1,V]
+                if temperature_ == 0.0:
+                    emitted, acc = verify_greedy(logits, drafts)
+                    key_n = key
+                else:
+                    key_n, sub = jax.random.split(key)
+                    emitted, acc = verify_rejection(
+                        logits, drafts, sub, temperature_, top_k_, top_p_)
+                # candidate validity: live lane, within the accepted
+                # prefix (+ the correction/bonus at j == acc), within the
+                # remaining token budget
+                cand = act[:, None] & (j <= acc[:, None]) & \
+                    (j < rem[:, None])
+                hit = (eos[:, None] >= 0) & (emitted == eos[:, None])
+                cut = (cand & hit).astype(jnp.int32)
+                prior_hits = jnp.cumsum(cut, axis=1) - cut
+                valid = cand & (prior_hits == 0)    # stop AFTER first EOS
+                n = jnp.sum(valid.astype(jnp.int32), axis=1)    # [B]
+                last = jnp.take_along_axis(
+                    emitted, jnp.clip(n - 1, 0, spec_k_)[:, None],
+                    axis=1)[:, 0]
+                tok_n = jnp.where(n > 0, last, tok)
+                stopped = jnp.any(valid & hit, axis=1)
+                rem_n = rem - n
+                act_n = act & (rem_n > 0) & jnp.logical_not(stopped)
+                # emitted token j landed at history index pos + 1 + j
+                widx = jnp.where(valid, pos[:, None] + 1 + j,
+                                 jnp.int32(max_seq_))
+                h = h.at[rows[:, None], widx].set(emitted, mode="drop")
+                pos_n = pos + n
+                return ((vc["cache"], tok_n, pos_n, act_n, rem_n, key_n, h),
+                        (emitted, valid))
+
+            (c, tok_f, pos_f, act_f, rem_f, _, hist_f), (toks, valid) = \
+                jax.lax.scan(
+                    body,
+                    (cache, tokens, positions, active, remaining, rng,
+                     hist),
+                    None, length=K)
+            toks = jnp.moveaxis(toks, 0, 1).reshape(B_, K * kp1)
+            valid = jnp.moveaxis(valid, 0, 1).reshape(B_, K * kp1)
+            return (toks, valid, c, tok_f, pos_f, act_f, rem_f, hist_f)
+
         # prefill retraces lazily per (n, bucket) shape — the jit cache IS
         # the bucket program table
         self._jit_prefill = jax.jit(prefill)
         # donate the arena: XLA updates every slot's KV rows in place
         self._jit_decode = jax.jit(decode, donate_argnums=(1,))
+        # distinct function name => distinct TraceAuditor budget: every
+        # spec / int8 / paged combination is a different compiled program
+        # family whose retrace count is pinned separately ("decode_chunk"
+        # + "_spec"? + "_int8"? + "_paged"? + "_fn")
+        variant = "decode_chunk"
+        if self.speculative:
+            variant += "_spec"
+        if self.kv_dtype == "int8":
+            variant += "_int8"
         if self.paged:
-            # distinct function name => distinct TraceAuditor budget: the
-            # paged chunk program's retrace count is pinned separately
-            # from the dense decode_chunk_fn == 3 budget
-            def decode_chunk_paged_fn(params, cache, tokens, positions,
-                                      active, eos, remaining, rng):
-                return decode_chunk_fn(params, cache, tokens, positions,
-                                       active, eos, remaining, rng)
-            self._jit_decode_chunk = jax.jit(decode_chunk_paged_fn,
-                                             donate_argnums=(1,))
-        else:
-            self._jit_decode_chunk = jax.jit(decode_chunk_fn,
-                                             donate_argnums=(1,))
+            variant += "_paged"
+        variant += "_fn"
+        chunk_fn = (decode_chunk_spec_fn if self.speculative
+                    else decode_chunk_fn)
+        chunk_fn.__name__ = variant
+        self._jit_decode_chunk = jax.jit(chunk_fn, donate_argnums=(1,))
         # arena-size gauges at init: the KV footprint is fixed for the
         # engine's lifetime, headroom varies (re-gauged per chunk)
         arena = self.kv.arena_report()
         telemetry.gauge("serve/arena_bytes", float(arena["arena_bytes"]))
         telemetry.gauge("serve/arena_headroom_bytes",
                         float(arena["headroom_bytes"]))
+        # int8 KV: bytes the quantized arena saves vs the fp layout it
+        # replaces (0.0 in fp mode — the gauge is always present so
+        # dashboards need no mode branch)
+        telemetry.gauge("serve/kv_bytes_saved",
+                        float(arena.get("kv_bytes_saved", 0.0)))
         if self.paged:
             self._bytes_per_block = arena["bytes_per_block"]
             self._gauge_block_pool()
@@ -350,7 +504,7 @@ class ServingEngine:
         overlap ``run()`` has. Call until ``has_work()`` is False AND the
         last call returned with nothing in flight to drain completely."""
         before = len(self.scheduler.finished)
-        if self.decode_chunk <= 1:
+        if not self._chunked:
             self._admit()
             self._decode_once()
             return self.scheduler.finished[before:]
@@ -383,7 +537,7 @@ class ServingEngine:
         iteration."""
         before = len(self.scheduler.finished)
         self._admit()
-        if self.decode_chunk <= 1:
+        if not self._chunked:
             self._decode_once()
         elif self.scheduler.running:
             self._consume_chunk(self._launch_chunk(self._host_state()))
@@ -400,7 +554,7 @@ class ServingEngine:
         submission order (rejected ones included, flagged by status)."""
         submitted = [self.submit(p, **request_kwargs)
                      for p in (prompts or [])]
-        if self.decode_chunk <= 1:
+        if not self._chunked:
             while self.scheduler.has_work():
                 self.step()
         else:
@@ -429,22 +583,29 @@ class ServingEngine:
 
         B = self.max_batch
         i32 = jax.ShapeDtypeStruct((B,), np.int32)
-        ca = _mfu.compiled_cost_analysis(
-            self._jit_decode_chunk,
+        chunk_args = [
             jax.tree.map(abst, self.engine.params),
             jax.tree.map(abst, self.kv.cache),
-            i32, i32, jax.ShapeDtypeStruct((B,), bool), i32, i32,
-            abst(self._rng))
+            i32, i32, jax.ShapeDtypeStruct((B,), bool), i32, i32]
+        if self.speculative:
+            chunk_args.append(
+                jax.ShapeDtypeStruct((B, self.max_seq_len), np.int32))
+        chunk_args.append(abst(self._rng))
+        ca = _mfu.compiled_cost_analysis(
+            self._jit_decode_chunk, *chunk_args)
         if ca is None:
             return None
         K = self.decode_chunk
+        # each spec step scores spec_k + 1 positions in the one target
+        # forward, so the per-position flop denominator scales with k+1
+        per_step = (self.spec_k + 1) if self.speculative else 1
         flops_per_chunk = ca["flops"] * K
         return {
             "program_flops": ca["flops"],
             "bytes_accessed": ca["bytes_accessed"],
             "scan_length": K,
             "flops_per_chunk": flops_per_chunk,
-            "flops_per_token": flops_per_chunk / (B * K),
+            "flops_per_token": flops_per_chunk / (B * K * per_step),
             "max_batch": B,
             "scan_body_counted_once": True,
             "peak_flops_per_device": _mfu.peak_flops_per_device(),
@@ -472,10 +633,15 @@ class ServingEngine:
         params = jax.tree.map(abst, self.engine.params)
         cache = jax.tree.map(abst, self.kv.cache)
         rng = abst(self._rng)
-        if self.decode_chunk > 1:
+        if self._chunked:
+            chunk_args = [params, cache, i32, i32,
+                          jax.ShapeDtypeStruct((B,), bool), i32, i32]
+            if self.speculative:
+                chunk_args.append(
+                    jax.ShapeDtypeStruct((B, self.max_seq_len), np.int32))
+            chunk_args.append(rng)
             decode = _mem.compiled_memory_analysis(
-                self._jit_decode_chunk, params, cache,
-                i32, i32, jax.ShapeDtypeStruct((B,), bool), i32, i32, rng)
+                self._jit_decode_chunk, *chunk_args)
         else:
             decode = _mem.compiled_memory_analysis(
                 self._jit_decode, params, cache, i32, i32, rng)
@@ -549,7 +715,7 @@ class ServingEngine:
         self._last_token[req.slot] = first
         self.metrics.on_tokens(1)
         self.scheduler.record_first_token(req, first)
-        if self.decode_chunk > 1:
+        if self._chunked:
             self._record_admit_patch(req)
 
     def _gauge_block_pool(self) -> None:
@@ -610,7 +776,7 @@ class ServingEngine:
                 # may retire the request immediately (max_new_tokens == 1
                 # or an instant EOS) — its slot frees before any decode
                 self.scheduler.record_first_token(r, first)
-                if self.decode_chunk > 1:
+                if self._chunked:
                     self._record_admit_patch(r)
 
     def _record_admit_patch(self, req: Request) -> None:
@@ -619,8 +785,12 @@ class ServingEngine:
             rem = min(req.max_new_tokens - len(req.tokens),
                       self.kv.allocator.remaining(slot))
             eos = -1 if req.eos_token_id is None else int(req.eos_token_id)
-            self._admit_patches[slot] = (int(req.tokens[-1]),
-                                         req.prompt_len, rem, eos)
+            patch = (int(req.tokens[-1]), req.prompt_len, rem, eos)
+            if self.speculative:
+                # the drafter mines the lane's full history: patch in the
+                # prompt + first token so n-gram lookup sees the prompt
+                patch = patch + (self._history_row(req),)
+            self._admit_patches[slot] = patch
             self._deact_slots.discard(slot)
         else:
             # instantly retired: the slot must stay dead on device
@@ -674,6 +844,8 @@ class ServingEngine:
         active = np.zeros(B, bool)
         remaining = np.zeros(B, np.int32)
         eos = np.full(B, -1, np.int32)
+        hist = (np.zeros((B, self.max_seq_len), np.int32)
+                if self.speculative else None)
         for slot, req in self.scheduler.running.items():
             tokens[slot] = self._last_token[slot]
             positions[slot] = self.kv.fill[slot]
@@ -682,9 +854,24 @@ class ServingEngine:
                                   self.kv.allocator.remaining(slot))
             if req.eos_token_id is not None:
                 eos[slot] = int(req.eos_token_id)
+            if hist is not None:
+                hist[slot] = self._history_row(req)
         self._deact_slots.clear()
         self._admit_patches.clear()
+        if hist is not None:
+            return tokens, positions, active, remaining, eos, hist
         return tokens, positions, active, remaining, eos
+
+    def _history_row(self, req: Request) -> np.ndarray:
+        """One lane's token history (prompt + emitted) padded to
+        [max_seq_len] — the drafter's lookup corpus. Invariant:
+        ``row[positions[slot]] == last_token[slot]``."""
+        row = np.zeros(self.max_seq_len, np.int32)
+        seq = list(np.asarray(req.prompt).tolist()) + \
+            [int(t) for t in req.tokens]
+        n = min(len(seq), self.max_seq_len)
+        row[:n] = seq[:n]
+        return row
 
     def _device_state(self, chunk: _InflightChunk) -> Tuple:
         """Chunk-input state propagated on DEVICE from the previous
@@ -692,7 +879,11 @@ class ServingEngine:
         in: lanes the scheduler finished for its own reasons (deadline)
         go inactive; freshly admitted requests get their full lane
         state."""
-        tok, pos, act, rem, eos = chunk.state
+        if self.speculative:
+            tok, pos, act, rem, eos, hist = chunk.state
+        else:
+            tok, pos, act, rem, eos = chunk.state
+            hist = None
         if self._deact_slots:
             telemetry.instant("serve/deact_patch",
                               n=len(self._deact_slots))
@@ -712,8 +903,13 @@ class ServingEngine:
             eos = eos.at[slots].set(
                 np.array([v[3] for v in vals], np.int32))
             act = act.at[slots].set(True)
+            if hist is not None:
+                hist = hist.at[slots].set(
+                    np.stack([v[4] for v in vals]))
         self._deact_slots.clear()
         self._admit_patches.clear()
+        if hist is not None:
+            return tok, pos, act, rem, eos, hist
         return tok, pos, act, rem, eos
 
     def _launch_chunk(self, state: Tuple) -> _InflightChunk:
@@ -724,17 +920,27 @@ class ServingEngine:
         # run asynchronously; the honest device wait is measured at
         # consume time as serve/chunk_host_wait
         with telemetry.span("serve/chunk_launch", k=self.decode_chunk):
-            tokens, positions, active, remaining, eos = (
-                jnp.asarray(a) for a in state)
-            toks, valid, new_cache, tok_f, pos_f, act_f, rem_f = \
-                self._jit_decode_chunk(self.engine.params, self.kv.cache,
-                                       tokens, positions, active, eos,
-                                       remaining, self._next_rng())
+            if self.speculative:
+                (tokens, positions, active, remaining, eos, hist) = (
+                    jnp.asarray(a) for a in state)
+                (toks, valid, new_cache, tok_f, pos_f, act_f, rem_f,
+                 hist_f) = self._jit_decode_chunk(
+                    self.engine.params, self.kv.cache, tokens, positions,
+                    active, eos, remaining, hist, self._next_rng())
+                carry = (tok_f, pos_f, act_f, rem_f, eos, hist_f)
+            else:
+                tokens, positions, active, remaining, eos = (
+                    jnp.asarray(a) for a in state)
+                toks, valid, new_cache, tok_f, pos_f, act_f, rem_f = \
+                    self._jit_decode_chunk(
+                        self.engine.params, self.kv.cache, tokens,
+                        positions, active, eos, remaining,
+                        self._next_rng())
+                carry = (tok_f, pos_f, act_f, rem_f, eos)
             self.kv.update(new_cache)
         return _InflightChunk(
             slot_uids={s: r.uid for s, r in self.scheduler.running.items()},
-            tokens=toks, valid=valid,
-            state=(tok_f, pos_f, act_f, rem_f, eos))
+            tokens=toks, valid=valid, state=carry)
 
     def _consume_chunk(self, chunk: _InflightChunk) -> List[Request]:
         """Block on the chunk's token buffer (the ONE host sync per K
@@ -756,6 +962,21 @@ class ServingEngine:
             finished = self.scheduler.step_tokens_chunk(per_slot)
         n_tokens = sum(len(v) for v in per_slot.values())
         telemetry.count("serve/decode_tokens", float(n_tokens))
+        if self.speculative:
+            # acceptance accounting from the validity mask itself: a
+            # step is live iff its base position (j == 0, the correction
+            # /bonus slot always valid on live lanes) is valid; accepted
+            # drafts = valid tokens beyond that guaranteed one
+            kp1 = self.spec_k + 1
+            v3 = valid.reshape(self.max_batch, -1, kp1)
+            live_steps = v3[:, :, 0]
+            proposed = int(live_steps.sum()) * self.spec_k
+            accepted = int(np.maximum(
+                v3.sum(axis=2) - live_steps, 0).sum())
+            if proposed:
+                telemetry.count("serve/spec_proposed", float(proposed))
+                telemetry.count("serve/spec_accepted", float(accepted))
+            self.metrics.on_spec(proposed, accepted)
         telemetry.gauge("serve/queue_depth",
                         float(self.scheduler.queue_depth))
         telemetry.gauge("serve/occupancy", float(self.kv.occupancy))

@@ -104,6 +104,10 @@ class SlotKVCacheManager:
 
         cfg = getattr(model, "cfg", None)
         self.max_seq_len = int(getattr(cfg, "max_seq_len"))
+        # fp itemsize the arena WOULD use without int8 KV — the baseline
+        # for arena_report's kv_bytes_saved accounting
+        self._fp_itemsize = int(jnp.dtype(
+            getattr(cfg, "dtype", jnp.float32)).itemsize)
         self.allocator = SlotAllocator(max_batch, self.max_seq_len)
         if slot_axis is None:
             slot_axis = 1 if getattr(cfg, "scan_layers", False) else 0
@@ -201,17 +205,29 @@ class SlotKVCacheManager:
         block read — computed from the live leaves, so dtype changes
         (e.g. a future int8 KV) are reflected automatically."""
         import jax
+        import numpy as _np
         kv_bytes = 0
         index_bytes = 0
+        int8_payload = 0            # quantized cached_key/value bytes
+        scale_bytes = 0             # per-token f32 dequant multipliers
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self.cache)[0]:
             nbytes = getattr(leaf, "nbytes", None)
             if nbytes is None:
                 continue
-            if "cache_index" in jax.tree_util.keystr(path):
+            key = jax.tree_util.keystr(path)
+            if "cache_index" in key:
                 index_bytes += int(nbytes)
             else:
                 kv_bytes += int(nbytes)
+                if "scale" in key:
+                    scale_bytes += int(nbytes)
+                elif leaf.dtype == _np.int8:
+                    int8_payload += int(nbytes)
+        # what the SAME payload would cost in the model's fp dtype (scale
+        # leaves don't exist in fp mode): saved = fp-equivalent - actual
+        kv_bytes_fp = (kv_bytes - int8_payload - scale_bytes
+                       + int8_payload * self._fp_itemsize)
         alloc = self.allocator
         per_slot = kv_bytes // alloc.max_batch if alloc.max_batch else 0
         per_token = per_slot // self.max_seq_len if self.max_seq_len else 0
@@ -219,6 +235,10 @@ class SlotKVCacheManager:
             "arena_bytes": kv_bytes + index_bytes,
             "kv_bytes": kv_bytes,
             "index_bytes": index_bytes,
+            "int8_payload_bytes": int8_payload,
+            "scale_bytes": scale_bytes,
+            "kv_bytes_fp_equiv": kv_bytes_fp,
+            "kv_bytes_saved": kv_bytes_fp - kv_bytes,
             "max_batch": alloc.max_batch,
             "max_seq_len": self.max_seq_len,
             "bytes_per_slot": per_slot,

@@ -123,6 +123,8 @@ class ServingMetrics:
         self.n_prefix_hits = 0
         self.n_prefix_misses = 0
         self.n_cow_forks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     # ----------------------------------------------------------- recording
     def start(self) -> None:
@@ -167,6 +169,12 @@ class ServingMetrics:
         """One copy-on-write block fork (shared tail privatized)."""
         self.n_cow_forks += 1
 
+    def on_spec(self, proposed: int, accepted: int) -> None:
+        """One speculative chunk consumed: ``proposed`` draft tokens
+        offered to verification, ``accepted`` of them kept."""
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
+
     # ------------------------------------------------------------ reading
     @property
     def padding_waste(self) -> float:
@@ -191,6 +199,14 @@ class ServingMetrics:
         n = self.n_prefix_hits + self.n_prefix_misses
         return self.n_prefix_hits / n if n else 0.0
 
+    @property
+    def spec_acceptance_rate(self) -> float:
+        """Accepted / proposed draft tokens (0.0 before any speculative
+        chunk ran) — the lever behind speculative speedup: per-step
+        emitted tokens average 1 + rate * k."""
+        return (self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else 0.0)
+
     def snapshot(self, queue_depth: int, occupancy: float) -> Dict[str, float]:
         pct = self.ttft_reservoir.percentiles((50, 95, 99))
         return {
@@ -209,6 +225,7 @@ class ServingMetrics:
             "serving/prefix_cache_misses": float(self.n_prefix_misses),
             "serving/prefix_hit_rate": float(self.prefix_hit_rate),
             "serving/cow_forks": float(self.n_cow_forks),
+            "serving/spec_acceptance_rate": float(self.spec_acceptance_rate),
         }
 
     # ------------------------------------------------------------ emitting

@@ -386,7 +386,13 @@ class PagedSlotAllocator:
         self.blocks.decref(block)
 
     def padded_table(self, slot: int) -> np.ndarray:
-        out = np.zeros(self.blocks_per_seq, np.int32)
+        # pad with the num_blocks SENTINEL, not 0: entries past the
+        # slot's reservation must never name a real block — a
+        # speculative-verify write past the reservation routes through
+        # the padding and must hit the kernel's drop guard, while a 0
+        # pad would silently corrupt block 0 (likely leased elsewhere)
+        out = np.full(self.blocks_per_seq, self.blocks.num_blocks,
+                      np.int32)
         table = self.tables[slot]
         out[:len(table)] = table
         return out
@@ -446,6 +452,10 @@ class PagedKVCacheManager:
 
         cfg = getattr(model, "cfg", None)
         self.max_seq_len = int(getattr(cfg, "max_seq_len"))
+        # fp itemsize the pool WOULD use without int8 KV (arena_report's
+        # kv_bytes_saved baseline)
+        self._fp_itemsize = int(jnp.dtype(
+            getattr(cfg, "dtype", jnp.float32)).itemsize)
         self.block_size = int(block_size)
         T = self.max_seq_len // self.block_size
         self.allocator = PagedSlotAllocator(
@@ -629,6 +639,8 @@ class PagedKVCacheManager:
         import jax
         kv_bytes = 0
         index_bytes = 0
+        int8_payload = 0
+        scale_bytes = 0
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self.cache)[0]:
             nbytes = getattr(leaf, "nbytes", None)
@@ -639,6 +651,12 @@ class PagedKVCacheManager:
                 index_bytes += int(nbytes)
             else:
                 kv_bytes += int(nbytes)
+                if "scale" in ks:
+                    scale_bytes += int(nbytes)
+                elif leaf.dtype == np.int8:
+                    int8_payload += int(nbytes)
+        kv_bytes_fp = (kv_bytes - int8_payload - scale_bytes
+                       + int8_payload * self._fp_itemsize)
         al = self.allocator
         bytes_per_block = kv_bytes // self.num_blocks
         bytes_per_token = bytes_per_block // self.block_size \
@@ -652,6 +670,10 @@ class PagedKVCacheManager:
             "arena_bytes": kv_bytes + index_bytes,
             "kv_bytes": kv_bytes,
             "index_bytes": index_bytes,
+            "int8_payload_bytes": int8_payload,
+            "scale_bytes": scale_bytes,
+            "kv_bytes_fp_equiv": kv_bytes_fp,
+            "kv_bytes_saved": kv_bytes_fp - kv_bytes,
             "max_batch": al.max_batch,
             "max_seq_len": self.max_seq_len,
             "block_size": self.block_size,

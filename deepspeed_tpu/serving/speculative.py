@@ -1,0 +1,157 @@
+"""Self-drafting speculative decoding for the chunked serving loop.
+
+Leviathan et al., "Fast Inference from Transformers via Speculative
+Decoding": a cheap drafter proposes k tokens, the target model scores all
+k+1 positions in ONE batched forward, and an accept-prefix +
+rejection-resampling rule emits between 1 and k+1 tokens whose joint
+distribution is EXACTLY the target model's. Everything here is traceable
+jax — the engine runs it inside the ``lax.scan`` chunk body
+(serving/engine.py decode_chunk_spec_fn), so the host loop and
+double-buffered launch protocol are untouched; a chunk of K scan steps
+simply emits a variable number of tokens per lane per step.
+
+The built-in drafter is PROMPT-LOOKUP (n-gram): find the most recent
+earlier occurrence of the trailing n-gram of the lane's history and
+propose its continuation. No second model, no extra params, no extra
+forward — drafting is a few gathers over the [B, S] history buffer the
+engine threads through the chunk carry. The ``Drafter`` protocol keeps
+the slot open for a real draft model later: anything with a ``k``
+attribute and a traceable ``propose(hist, tok, pos) -> [B, k]`` works.
+
+Exactness:
+  * greedy (temperature == 0): the drafter proposes deltas; verification
+    accepts the longest prefix where draft == argmax(target). Emitted
+    tokens are argmax(target) at every position up to and including the
+    first mismatch — exactly the sequence the one-token-at-a-time greedy
+    loop produces, because the model's s>1 cached forward is positionwise
+    bit-identical to s=1 (the repo's masked_cache_attention is shared by
+    both shapes). Bit-identical to ``generate()``, gated by the parity
+    asserts in serving_bench and tests.
+  * sampled (temperature > 0): a delta-distribution drafter (q = 1 on the
+    proposed token) accepts draft d_j with probability p_j(d_j); on the
+    first rejection it resamples from the residual ``p_j`` with index
+    ``d_j`` zeroed and renormalized — the standard rejection-resampling
+    identity then gives emitted ~ p_j exactly. When all k drafts are
+    accepted, a bonus token samples from p_k for free.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Drafter(Protocol):
+    """Pluggable draft-proposal strategy. ``propose`` must be traceable
+    (it runs inside the jitted chunk scan) and is called with the
+    device-resident history ``hist`` [B, S] (row b's tokens 0..pos[b],
+    prompt + emitted, with ``hist[b, pos[b]] == tok[b]``), the current
+    last token ``tok`` [B] and its position ``pos`` [B]; it returns k
+    proposed continuation tokens [B, k] int32."""
+
+    k: int
+
+    def propose(self, hist: jnp.ndarray, tok: jnp.ndarray,
+                pos: jnp.ndarray) -> jnp.ndarray: ...
+
+
+class NGramDrafter:
+    """Prompt-lookup decoding (n-gram self-drafting): match the trailing
+    ``n``-gram of each lane's history against every earlier position and
+    continue from just after the MOST RECENT match, wrapping with the
+    match period so all k proposals come from real history. Lanes with no
+    match propose ``tok`` repeated (last-token repetition — the cheapest
+    guess, and the right one for degenerate repetition loops)."""
+
+    def __init__(self, k: int = 4, n: int = 2):
+        if k < 1:
+            raise ValueError(f"draft length k must be >= 1, got {k}")
+        if n < 1:
+            raise ValueError(f"n-gram order must be >= 1, got {n}")
+        self.k = int(k)
+        self.n = int(n)
+
+    def propose(self, hist: jnp.ndarray, tok: jnp.ndarray,
+                pos: jnp.ndarray) -> jnp.ndarray:
+        B, S = hist.shape
+        k, n = self.k, self.n
+        hlen = pos + 1                                   # tokens in history
+        idx = jnp.arange(S, dtype=jnp.int32)[None, :]    # candidate ends
+        match = jnp.ones((B, S), bool)
+        for t in range(n):
+            # hist[b, idx - t] == hist[b, hlen-1-t]: roll brings position
+            # idx-t to column idx (wrap-around columns are excluded by the
+            # idx >= n-1 validity mask below)
+            ref_t = jnp.take_along_axis(
+                hist, jnp.clip(hlen - 1 - t, 0, S - 1)[:, None], axis=1)
+            match = match & (jnp.roll(hist, t, axis=1) == ref_t)
+        valid = match & (idx >= n - 1) & (idx < hlen[:, None] - 1)
+        jstar = jnp.max(jnp.where(valid, idx, -1), axis=1)   # [B]
+        found = jstar >= 0
+        # continue after the match, wrapping with the period so proposals
+        # past the matched span re-walk the repeating cycle
+        period = jnp.maximum(hlen - 1 - jstar, 1)
+        i = jnp.arange(k, dtype=jnp.int32)[None, :]
+        src = jnp.clip(jstar[:, None] + 1 + i % period[:, None], 0, S - 1)
+        drafts = jnp.take_along_axis(hist, src, axis=1)
+        return jnp.where(found[:, None], drafts, tok[:, None])
+
+
+def verify_greedy(logits: jnp.ndarray, drafts: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy verification. ``logits`` [B, k+1, V]: target scores at the
+    k+1 positions fed (last token + k drafts); ``drafts`` [B, k].
+    Returns ``(emitted [B, k+1], acc [B])``: ``acc`` counts accepted
+    drafts (0..k) and positions 0..acc of ``emitted`` are the real
+    output (acc+1 tokens) — exactly what sequential greedy would emit,
+    since emitted_j == argmax_j and drafts agree on the accepted
+    prefix."""
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)      # [B, k+1]
+    k = drafts.shape[1]
+    ok = (drafts == tgt[:, :k]).astype(jnp.int32)
+    acc = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)           # [B]
+    return tgt, acc
+
+
+def verify_rejection(logits: jnp.ndarray, drafts: jnp.ndarray, key,
+                     temperature: float, top_k, top_p
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rejection-resampling verification at temperature > 0 against the
+    SAME filtered distribution ``sample_tokens`` draws from (temperature /
+    top-k / top-p applied before the softmax — serving/engine.py
+    filter_logits). Draft j is accepted with probability p_j(d_j) (the
+    delta-drafter accept rule); the first rejected position resamples
+    from the residual (p_j with the draft index zeroed, renormalized),
+    and a fully-accepted chunk samples a bonus token from p_k. Returns
+    ``(emitted [B, k+1], acc [B])`` with positions 0..acc real — the
+    emitted tokens are distributed exactly as k+1 sequential draws."""
+    from .engine import filter_logits
+    B, kp1, _ = logits.shape
+    k = kp1 - 1
+    probs = jax.nn.softmax(
+        filter_logits(logits, temperature, top_k, top_p), axis=-1)
+    ukey, rkey, bkey = jax.random.split(key, 3)
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], drafts[..., None], axis=-1)[..., 0]    # [B, k]
+    accept = jax.random.uniform(ukey, (B, k)) < p_draft
+    acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # residual at every draft position (only position ``acc`` is used):
+    # zero the rejected draft's mass and renormalize
+    res = probs[:, :k] * (1.0 - jax.nn.one_hot(
+        drafts, probs.shape[-1], dtype=probs.dtype))
+    res_logits = jnp.where(res > 0, jnp.log(jnp.maximum(res, 1e-30)),
+                           -1e9)
+    rescue = jax.random.categorical(rkey, res_logits, axis=-1)   # [B, k]
+    bonus_logits = jnp.where(
+        probs[:, k] > 0, jnp.log(jnp.maximum(probs[:, k], 1e-30)), -1e9)
+    bonus = jax.random.categorical(bkey, bonus_logits, axis=-1)  # [B]
+    correction = jnp.concatenate(
+        [rescue.astype(jnp.int32), bonus[:, None].astype(jnp.int32)],
+        axis=1)                                                  # [B, k+1]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    j = jnp.arange(kp1, dtype=jnp.int32)[None, :]
+    emitted = jnp.where(j < acc[:, None], drafts_pad, correction)
+    return emitted, acc

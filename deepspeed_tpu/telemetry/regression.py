@@ -102,6 +102,23 @@ SERVING_SPECS: List[MetricSpec] = [
                note="sequences held per unit of KV HBM vs dense slots"),
     MetricSpec(("paged", "shared_prefix", "prefix_hit_rate"), HIGHER,
                0.10, abs_tol=0.05),
+    # ---- speculative decoding (--speculative A/B, repetitive workload) ----
+    MetricSpec(("speculative", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="spec vs sequential bit-exactness is binary"),
+    MetricSpec(("speculative", "decode_chunk_compiles"), SHIFT,
+               abs_tol=0.0, note="pinned spec retrace budget"),
+    MetricSpec(("speculative", "acceptance_rate"), SHIFT, abs_tol=0.25,
+               note="drafter quality band on the pinned workload"),
+    MetricSpec(("speculative", "spec_speedup"), HIGHER, 0.30,
+               note="accepted drafts must keep buying wall-clock"),
+    # ---- int8 KV (--kv-dtype int8 A/B) ----
+    MetricSpec(("int8_kv", "greedy_parity_paged"), SHIFT, abs_tol=0.0,
+               note="int8 dense vs int8 paged bit-exactness is binary"),
+    MetricSpec(("int8_kv", "kv_bytes_ratio"), SHIFT, abs_tol=0.0,
+               note="quantized/fp arena byte ratio is deterministic"),
+    MetricSpec(("int8_kv", "kv_bytes_saved"), SHIFT, abs_tol=0.0),
+    MetricSpec(("int8_kv", "decode_chunk_compiles"), SHIFT, abs_tol=0.0,
+               note="pinned int8 retrace budget"),
 ]
 
 FRONTEND_SPECS: List[MetricSpec] = [

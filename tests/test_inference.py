@@ -386,6 +386,43 @@ def test_ds_quantize_saturates_at_group_extremes():
                 f"{err.max()} vs grid step {step.max()}")
 
 
+def test_quantize_kv_reference_semantics():
+    """quantize_kv/dequantize_kv (the serving KV-cache int8 path) keep
+    ds_quantize's symmetric math at per-token granularity: q_scale =
+    2^8/(2*absmax + 1e-5) with the last axis as the group, the stored
+    scale is the DEQUANT multiplier, the group max saturates at +127
+    instead of wrapping, and round-trip error stays within one grid
+    step everywhere (half a step off the saturated extreme)."""
+    from deepspeed_tpu.ops.quantizer import dequantize_kv, quantize_kv
+    rng = np.random.default_rng(11)
+    x = np.asarray(rng.normal(size=(3, 5, 16)) * 4.0, np.float32)
+    q, scale = quantize_kv(jnp.asarray(x))
+    q, scale = np.asarray(q), np.asarray(scale)
+    assert q.dtype == np.int8 and q.shape == x.shape
+    assert scale.dtype == np.float32 and scale.shape == x.shape[:-1] + (1,)
+    absmax = np.abs(x).max(-1, keepdims=True)
+    np.testing.assert_allclose(scale, (2 * absmax + 1e-5) / 256.0,
+                               rtol=1e-6)
+    # the positive extreme rounds to 128 and must clamp to +127, not
+    # wrap to -128; the negative extreme is exactly representable
+    hi = x == absmax
+    assert np.all(q[hi] == 127)
+    assert np.all(q[x == -absmax] == -128)
+    back = np.asarray(dequantize_kv(jnp.asarray(q), jnp.asarray(scale),
+                                    jnp.float32))
+    err = np.abs(back - x)
+    assert np.all(err <= scale * 1.001)                # saturated extreme
+    assert np.all(err[~hi] <= scale.repeat(16, -1)[~hi] * 0.5 + 1e-6)
+    # requested output dtype is honored (bf16 on the device hot path)
+    assert dequantize_kv(jnp.asarray(q),
+                         jnp.asarray(scale)).dtype == jnp.bfloat16
+    # an all-zero token vector is safe: the 1e-5 pad keeps the scale
+    # finite and the round trip exactly zero
+    qz, sz = quantize_kv(jnp.zeros((2, 8)))
+    assert np.all(np.asarray(qz) == 0) and np.all(np.isfinite(sz))
+    assert np.all(np.asarray(dequantize_kv(qz, sz, jnp.float32)) == 0)
+
+
 def test_int8_asymmetric_tree_and_engine():
     """Asymmetric int8 at rest: biased weight distributions reconstruct
     better than symmetric, and the inference engine accepts

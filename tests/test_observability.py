@@ -348,6 +348,18 @@ def _serving_doc(**over):
                               "prefix_hit_rate": 0.875,
                               "effective_seq_multiplier": 2.5},
         },
+        "speculative": {
+            "greedy_parity": True,
+            "decode_chunk_compiles": 3,
+            "acceptance_rate": 0.7,
+            "spec_speedup": 2.4,
+        },
+        "int8_kv": {
+            "greedy_parity_paged": True,
+            "kv_bytes_ratio": 0.265625,
+            "kv_bytes_saved": 385024,
+            "decode_chunk_compiles": 3,
+        },
     }
     doc.update(over)
     return doc

@@ -492,6 +492,55 @@ class TestSampling:
                 topk.add((row, int(tok)))
         assert len(topk) > 4          # actually stochastic, not argmax
 
+    def test_sample_tokens_top_p_nucleus(self):
+        """top-p keeps the minimal token set whose cumulative mass
+        reaches p: every draw must land inside the nucleus computed
+        independently in numpy, and a tiny p over peaked logits
+        degenerates to argmax."""
+        import jax
+        import jax.numpy as jnp
+        from deepspeed_tpu.serving.engine import sample_tokens
+        logits_np = np.random.default_rng(5).normal(
+            size=(4, 32)).astype(np.float32) * 2.0
+        logits = jnp.asarray(logits_np)
+        top_p = 0.7
+        order = np.argsort(-logits_np, axis=-1)
+        srt = np.take_along_axis(logits_np, order, axis=-1)
+        probs = np.exp(srt) / np.exp(srt).sum(-1, keepdims=True)
+        keep = (np.cumsum(probs, -1) - probs) < top_p
+        nucleus = [set(order[r][keep[r]]) for r in range(4)]
+        assert all(0 < len(n) < 32 for n in nucleus)   # actually filters
+        seen = set()
+        for k in range(24):
+            out = np.asarray(sample_tokens(logits, jax.random.PRNGKey(k),
+                                           1.0, None, top_p))
+            for row, tok in enumerate(out):
+                assert int(tok) in nucleus[row]
+                seen.add((row, int(tok)))
+        assert len(seen) > 4                           # still stochastic
+        # a nucleus smaller than any probability gap keeps only argmax
+        peaked = np.asarray(sample_tokens(logits * 8.0,
+                                          jax.random.PRNGKey(0),
+                                          1.0, None, 0.01))
+        np.testing.assert_array_equal(peaked,
+                                      np.argmax(logits_np, -1))
+
+    def test_filter_logits_temperature_one_single_path(self):
+        """temperature=1.0 takes the same scaling branch as every other
+        nonzero temperature (x / 1.0 is the bitwise identity — the old
+        ``not in (0.0, 1.0)`` guard forked the path for no numeric
+        effect): output is bit-equal to the f32 input."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.serving.engine import filter_logits
+        logits = jnp.asarray(np.random.default_rng(6).normal(
+            size=(3, 16)).astype(np.float32))
+        out = filter_logits(logits, 1.0, None, None)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(logits))
+        # and temperature scaling itself is the plain division
+        out2 = filter_logits(logits, 0.5, None, None)
+        np.testing.assert_array_equal(np.asarray(out2),
+                                      np.asarray(logits) / 0.5)
+
     def test_sampled_serving_is_deterministic_under_seed(self, tiny_engine):
         """temperature/top-k sampling through the chunked loop: same
         engine seed -> identical streams; different seed -> different."""

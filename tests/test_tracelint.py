@@ -455,6 +455,104 @@ def test_paged_decode_path_at_declared_budget():
     assert aud.records["decode_chunk_paged_fn"].calls >= 6
 
 
+def test_speculative_decode_paths_at_declared_budgets():
+    """The speculative chunk programs are their OWN jit families
+    (decode_chunk_spec_fn / decode_chunk_spec_paged_fn) but inherit the
+    base layouts' retrace physics: history/rng carries and the k+1-wide
+    verify forward add zero shape variation, so the dense budget stays
+    at the arena-metadata retrace count and the paged one at the single
+    carry retrace (benchmarks/serving_bench.SPEC_*_PROGRAM_BUDGET)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import (
+        SPEC_DECODE_PROGRAM_BUDGET, SPEC_PAGED_DECODE_PROGRAM_BUDGET,
+        _tiny_model)
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 7, 12, 4)]
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_spec_fn": SPEC_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=8, speculative=True)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=24)
+    assert (aud.compiles("decode_chunk_spec_fn")
+            == SPEC_DECODE_PROGRAM_BUDGET)
+    assert serving.metrics.spec_proposed > 0
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_spec_paged_fn":
+                 SPEC_PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=8, speculative=True, paged=True,
+                                prefix_cache=False)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=24)
+    assert (aud.compiles("decode_chunk_spec_paged_fn")
+            == SPEC_PAGED_DECODE_PROGRAM_BUDGET)
+
+
+def test_int8_decode_paths_at_declared_budgets():
+    """The int8 chunk programs (decode_chunk_int8_fn /
+    decode_chunk_int8_paged_fn): quantized payload + scale leaves ride
+    the same donated carry, so swapping the arena dtype must not add a
+    single retrace over the fp budgets
+    (benchmarks/serving_bench.INT8_*_PROGRAM_BUDGET)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import (
+        INT8_DECODE_PROGRAM_BUDGET, INT8_PAGED_DECODE_PROGRAM_BUDGET,
+        _tiny_model)
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 7, 12, 4)]
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_int8_fn": INT8_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=8, kv_dtype="int8")
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=24)
+    assert (aud.compiles("decode_chunk_int8_fn")
+            == INT8_DECODE_PROGRAM_BUDGET)
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_int8_paged_fn":
+                 INT8_PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=8, kv_dtype="int8", paged=True,
+                                prefix_cache=False)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=24)
+    assert (aud.compiles("decode_chunk_int8_paged_fn")
+            == INT8_PAGED_DECODE_PROGRAM_BUDGET)
+
+
 def test_train_step_path_at_declared_budget():
     """The fused train step compiles exactly twice — the initial trace
     (freshly initialized state) plus one retrace when call 2 feeds back
