@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Fleet smoke: the fleet serving benchmark on CPU. Four asserted cases:
+# 2-replica FleetRouter >= 1.6x a 1-replica router over
+# simulated-compute replicas (real scheduler/admission/stream stack,
+# sleep-for-device — one XLA CPU engine already saturates every host
+# core, so real-engine replicas cannot scale on this machine and the
+# simulation is what isolates the ROUTER's overhead); routed streams
+# bit-identical to ServingEngine.run with zero shed/re-route; tp=2 on
+# the 8-virtual-device mesh bit-identical to tp=1 under the pinned
+# decode_chunk_tp2_fn budget; disaggregated prefill bit-identical to
+# co-located paged with exactly one D2D handoff per prefill under the
+# pinned decode_chunk_paged_disagg_fn budget. Writes BENCH_fleet.json
+# at the repo root and exits nonzero on any parity/scaling/budget
+# failure — fast enough for tier-1.
+#
+# Usage: bin/fleet_smoke.sh        (from the repo root, or anywhere)
+
+cd "$(dirname "$0")/.." || exit 1
+
+exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m deepspeed_tpu.benchmarks.fleet_bench \
+    --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
+    --decode-chunk 8 --json-out BENCH_fleet.json

@@ -36,6 +36,10 @@ _EMBED_PAT = re.compile(r"(wte|embed|embedding)")
 # Expert-stacked params (leading dim = experts; see moe/experts.py). The
 # gate (`wg`) is NOT expert-stacked and stays replicated over ep.
 _EXPERT_PAT = re.compile(r"(^|/)experts(/|$)")
+# KV-cache payload leaves (serving arenas / paged pools). Everything else
+# in the cache collection (cache_index cursors, int8 scale leaves, block
+# tables) is tiny control state and stays replicated.
+_KV_PAYLOAD_PAT = re.compile(r"(cached_key|cached_value)")
 
 
 def path_str(path) -> str:
@@ -68,6 +72,49 @@ def tp_spec(path: str, ndim: int) -> P:
             spec[-2] = "tp"
         # row-parallel bias is replicated (added after the psum)
     return P(*spec)
+
+
+def kv_spec(path: str, shape: Tuple[int, ...], tp: int,
+            head_dim: Optional[int] = None) -> P:
+    """TP PartitionSpec for one serving KV-cache leaf.
+
+    The cache payload mirrors the attention activations the TP-sharded
+    QKV projections produce, so sharding it the same way keeps decode
+    reads/writes local to each tp shard:
+
+    * flat layout ``[.., S, h*d]`` — shard the fused heads*head_dim dim
+      (detected: last dim is a multiple of ``tp * head_dim``);
+    * 4D layout ``[.., S, h, d]`` — shard the heads dim (dim -2);
+    * anything that doesn't divide, plus control leaves (``cache_index``,
+      scales, block tables) — replicated.
+
+    Like ``tp_spec`` for params, a leaf only ever shards ONE dim and a
+    non-divisible dim falls back to replication rather than erroring."""
+    ndim = len(shape)
+    spec: list = [None] * ndim
+    if tp <= 1 or not _KV_PAYLOAD_PAT.search(path) or ndim < 2:
+        return P(*spec)
+    last = shape[-1]
+    if head_dim and last != head_dim and last % (tp * head_dim) == 0:
+        spec[-1] = "tp"                          # flat [.., S, h*d]
+    elif head_dim and last == head_dim and shape[-2] % tp == 0:
+        spec[-2] = "tp"                          # 4D [.., S, h, d]
+    elif not head_dim and last % tp == 0:
+        spec[-1] = "tp"                          # layout unknown: best effort
+    return P(*spec)
+
+
+def kv_shardings(cache, mesh: Mesh, head_dim: Optional[int] = None):
+    """NamedShardings for a serving KV-cache pytree (arena or paged pool)
+    over ``mesh``'s tp axis — the placement a tp-sharded serving engine
+    commits its cache with so the insert/decode programs never start from
+    an unsharded arena (which would retrace once placement settles)."""
+    tp = mesh.shape.get("tp", 1)
+
+    def leaf(p, x):
+        return NamedSharding(
+            mesh, kv_spec(path_str(p), tuple(x.shape), tp, head_dim))
+    return jax.tree_util.tree_map_with_path(leaf, cache)
 
 
 def _add_axis(spec: P, shape: Tuple[int, ...], axis_name: str, axis_size: int) -> P:

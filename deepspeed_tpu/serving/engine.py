@@ -170,15 +170,28 @@ class ServingEngine:
                  kv_pool_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
                  prefix_cache_capacity: int = 64,
+                 tp: int = 1,
+                 disaggregate_prefill: bool = False,
                  **inference_kwargs):
         import jax
         import jax.numpy as jnp
 
         if engine is None:
             from ..inference.engine import InferenceEngine
+            if int(tp) > 1:
+                # the serving-level tp knob rides the inference engine's
+                # existing mesh/ShardingRules machinery (mp_size)
+                inference_kwargs.setdefault("mp_size", int(tp))
             engine = InferenceEngine(model, model_parameters=model_parameters,
                                      **inference_kwargs)
         self.engine = engine
+        mesh = getattr(engine, "mesh", None)
+        self.tp = int(mesh.shape.get("tp", 1)) if mesh is not None else 1
+        if int(tp) > 1 and self.tp != int(tp):
+            raise ValueError(
+                f"tp={tp} requested but the engine's mesh has tp={self.tp} "
+                f"(pass mp_size={tp} when building the InferenceEngine, or "
+                f"drop the engine= argument)")
         self.module = engine.module
         cfg = getattr(self.module, "cfg", None)
         max_seq = getattr(cfg, "max_seq_len", None)
@@ -242,6 +255,64 @@ class ServingEngine:
         else:
             self.kv = SlotKVCacheManager(self.module, engine.params,
                                          self.max_batch)
+
+        # ---- mesh placement: tp-sharded KV + disaggregated prefill ----
+        # Which params each program family sees. Default: the inference
+        # engine's own placement for both. Disaggregation re-places two
+        # committed copies on disjoint device slices of the engine mesh.
+        self._decode_params = engine.params
+        self._prefill_params = engine.params
+        self._handoff_sharding = None       # set in disaggregated mode
+        head_dim = None
+        if getattr(cfg, "num_heads", None):
+            head_dim = int(cfg.d_model) // int(cfg.num_heads)
+        self.disaggregated = bool(disaggregate_prefill)
+        if self.disaggregated:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel import mesh as mesh_lib
+            from ..runtime.sharding import ShardingRules, kv_shardings
+            if getattr(engine, "quantized", False):
+                raise ValueError(
+                    "disaggregate_prefill with int8-quantized weights is "
+                    "unsupported (two placements of the quantized tree)")
+            devs = list(mesh.devices.flat)
+            if len(devs) < 2:
+                raise ValueError(
+                    "disaggregate_prefill needs >= 2 devices (one decode "
+                    "slice + one prefill slice)")
+            half = len(devs) // 2
+            dec_tp = self.tp if half % max(self.tp, 1) == 0 else 1
+            dshape = mesh_lib.MeshShape.infer(half, tp=dec_tp)
+            pshape = mesh_lib.MeshShape.infer(len(devs) - half, tp=dec_tp)
+            self._decode_mesh = mesh_lib.build_mesh(dshape,
+                                                    devices=devs[:half])
+            self._prefill_mesh = mesh_lib.build_mesh(pshape,
+                                                     devices=devs[half:])
+            drules = ShardingRules(self._decode_mesh, zero_stage=0)
+            prules = ShardingRules(self._prefill_mesh, zero_stage=0)
+            self._decode_params = jax.device_put(
+                engine.params,
+                drules.shardings(drules.param_specs(engine.params)))
+            self._prefill_params = jax.device_put(
+                engine.params,
+                prules.shardings(prules.param_specs(engine.params)))
+            # prompt KV is born on the prefill slice and handed to the
+            # decode slice replicated; the insert scatter then lands it in
+            # the (possibly tp-sharded) pool rows
+            self._handoff_sharding = NamedSharding(self._decode_mesh,
+                                                   PartitionSpec())
+            self.kv.update(jax.device_put(
+                self.kv.cache,
+                kv_shardings(self.kv.cache, self._decode_mesh,
+                             head_dim=head_dim)))
+        elif self.tp > 1:
+            from ..runtime.sharding import kv_shardings
+            # commit the fresh arena/pool with its tp NamedShardings so
+            # the first insert/decode never sees an unplaced cache
+            self.kv.update(jax.device_put(
+                self.kv.cache,
+                kv_shardings(self.kv.cache, mesh, head_dim=head_dim)))
+
         self.scheduler = ContinuousBatchScheduler(
             self.kv.allocator, max_queue=max_queue,
             max_prompt_len=self.max_prompt_len)
@@ -437,6 +508,13 @@ class ServingEngine:
             variant += "_int8"
         if self.paged:
             variant += "_paged"
+        # tp-sharded and disaggregated engines compile against different
+        # placement metadata, so they are their own program families with
+        # their own pinned budgets — the dense/paged budgets stay exact
+        if self.tp > 1:
+            variant += f"_tp{self.tp}"
+        if self.disaggregated:
+            variant += "_disagg"
         variant += "_fn"
         chunk_fn = (decode_chunk_spec_fn if self.speculative
                     else decode_chunk_fn)
@@ -462,7 +540,9 @@ class ServingEngine:
                  f"prefill_buckets={self._buckets} "
                  f"decode_chunk={self.decode_chunk} "
                  f"max_seq={max_seq} "
-                 f"kv={'paged' if self.paged else 'dense'}", ranks=[0])
+                 f"kv={'paged' if self.paged else 'dense'} "
+                 f"tp={self.tp} "
+                 f"disaggregated={self.disaggregated}", ranks=[0])
 
     # --------------------------------------------------------------- API
     def submit(self, prompt: Union[Request, Sequence[int], np.ndarray],
@@ -751,8 +831,25 @@ class ServingEngine:
             # dispatch + device prefill + arena insert honestly
             with telemetry.span("serve/prefill", n=n, bucket=bucket):
                 toks, cache = self._jit_prefill(
-                    self.engine.params, jnp.asarray(ids),
+                    self._prefill_params, jnp.asarray(ids),
                     jnp.asarray(lens), self._next_rng())
+                if self._handoff_sharding is not None:
+                    # disaggregation: the finished prompt KV leaves the
+                    # prefill slice here — a device-to-device transfer of
+                    # the batch's cache rows onto the decode slice, where
+                    # the insert scatters them through each request's
+                    # table row / slot lane
+                    import jax
+                    nbytes = sum(
+                        int(getattr(leaf, "nbytes", 0))
+                        for leaf in jax.tree.leaves(cache))
+                    with telemetry.span("serve/disagg_handoff", n=n,
+                                        bucket=bucket):
+                        cache = jax.device_put(cache,
+                                               self._handoff_sharding)
+                    telemetry.count("serve/disagg_handoff_bytes",
+                                    float(nbytes))
+                    telemetry.count("serve/disagg_handoffs", float(n))
                 self.kv.insert_batch(cache, [r.slot for r in reqs], lens)
                 toks_host = np.asarray(toks)
             telemetry.count("serve/prefill_tokens", float(lens.sum()))
@@ -819,7 +916,7 @@ class ServingEngine:
         # dispatch + device step (the K=1 reference path's whole cost)
         with telemetry.span("serve/decode_step", n=len(slots)):
             tok, new_cache = self._jit_decode(
-                self.engine.params, self.kv.cache, jnp.asarray(tokens),
+                self._decode_params, self.kv.cache, jnp.asarray(tokens),
                 jnp.asarray(positions), self._next_rng())
             self.kv.update(new_cache)
             self.kv.allocator.advance(slots)
@@ -925,7 +1022,7 @@ class ServingEngine:
                     jnp.asarray(a) for a in state)
                 (toks, valid, new_cache, tok_f, pos_f, act_f, rem_f,
                  hist_f) = self._jit_decode_chunk(
-                    self.engine.params, self.kv.cache, tokens, positions,
+                    self._decode_params, self.kv.cache, tokens, positions,
                     active, eos, remaining, hist, self._next_rng())
                 carry = (tok_f, pos_f, act_f, rem_f, eos, hist_f)
             else:
@@ -933,7 +1030,7 @@ class ServingEngine:
                     jnp.asarray(a) for a in state)
                 toks, valid, new_cache, tok_f, pos_f, act_f, rem_f = \
                     self._jit_decode_chunk(
-                        self.engine.params, self.kv.cache, tokens,
+                        self._decode_params, self.kv.cache, tokens,
                         positions, active, eos, remaining,
                         self._next_rng())
                 carry = (tok_f, pos_f, act_f, rem_f, eos)

@@ -1,0 +1,12 @@
+"""Fleet serving: data-parallel replica routing over serving engines.
+
+One ``ServingEngine`` is one replica; a deployment runs N of them
+(optionally tensor-parallel via the engine's ``tp=`` knob, optionally
+prefill/decode-disaggregated via ``disaggregate_prefill=True``) behind
+one :class:`FleetRouter` — least-loaded placement, prefix-affinity
+routing, and dead-replica drain. See docs/serving.md.
+"""
+
+from .router import FleetReplica, FleetRouter  # noqa: F401
+
+__all__ = ["FleetRouter", "FleetReplica"]

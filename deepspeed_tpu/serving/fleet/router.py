@@ -1,0 +1,255 @@
+"""FleetRouter: one submit() surface over N data-parallel serving
+replicas.
+
+One continuous-batching :class:`~deepspeed_tpu.serving.engine
+.ServingEngine` saturates one mesh slice; a serving deployment runs
+several — data-parallel replicas with identical weights — behind one
+frontend. This module is that frontend-of-frontends. Each replica is a
+``ServingEngine`` owned by its own :class:`ServingFrontend` (its own
+daemon driver thread, admission controller, throughput estimator), and
+the router only ever makes PLACEMENT decisions; after placement the
+request's whole lifecycle — admission, prefill, decode chunks, token
+streaming — is the chosen replica's, and the caller holds a perfectly
+ordinary :class:`StreamHandle`.
+
+Placement, in order:
+
+1. **Health**: replicas whose driver thread has crashed (or that the
+   router already marked dead) never receive traffic — the
+   ``HealthMonitor`` contract ("a fleet router should stop placing
+   traffic here") enforced at the router.
+2. **Prefix affinity**: hash the prompt (``PrefixCache.key_for`` — the
+   exact token-byte key the paged allocator uses) and prefer replicas
+   whose :class:`PrefixCache` already holds it: a hit replica serves
+   the prompt's prefill almost for free by block sharing, so sending
+   the request anywhere else throws away cached device work. The probe
+   is a pure peek (no LRU refresh, no counters).
+3. **Least loaded**: among the remaining candidates, pick the lowest
+   estimated drain time — outstanding work from the frontend's locked
+   ``load_snapshot()`` (admission-pending + engine backlog tokens)
+   over the replica's EWMA decode throughput.
+
+**Dead-replica drain**: each frontend gets the router as its
+``on_crash`` hook. When a driver crashes, work that never touched the
+device (admission-pending tickets, engine-queued requests) is re-homed
+on surviving replicas via ``ServingFrontend.adopt`` — the SAME handle
+objects keep streaming to their callers — while prefilled/running
+requests still resolve ``error`` (their KV state died with the
+replica). The crashed replica is marked dead and drops out of
+placement.
+
+Telemetry: every replica's driver thread is labeled (``replica=<id>``
+via ``telemetry.replica_label``) so per-replica gauges/counters stay
+distinguishable in one process-wide runtime; the router's own counters
+(``fleet/routed``, ``fleet/affinity_hits``, ``fleet/rerouted``,
+``fleet/reroute_failed``, ``fleet/replica_crashes``) are recorded
+unlabeled — they are fleet-level, not per-replica.
+
+Host-side only — this module never imports JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...telemetry import core as telemetry
+from ...utils.logging import logger
+from ..frontend.admission import AdmissionConfig, PRIORITY_NORMAL
+from ..frontend.frontend import ServingFrontend, StreamHandle
+from ..paged_kv import PrefixCache
+
+
+@dataclasses.dataclass
+class FleetReplica:
+    """One replica's slot in the fleet: engine + owning frontend +
+    router-side health mark."""
+    rid: int
+    engine: Any
+    frontend: ServingFrontend
+    dead: bool = False
+
+    @property
+    def alive(self) -> bool:
+        return not self.dead and self.frontend.driver_alive
+
+
+class FleetRouter:
+    """Route requests across N ``ServingEngine`` replicas.
+
+    ``engines`` are pre-built replicas (identical weights — the router
+    assumes any replica can serve any request). Each is wrapped in a
+    ``ServingFrontend`` with its own driver thread; the router owns
+    those frontends and ``close()`` drains all of them. ``admission``
+    is copied per replica (the frontend mutates its config in place to
+    size memory-aware shedding from the engine arena).
+    """
+
+    def __init__(self, engines: Sequence[Any], *,
+                 admission: Optional[AdmissionConfig] = None,
+                 affinity: bool = True,
+                 feed_depth: Optional[int] = None,
+                 idle_wait_s: float = 0.005,
+                 clock=time.monotonic):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self._clock = clock
+        self.affinity = bool(affinity)
+        self._lock = threading.Lock()
+        self.n_routed = 0
+        self.n_affinity_hits = 0
+        self.n_rerouted = 0
+        self.n_reroute_failed = 0
+        self.n_replica_crashes = 0
+        self.replicas: List[FleetReplica] = []
+        self._by_frontend: Dict[int, FleetReplica] = {}
+        for rid, eng in enumerate(engines):
+            cfg = dataclasses.replace(admission) if admission is not None \
+                else None
+            fe = ServingFrontend(eng, admission=cfg,
+                                 feed_depth=feed_depth,
+                                 idle_wait_s=idle_wait_s,
+                                 on_crash=self._on_replica_crash,
+                                 telemetry_label=str(rid),
+                                 clock=clock)
+            rep = FleetReplica(rid=rid, engine=eng, frontend=fe)
+            self.replicas.append(rep)
+            self._by_frontend[id(fe)] = rep
+
+    # ------------------------------------------------------- public API
+    def submit(self, prompt: Union[Sequence[int], np.ndarray], *,
+               priority: int = PRIORITY_NORMAL,
+               tenant: str = "default",
+               slo_ttft_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> StreamHandle:
+        """Place one request and enqueue it; returns the chosen
+        replica's StreamHandle immediately. With every replica dead the
+        handle resolves ``rejected`` (``frontend_closed``) — same
+        no-exception contract as ``ServingFrontend.submit``."""
+        replica = self._place(prompt)
+        telemetry.count("fleet/routed")
+        with self._lock:
+            self.n_routed += 1
+        return replica.frontend.submit(
+            prompt, priority=priority, tenant=tenant,
+            slo_ttft_s=slo_ttft_s, deadline_s=deadline_s,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        for rep in self.replicas:
+            rep.frontend.close(timeout)
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --------------------------------------------------------- placement
+    def _place(self, prompt) -> FleetReplica:
+        candidates = [r for r in self.replicas if r.alive]
+        if not candidates:
+            # every replica is dead: any frontend will reject-with-reason
+            # (frontend_closed) — deliberate, so callers get a terminal
+            # handle instead of an exception
+            return self.replicas[0]
+        if self.affinity and len(candidates) > 1:
+            key = PrefixCache.key_for(prompt)
+            hits = [r for r in candidates if self._holds_prefix(r, key)]
+            if hits:
+                telemetry.count("fleet/affinity_hits")
+                with self._lock:
+                    self.n_affinity_hits += 1
+                candidates = hits
+        if len(candidates) == 1:
+            return candidates[0]
+        return min(candidates, key=self._load_score)
+
+    @staticmethod
+    def _holds_prefix(replica: FleetReplica, key: bytes) -> bool:
+        kv = getattr(replica.engine, "kv", None)
+        if kv is None or not getattr(kv, "prefix_enabled", False):
+            return False
+        return key in kv.prefix_cache
+
+    @staticmethod
+    def _load_score(replica: FleetReplica) -> float:
+        """Estimated drain time: outstanding tokens over EWMA decode
+        throughput. Admission-pending requests haven't sized their
+        decode yet, so they count by the engine-side backlog convention
+        (prompt + budget) folded into ``pending`` as request counts —
+        with homogeneous data-parallel replicas the ordering is what
+        matters, not the absolute seconds."""
+        snap = replica.frontend.load_snapshot()
+        outstanding = (float(snap["engine_backlog_tokens"])
+                       + float(snap["admission"]["pending"]))
+        rate = snap["throughput"]["tokens_per_s"]
+        return outstanding / rate if rate else outstanding
+
+    # ------------------------------------------------------- crash drain
+    def _on_replica_crash(self, frontend: ServingFrontend,
+                          salvaged: List[StreamHandle],
+                          exc: BaseException) -> None:
+        """``ServingFrontend`` crash hook (runs on the dead driver
+        thread): mark the replica dead, then re-home every salvaged —
+        never-prefilled, still-unresolved — handle on a survivor."""
+        with self._lock:
+            rep = self._by_frontend.get(id(frontend))
+            if rep is not None and not rep.dead:
+                rep.dead = True
+                self.n_replica_crashes += 1
+        # the dead driver thread carries its replica label; fleet-level
+        # reroute counters must not inherit it
+        with telemetry.replica_label(None):
+            telemetry.count("fleet/replica_crashes")
+            rid = rep.rid if rep is not None else "?"
+            logger.error(
+                f"fleet replica {rid} crashed "
+                f"({type(exc).__name__}: {exc}); re-routing "
+                f"{len(salvaged)} queued requests")
+            for handle in salvaged:
+                self._reroute(handle, exc)
+
+    def _reroute(self, handle: StreamHandle, exc: BaseException) -> None:
+        target = self._place(handle._request.prompt)
+        if target.alive and target.frontend.adopt(handle):
+            telemetry.count("fleet/rerouted")
+            with self._lock:
+                self.n_rerouted += 1
+            return
+        with self._lock:
+            self.n_reroute_failed += 1
+        telemetry.count("fleet/reroute_failed")
+        if not handle.done:   # adopt() resolves on its own rejections
+            handle._resolve(
+                "error",
+                error=f"replica crashed ({type(exc).__name__}: {exc}) "
+                      f"and no survivor accepted the request")
+
+    # ----------------------------------------------------------- queries
+    @property
+    def n_alive(self) -> int:
+        return sum(1 for r in self.replicas if r.alive)
+
+    def stats(self) -> Dict[str, Any]:
+        """Fleet-level counters plus every replica's own stats."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                "replicas": len(self.replicas),
+                "alive": self.n_alive,
+                "routed": self.n_routed,
+                "affinity_hits": self.n_affinity_hits,
+                "rerouted": self.n_rerouted,
+                "reroute_failed": self.n_reroute_failed,
+                "replica_crashes": self.n_replica_crashes,
+            }
+        out["per_replica"] = {
+            r.rid: {"alive": r.alive, **r.frontend.stats()}
+            for r in self.replicas}
+        return out

@@ -86,10 +86,15 @@ class TokenBucket:
 class ChunkThroughputEstimator:
     """EWMA of decode throughput (tokens/s) observed per consumed chunk.
     ``rate()`` is None until the first observation — the controller never
-    sheds on an unmeasured system (cold starts admit optimistically)."""
+    sheds on an unmeasured system (cold starts admit optimistically).
+
+    Thread safety: ``record`` runs on a replica's driver thread while a
+    fleet router reads ``rate``/``snapshot`` from caller threads, so the
+    EWMA fold and the reads serialize behind one lock."""
 
     def __init__(self, alpha: float = 0.3):
         self.alpha = float(alpha)
+        self._lock = threading.Lock()
         self._rate: Optional[float] = None
         self.n_samples = 0
 
@@ -97,12 +102,21 @@ class ChunkThroughputEstimator:
         if tokens <= 0 or dt_s <= 0:
             return
         sample = tokens / dt_s
-        self._rate = sample if self._rate is None else (
-            self.alpha * sample + (1.0 - self.alpha) * self._rate)
-        self.n_samples += 1
+        with self._lock:
+            self._rate = sample if self._rate is None else (
+                self.alpha * sample + (1.0 - self.alpha) * self._rate)
+            self.n_samples += 1
 
     def rate(self) -> Optional[float]:
-        return self._rate
+        with self._lock:
+            return self._rate
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One consistent read of the placement signal: EWMA tokens/s
+        (None before the first chunk) and how many samples back it."""
+        with self._lock:
+            return {"tokens_per_s": self._rate,
+                    "n_samples": self.n_samples}
 
 
 @dataclasses.dataclass
@@ -285,6 +299,25 @@ class AdmissionController:
     def pending(self) -> int:
         with self._lock:
             return self._pending
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One locked, allocation-cheap read of every placement signal a
+        fleet router needs: pending depth + bound, decision counters, and
+        per-tenant rate-limit state (current bucket tokens / rate /
+        burst). No heap walk beyond the bucket dict — O(tenants)."""
+        with self._lock:
+            return {
+                "pending": self._pending,
+                "max_pending": self.config.max_pending,
+                "n_offered": self.n_offered,
+                "n_rate_limited": self.n_rate_limited,
+                "n_shed": self.n_shed,
+                "n_memory_infeasible": self.n_memory_infeasible,
+                "rate_limits": {
+                    tenant: {"tokens": b._tokens, "rate": b.rate,
+                             "burst": b.burst}
+                    for tenant, b in self._buckets.items()},
+            }
 
     def drain(self) -> List[Ticket]:
         """Remove and return every live pending ticket (crash/teardown:

@@ -206,9 +206,17 @@ class ServingFrontend:
                  idle_wait_s: float = 0.005,
                  emit_every_s: float = 1.0,
                  trace_keep_last: int = 256,
+                 on_crash=None,
+                 telemetry_label: Optional[str] = None,
                  clock=time.monotonic):
         self._engine = engine
         self._clock = clock
+        # fleet hooks: ``on_crash(frontend, salvaged_handles, exc)`` gets
+        # the never-prefilled work when the driver dies (the router
+        # re-homes it on survivors); ``telemetry_label`` tags every metric
+        # the driver thread records with ``replica=<label>``
+        self._on_crash = on_crash
+        self._telemetry_label = telemetry_label
         self._controller = AdmissionController(admission, clock=clock)
         cfg = self._controller.config
         if cfg.shed_memory_infeasible and cfg.slot_tokens is None:
@@ -355,6 +363,25 @@ class ServingFrontend:
         with self._wake:
             return self._crash_error
 
+    def load_snapshot(self) -> Dict[str, Any]:
+        """Placement inputs for a fleet router: the admission
+        controller's and throughput estimator's locked snapshots plus
+        the engine backlog. Engine-side numbers are read without the
+        driver's cooperation, so they are approximate under concurrency
+        — fine for load scoring, not for invariants."""
+        sched = self._engine.scheduler
+        backlog = sum(r.max_new_tokens - len(r.tokens)
+                      for r in list(sched.running.values()))
+        backlog += sum(q.max_new_tokens + q.prompt_len
+                       for q in list(sched.queue))
+        return {
+            "admission": self._controller.snapshot(),
+            "throughput": self._estimator.snapshot(),
+            "engine_backlog_tokens": int(backlog),
+            "engine_queue_depth": len(sched.queue),
+            "engine_running": len(sched.running),
+        }
+
     def stats(self) -> Dict[str, Any]:
         """Control-plane counters (thread-safe, approximate under
         concurrency)."""
@@ -368,11 +395,59 @@ class ServingFrontend:
             "terminal": dict(self.tracing.counters),
         }
 
+    def adopt(self, handle: StreamHandle) -> bool:
+        """Re-home a never-prefilled handle from a crashed peer onto this
+        frontend (the fleet router's dead-replica drain path). The SAME
+        StreamHandle keeps streaming to its caller; only the backend
+        changes. Returns False — after resolving the handle ``rejected``
+        — when this frontend cannot take it; thread-safe."""
+        if handle.done:
+            return False
+        req = handle._request
+        # the request never prefilled on the dead replica: no slot, no
+        # tokens, no device state — reset the scheduler-side lifecycle
+        # fields so a fresh engine accepts it as new work
+        req.status = "new"
+        req.slot = None
+        req.submit_t = None
+        handle._frontend = self
+        now = self._clock()
+        meta = dict(tenant=handle.tenant, priority=handle.priority,
+                    prompt_len=req.prompt_len,
+                    max_new_tokens=req.max_new_tokens,
+                    slo_ttft_s=handle.slo_ttft_s, deadline_s=req.deadline_s)
+        self.n_submitted += 1
+        with self._wake:
+            dead = self._closing or self._crashed
+        if dead:
+            self.tracing.record_rejected(req.uid, REJECT_FRONTEND_CLOSED,
+                                         **meta)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_CLOSED)
+            return False
+        ticket = Ticket(prompt_len=req.prompt_len,
+                        max_new_tokens=req.max_new_tokens,
+                        priority=handle.priority, tenant=handle.tenant,
+                        deadline_s=req.deadline_s,
+                        slo_ttft_s=handle.slo_ttft_s, payload=handle)
+        handle._ticket = ticket
+        reason = self._controller.offer(ticket)
+        if reason is not None:
+            self.tracing.record_rejected(req.uid, reason, **meta)
+            handle._resolve("rejected", reject_reason=reason)
+            return False
+        self.tracing.start(req.uid, **meta)
+        self.tracing.mark(req.uid, "submitted", t=now)
+        with self._wake:
+            self._wake.notify()
+        return True
+
     # ------------------------------------------------------ driver loop
     def _drive(self) -> None:
         try:
-            while self._drive_once():
-                pass
+            with telemetry.replica_label(self._telemetry_label):
+                while self._drive_once():
+                    pass
         except BaseException as e:  # noqa: BLE001 — converted to results
             self._fail_all(e)
 
@@ -503,15 +578,43 @@ class ServingFrontend:
         """Driver crash: convert every outstanding request — pending
         admission, queued, running — into a structured ``error`` result
         so no caller blocks forever, then mark the frontend dead (new
-        submits reject with ``frontend_closed``)."""
+        submits reject with ``frontend_closed``).
+
+        With an ``on_crash`` hook installed, work that never touched the
+        device — admission-pending tickets plus engine-queued (never
+        prefilled) requests — is handed to the hook instead, still
+        unresolved, so a fleet router can re-home those handles on
+        surviving replicas. Requests that prefilled or streamed tokens
+        always resolve ``error`` here: their KV state died with the
+        replica."""
         msg = f"{type(exc).__name__}: {exc}"
         logger.error(f"serving frontend driver crashed: {msg}")
         with self._wake:
             self._crashed = True
             self._crash_error = exc
             cancels, self._cancel_requests = self._cancel_requests, []
+        salvaged: List[StreamHandle] = []
         for ticket in self._controller.drain():
-            handle: StreamHandle = ticket.payload
+            salvaged.append(ticket.payload)
+        # engine-queued requests were fed but never admitted to a slot:
+        # host-only state, safe to replay elsewhere (scheduler data is
+        # driver-owned and this IS the driver thread, post-crash)
+        sched = getattr(self._engine, "scheduler", None)
+        if sched is not None:
+            for req in list(sched.queue):
+                handle = self._handles.pop(req.uid, None)
+                if handle is not None:
+                    salvaged.append(handle)
+            sched.queue.clear()
+        if self._on_crash is not None and salvaged:
+            try:
+                self._on_crash(self, list(salvaged), exc)
+                salvaged = []
+            except Exception as hook_exc:  # noqa: BLE001 — fall back
+                logger.error(
+                    f"crash re-route hook failed ({hook_exc}); resolving "
+                    f"{len(salvaged)} salvaged handles as error")
+        for handle in salvaged:
             self.tracing.finish(handle.uid, "error", error=msg)
             handle._resolve("error", error=msg)
         for uid, handle in list(self._handles.items()):

@@ -170,6 +170,13 @@ class PrefixCache:
         self.evictions += 1
         return True
 
+    def __contains__(self, key: bytes) -> bool:
+        """Pure membership peek — no LRU reordering, no counter touch.
+        The fleet router probes every replica's cache per placement
+        decision; a probe must not refresh entries the replica itself
+        never re-used."""
+        return key in self._entries
+
     def __len__(self) -> int:
         return len(self._entries)
 

@@ -34,8 +34,8 @@ stay in the millisecond range. See docs/observability.md.
 """
 
 from .core import (NOOP_SPAN, TelemetryRuntime, configure,  # noqa: F401
-                   count, disable, enable, gauge, get_runtime, instant,
-                   span)
+                   count, current_replica, disable, enable, gauge,
+                   get_runtime, instant, replica_label, span)
 from .export import (chrome_trace, request_trace_events,  # noqa: F401
                      write_chrome_trace)
 from .summary import (emit_summary, phase_breakdown,  # noqa: F401
@@ -52,6 +52,7 @@ from .regression import (MetricSpec, detect_kind,  # noqa: F401
 __all__ = [
     "TelemetryRuntime", "get_runtime", "configure", "enable", "disable",
     "span", "instant", "count", "gauge", "NOOP_SPAN",
+    "replica_label", "current_replica",
     "chrome_trace", "write_chrome_trace", "request_trace_events",
     "summarize", "phase_breakdown", "emit_summary",
     "compiled_cost_analysis", "mfu_report", "peak_flops_per_device",

@@ -46,6 +46,52 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _US = 1e6
 
+# ---------------------------------------------------------------------------
+# Replica labeling. A fleet runs N serving replicas in ONE process against
+# one default runtime; without a discriminator their identically-named
+# gauges/counters would fold together (and the last gauge write would win).
+# The label is thread-local — each replica's driver thread tags everything
+# it records — and rides INSIDE the metric name as a ``|replica=<id>``
+# suffix, so the runtime's flat string-keyed dicts need no schema change.
+# The Prometheus exposition layer (exposition.py) splits the suffix back
+# out into a real ``{replica="<id>"}`` label before sanitizing the name.
+# ---------------------------------------------------------------------------
+
+_replica_ctx = threading.local()
+
+
+class _ReplicaLabel:
+    __slots__ = ("label", "_prev")
+
+    def __init__(self, replica):
+        self.label = None if replica is None else str(replica)
+
+    def __enter__(self):
+        self._prev = getattr(_replica_ctx, "label", None)
+        _replica_ctx.label = self.label
+        return self
+
+    def __exit__(self, *exc):
+        _replica_ctx.label = self._prev
+        return False
+
+
+def replica_label(replica) -> _ReplicaLabel:
+    """Context manager tagging every metric recorded on THIS thread with
+    ``|replica=<id>`` while active (nestable; ``None`` clears). Cheap
+    enough to wrap a whole driver loop iteration."""
+    return _ReplicaLabel(replica)
+
+
+def current_replica() -> Optional[str]:
+    """The replica label active on the calling thread, or None."""
+    return getattr(_replica_ctx, "label", None)
+
+
+def _labeled(name: str) -> str:
+    lbl = getattr(_replica_ctx, "label", None)
+    return name if lbl is None else f"{name}|replica={lbl}"
+
 
 class _NoopSpan:
     """Shared do-nothing context manager returned while disabled."""
@@ -169,6 +215,7 @@ class TelemetryRuntime:
         """A zero-duration timeline marker (Perfetto instant event)."""
         if not self.enabled:
             return
+        name = _labeled(name)
         ts = self.clock() * _US
         tid = threading.get_ident()
         with self._lock:
@@ -181,6 +228,7 @@ class TelemetryRuntime:
         cumulative value as a counter-track sample."""
         if not self.enabled:
             return
+        name = _labeled(name)
         ts = self.clock() * _US
         with self._lock:
             val = self._counters.get(name, 0.0) + float(delta)
@@ -192,6 +240,7 @@ class TelemetryRuntime:
         value as-is on the counter track."""
         if not self.enabled:
             return
+        name = _labeled(name)
         ts = self.clock() * _US
         with self._lock:
             self._gauges[name] = float(value)
@@ -200,6 +249,7 @@ class TelemetryRuntime:
     # --------------------------------------------------- internal helpers
     def _record_span(self, name: str, t0: float, t1: float,
                      attrs: Optional[Dict[str, Any]]) -> None:
+        name = _labeled(name)
         tid = threading.get_ident()
         dur_s = t1 - t0
         with self._lock:

@@ -55,6 +55,23 @@ def sanitize_metric_name(name: str) -> str:
     return out
 
 
+def split_embedded_labels(name: str):
+    """Split ``base|k=v|k2=v2`` embedded-label suffixes (the telemetry
+    core's thread-local replica tag rides inside metric names this way —
+    see ``telemetry.core.replica_label``) into ``(base, labels|None)``.
+    Must run BEFORE :func:`sanitize_metric_name`, which would mangle the
+    ``|``/``=`` delimiters into underscores."""
+    if "|" not in name:
+        return name, None
+    base, *parts = name.split("|")
+    labels = {}
+    for part in parts:
+        key, _, value = part.partition("=")
+        if key:
+            labels[key] = value
+    return base, labels or None
+
+
 def escape_label_value(value: str) -> str:
     """Label-value escaping per the text format: backslash, quote,
     newline."""
@@ -72,13 +89,18 @@ def _line(name: str, value: float,
 
 
 def _summary(lines: List[str], name: str, *, quantiles: Mapping[float, float],
-             count: int, total: float, help_: str) -> None:
-    lines.append(f"# HELP {name} {help_}")
-    lines.append(f"# TYPE {name} summary")
+             count: int, total: float, help_: str,
+             labels: Optional[Mapping[str, str]] = None,
+             headers: bool = True) -> None:
+    if headers:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} summary")
     for q, v in quantiles.items():
-        lines.append(_line(name, float(v), {"quantile": str(q)}))
-    lines.append(_line(f"{name}_count", float(count)))
-    lines.append(_line(f"{name}_sum", float(total)))
+        ql = dict(labels or {})
+        ql["quantile"] = str(q)
+        lines.append(_line(name, float(v), ql))
+    lines.append(_line(f"{name}_count", float(count), labels))
+    lines.append(_line(f"{name}_sum", float(total), labels))
 
 
 def render_prometheus(*, runtime=None, tracelog=None,
@@ -88,26 +110,42 @@ def render_prometheus(*, runtime=None, tracelog=None,
     All arguments optional — pass whatever the process has."""
     ns = sanitize_metric_name(namespace)
     lines: List[str] = []
+    # N replicas share one runtime: the same family can appear once per
+    # embedded label set, but its TYPE/HELP header must render only once
+    typed: set = set()
+
+    def _header(m: str, kind: str) -> None:
+        if m not in typed:
+            typed.add(m)
+            lines.append(f"# TYPE {m} {kind}")
+
     if runtime is not None:
         for name, total in sorted(runtime.counter_totals().items()):
-            m = f"{ns}_{sanitize_metric_name(name)}_total"
-            lines.append(f"# TYPE {m} counter")
-            lines.append(_line(m, float(total)))
+            base, labels = split_embedded_labels(name)
+            m = f"{ns}_{sanitize_metric_name(base)}_total"
+            _header(m, "counter")
+            lines.append(_line(m, float(total), labels))
         for name, value in sorted(runtime.gauge_values().items()):
-            m = f"{ns}_{sanitize_metric_name(name)}"
-            lines.append(f"# TYPE {m} gauge")
-            lines.append(_line(m, float(value)))
+            base, labels = split_embedded_labels(name)
+            m = f"{ns}_{sanitize_metric_name(base)}"
+            _header(m, "gauge")
+            lines.append(_line(m, float(value), labels))
         for name, n in sorted(runtime.instant_counts().items()):
-            m = f"{ns}_{sanitize_metric_name(name)}_events_total"
-            lines.append(f"# TYPE {m} counter")
-            lines.append(_line(m, float(n)))
+            base, labels = split_embedded_labels(name)
+            m = f"{ns}_{sanitize_metric_name(base)}_events_total"
+            _header(m, "counter")
+            lines.append(_line(m, float(n), labels))
         for name, st in sorted(runtime.span_stats().items()):
-            m = f"{ns}_span_{sanitize_metric_name(name)}_seconds"
+            base, labels = split_embedded_labels(name)
+            m = f"{ns}_span_{sanitize_metric_name(base)}_seconds"
+            headers = m not in typed
+            typed.add(m)
             _summary(lines, m,
                      quantiles={q: st[f"p{round(q * 100)}_s"]
                                 for q in _QUANTILES},
                      count=st["count"], total=st["total_s"],
-                     help_=f"telemetry span {name} duration")
+                     help_=f"telemetry span {base} duration",
+                     labels=labels, headers=headers)
     if tracelog is not None:
         for name, st in sorted(tracelog.histogram_stats().items()):
             base = name[:-2] if name.endswith("_s") else name

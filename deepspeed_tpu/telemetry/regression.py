@@ -134,9 +134,38 @@ FRONTEND_SPECS: List[MetricSpec] = [
     MetricSpec(("hbm", "arena", "arena_bytes"), LOWER, 0.10),
 ]
 
+FLEET_SPECS: List[MetricSpec] = [
+    # ---- data-parallel router (2 replicas vs 1, open-loop burst) ----
+    MetricSpec(("replica_scaling",), HIGHER, 0.20,
+               note="2-replica router throughput over single-replica; "
+                    "the acceptance floor (>= 1.6x) is asserted inside "
+                    "the bench itself"),
+    MetricSpec(("fleet_tokens_per_s",), HIGHER, 0.30),
+    MetricSpec(("single_tokens_per_s",), HIGHER, 0.30),
+    MetricSpec(("router_streaming_parity",), SHIFT, abs_tol=0.0,
+               note="routed streams vs ServingEngine.run is binary"),
+    MetricSpec(("router", "shed",), SHIFT, abs_tol=0.0,
+               note="the pinned workload must not shed"),
+    MetricSpec(("router", "rerouted",), SHIFT, abs_tol=0.0,
+               note="no crashes injected in the bench workload"),
+    # ---- tensor-parallel serving (tp=2 on the 8-device CPU mesh) ----
+    MetricSpec(("tp", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="tp=2 vs tp=1 bit-exactness is binary"),
+    MetricSpec(("tp", "decode_chunk_compiles"), SHIFT, abs_tol=0.0,
+               note="pinned tp retrace budget"),
+    # ---- prefill/decode disaggregation ----
+    MetricSpec(("disagg", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="disaggregated handoff bit-exactness is binary"),
+    MetricSpec(("disagg", "decode_chunk_compiles"), SHIFT, abs_tol=0.0,
+               note="pinned disagg retrace budget"),
+    MetricSpec(("disagg", "handoffs"), SHIFT, abs_tol=0.0,
+               note="one D2D handoff per prefilled request"),
+]
+
 SPEC_SETS: Dict[str, List[MetricSpec]] = {
     "serving": SERVING_SPECS,
     "frontend": FRONTEND_SPECS,
+    "fleet": FLEET_SPECS,
 }
 
 
@@ -145,6 +174,8 @@ def detect_kind(doc: Dict[str, Any]) -> Optional[str]:
         return "serving"
     if "capacity_tokens_per_s" in doc:
         return "frontend"
+    if "replica_scaling" in doc:
+        return "fleet"
     return None
 
 
@@ -212,7 +243,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "bands; exit 1 on regression.")
     p.add_argument("baseline", help="baseline BENCH_*.json")
     p.add_argument("current", help="current BENCH_*.json")
-    p.add_argument("--kind", choices=["auto", "serving", "frontend"],
+    p.add_argument("--kind",
+                   choices=["auto", "serving", "frontend", "fleet"],
                    default="auto")
     p.add_argument("--fail-on-missing", action="store_true",
                    help="exit 1 when a watched metric is absent from "

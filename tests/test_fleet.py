@@ -1,0 +1,331 @@
+"""Fleet serving: replica router, crash drain, snapshot surfaces.
+
+Covers the PR-9 fleet tier end to end at tier-1 speed:
+
+* the locked ``snapshot()`` surfaces on :class:`AdmissionController`
+  and :class:`ChunkThroughputEstimator` (the router's placement
+  inputs);
+* placement policy on JAX-free fake replicas — least-loaded scoring,
+  prefix-affinity preference, dead-replica skip;
+* :class:`PrefixCache.__contains__` as a pure peek (no LRU refresh —
+  router probes must not distort the replica's own eviction order);
+* routed streaming parity against the single-engine
+  ``ServingEngine.run`` oracle;
+* dead-replica drain: an injected driver crash re-homes every
+  never-prefilled request onto the survivor (same StreamHandle
+  objects), while prefilled work resolves ``error``;
+* concurrent multi-engine isolation: two engines pumped from separate
+  threads retrace exactly like two engines pumped sequentially.
+
+Tensor-parallel and disaggregated-prefill parity live in
+``test_serving.py`` (they are engine properties, not router
+properties).
+"""
+
+import threading
+from collections import deque
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving import BlockAllocator, PrefixCache
+from deepspeed_tpu.serving.fleet import FleetRouter
+from deepspeed_tpu.serving.frontend import (AdmissionConfig,
+                                            AdmissionController,
+                                            ChunkThroughputEstimator,
+                                            Ticket)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------- satellite: snapshots
+class TestSnapshots:
+    def test_admission_snapshot_reports_pending_and_counters(self):
+        clock = FakeClock()
+        c = AdmissionController(
+            AdmissionConfig(max_pending=4, rate_per_tenant=1.0,
+                            burst_per_tenant=1.0), clock=clock)
+        assert c.offer(Ticket(prompt_len=4, max_new_tokens=8,
+                              tenant="a")) is None
+        assert c.offer(Ticket(prompt_len=4, max_new_tokens=8,
+                              tenant="a")) is not None   # rate limited
+        snap = c.snapshot()
+        assert snap["pending"] == 1
+        assert snap["max_pending"] == 4
+        assert snap["n_offered"] == 2
+        assert snap["n_rate_limited"] == 1
+        assert snap["n_shed"] == 0
+        assert "a" in snap["rate_limits"]
+        bucket = snap["rate_limits"]["a"]
+        assert bucket["rate"] == 1.0 and bucket["burst"] == 1.0
+        assert bucket["tokens"] < 1.0          # the burst was consumed
+        # the snapshot is a copy: mutating it must not touch the
+        # controller
+        snap["pending"] = 99
+        assert c.snapshot()["pending"] == 1
+
+    def test_estimator_snapshot_cold_and_warm(self):
+        est = ChunkThroughputEstimator()
+        cold = est.snapshot()
+        assert cold["tokens_per_s"] is None and cold["n_samples"] == 0
+        est.record(100, 1.0)
+        warm = est.snapshot()
+        assert warm["n_samples"] == 1
+        assert warm["tokens_per_s"] == pytest.approx(est.rate())
+
+
+# ------------------------------------------- placement on fake replicas
+class _FakeSched:
+    def __init__(self):
+        self.queue = deque()
+        self.running = {}
+        self.finished = []
+
+    def has_work(self):
+        return False
+
+
+class _FakeKV:
+    prefix_enabled = True
+
+    def __init__(self):
+        self.prefix_cache = set()
+
+
+class _FakeEngine:
+    """Just enough surface for ServingFrontend + router placement: the
+    driver thread idles (no work), placement reads load_snapshot and
+    the prefix cache."""
+
+    def __init__(self, with_kv=False):
+        self.max_seq_len = 64
+        self.max_batch = 4
+        self.scheduler = _FakeSched()
+        self.chunk_in_flight = False
+        if with_kv:
+            self.kv = _FakeKV()
+
+
+def _stub_load(router, rid, *, pending=0, backlog=0, rate=None):
+    """Pin one replica's placement inputs (the live driver thread would
+    otherwise race any state injected into the real controller)."""
+    router.replicas[rid].frontend.load_snapshot = lambda: {
+        "admission": {"pending": pending},
+        "throughput": {"tokens_per_s": rate},
+        "engine_backlog_tokens": backlog,
+        "engine_queue_depth": 0,
+        "engine_running": 0,
+    }
+
+
+class TestPlacement:
+    def test_least_loaded_prefers_empty_replica(self):
+        with FleetRouter([_FakeEngine(), _FakeEngine()],
+                         affinity=False) as router:
+            _stub_load(router, 0, pending=3, backlog=40)
+            _stub_load(router, 1)
+            prompt = np.arange(1, 5, dtype=np.int32)
+            assert router._place(prompt).rid == 1
+            _stub_load(router, 1, pending=5, backlog=200)
+            assert router._place(prompt).rid == 0
+
+    def test_throughput_normalizes_load(self):
+        # replica 0 has more queued tokens but drains 10x faster
+        with FleetRouter([_FakeEngine(), _FakeEngine()],
+                         affinity=False) as router:
+            _stub_load(router, 0, backlog=100, rate=100.0)
+            _stub_load(router, 1, backlog=50, rate=10.0)
+            prompt = np.arange(1, 5, dtype=np.int32)
+            assert router._place(prompt).rid == 0
+
+    def test_affinity_beats_load(self):
+        with FleetRouter([_FakeEngine(with_kv=True),
+                          _FakeEngine(with_kv=True)]) as router:
+            prompt = np.arange(1, 9, dtype=np.int32)
+            key = PrefixCache.key_for(prompt)
+            # replica 0 is busier but holds the prefix: affinity wins
+            router.replicas[0].engine.kv.prefix_cache.add(key)
+            _stub_load(router, 0, pending=4, backlog=80)
+            _stub_load(router, 1)
+            assert router._place(prompt).rid == 0
+            assert router.n_affinity_hits == 1
+            # an unknown prompt falls back to least-loaded
+            other = np.arange(20, 28, dtype=np.int32)
+            assert router._place(other).rid == 1
+
+    def test_dead_replica_skipped(self):
+        with FleetRouter([_FakeEngine(), _FakeEngine()],
+                         affinity=False) as router:
+            router.replicas[1].dead = True
+            _stub_load(router, 0, pending=8, backlog=400)
+            _stub_load(router, 1)
+            prompt = np.arange(1, 5, dtype=np.int32)
+            for _ in range(3):
+                assert router._place(prompt).rid == 0
+            assert router.n_alive == 1
+
+    def test_requires_engines(self):
+        with pytest.raises(ValueError):
+            FleetRouter([])
+
+
+def test_prefix_cache_contains_is_a_pure_peek():
+    ba = BlockAllocator(num_blocks=4, block_size=16)
+    pc = PrefixCache(capacity=4)
+    k1, k2 = b"one", b"two"
+    pc.put(k1, (ba.alloc(),), 16, 1, ba)
+    pc.put(k2, (ba.alloc(),), 16, 1, ba)
+    assert k1 in pc and b"missing" not in pc
+    # the peek must NOT refresh LRU order; lookup() must
+    assert list(pc._entries) == [k1, k2]
+    pc.lookup(k1)
+    assert list(pc._entries) == [k2, k1]
+
+
+# --------------------------------------------- integration (real engine)
+def _tiny(vocab=64, max_seq=64):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    cfg = GPTConfig(vocab_size=vocab, max_seq_len=max_seq, num_layers=2,
+                    num_heads=2, d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, remat=False)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    model, params = _tiny()
+    return ds.init_inference(model, model_parameters=params,
+                             dtype=jnp.float32)
+
+
+def _serving(tiny_engine, **kw):
+    from deepspeed_tpu.serving import ServingEngine
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_prompt_len", 16)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("decode_chunk", 4)
+    return ServingEngine(engine=tiny_engine, **kw)
+
+
+def _prompts(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, int(rng.integers(3, 9))).astype(np.int32)
+            for _ in range(n)]
+
+
+class TestFleetIntegration:
+    def test_routed_streams_match_engine_run(self, tiny_engine):
+        prompts = _prompts(6)
+        oracle = _serving(tiny_engine)
+        want = [r.output_ids for r in oracle.run(prompts,
+                                                 max_new_tokens=6)]
+        with FleetRouter([_serving(tiny_engine),
+                          _serving(tiny_engine)]) as router:
+            handles = [router.submit(p, max_new_tokens=6)
+                       for p in prompts]
+            for h in handles:
+                assert h.result(timeout=60) == "done"
+            for w, h in zip(want, handles):
+                assert np.array_equal(w, h.output_ids)
+            stats = router.stats()
+        assert stats["routed"] == 6
+        assert stats["replica_crashes"] == 0
+        # both replicas took part in serving (least-loaded spreads an
+        # open-loop burst across the fleet)
+        per = stats["per_replica"]
+        assert sum(per[r]["submitted"] for r in per) == 6
+
+    def test_injected_crash_reroutes_queued_to_survivor(self, tiny_engine):
+        """The dead-replica drain: requests the crashed replica never
+        prefilled must complete on the survivor — same handles, correct
+        tokens — while prefilled requests resolve ``error``."""
+        prompts = _prompts(6, seed=1)
+        oracle = _serving(tiny_engine)
+        want = [r.output_ids for r in oracle.run(prompts,
+                                                 max_new_tokens=6)]
+        entered, release = threading.Event(), threading.Event()
+
+        def boom(*a, **k):
+            entered.set()
+            release.wait(30)
+            raise RuntimeError("injected decode fault")
+
+        crashy = _serving(tiny_engine)
+        survivor = _serving(tiny_engine)
+        with FleetRouter([crashy, survivor], affinity=False) as router:
+            crashy._jit_decode_chunk = boom
+            router.replicas[1].dead = True      # steer traffic to 0
+            first = router.submit(prompts[0], max_new_tokens=6)
+            assert entered.wait(30)             # replica 0 is wedged
+            rest = [router.submit(p, max_new_tokens=6)
+                    for p in prompts[1:]]
+            router.replicas[1].dead = False
+            release.set()
+            assert first.result(timeout=60) == "error"
+            assert "injected decode fault" in first.error
+            for w, h in zip(want[1:], rest):
+                assert h.result(timeout=60) == "done"
+                assert np.array_equal(w, h.output_ids)
+            stats = router.stats()
+            assert stats["replica_crashes"] == 1
+            assert stats["rerouted"] == len(rest)
+            assert stats["alive"] == 1
+            # post-crash traffic lands on the survivor
+            late = router.submit(prompts[0], max_new_tokens=6)
+            assert late.result(timeout=60) == "done"
+            assert np.array_equal(want[0], late.output_ids)
+
+    def test_concurrent_engines_do_not_cross_retrace(self, tiny_engine):
+        """Two engines pumped from separate threads must keep their
+        per-engine variant budgets: exactly the same decode-program
+        compile count as two engines run sequentially, and identical
+        outputs (the auditor is not reentrant, so one auditor scopes
+        each phase)."""
+        from deepspeed_tpu.analysis.auditor import TraceAuditor
+        prompts = _prompts(4, seed=2)
+        with TraceAuditor(audit_jaxprs=False) as base_aud:
+            e0 = _serving(tiny_engine)
+            base = [r.output_ids for r in e0.run(prompts,
+                                                 max_new_tokens=6)]
+            n_single = base_aud.compiles("decode_chunk_fn")
+        assert n_single >= 1
+        with TraceAuditor(audit_jaxprs=False) as aud:
+            engines = [_serving(tiny_engine), _serving(tiny_engine)]
+            results = [None, None]
+            errors = []
+
+            def run(i):
+                try:
+                    results[i] = engines[i].run(prompts, max_new_tokens=6)
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            assert not errors
+            n_pair = aud.compiles("decode_chunk_fn")
+        assert n_pair == 2 * n_single
+        for res in results:
+            got = [r.output_ids for r in res]
+            for w, g in zip(base, got):
+                assert np.array_equal(w, g)

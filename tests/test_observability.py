@@ -112,6 +112,76 @@ class TestPrometheusRendering:
         with pytest.raises(ValueError):
             parse_prometheus_text("dstpu_ok 1\n}{garbage\n")
 
+    def test_split_embedded_labels(self):
+        from deepspeed_tpu.telemetry.exposition import split_embedded_labels
+        assert split_embedded_labels("serve/q") == ("serve/q", None)
+        assert split_embedded_labels("serve/q|replica=3") == \
+            ("serve/q", {"replica": "3"})
+        assert split_embedded_labels("a|replica=0|tier=hot") == \
+            ("a", {"replica": "0", "tier": "hot"})
+        # degenerate suffixes never produce empty-keyed labels
+        assert split_embedded_labels("a|") == ("a", None)
+
+    def test_replica_labels_golden_round_trip(self):
+        """The fleet path: N replicas record into ONE runtime under
+        thread-local ``replica_label``; exposition must split the
+        embedded suffix into a real ``{replica="..."}`` label, emit ONE
+        TYPE header per family, and keep unlabeled names byte-stable."""
+        rt = tel.TelemetryRuntime(enabled=True)
+        for rid in range(2):
+            with tel.core.replica_label(rid):
+                rt.count("serve/tokens_out", 10.0 * (rid + 1))
+                rt.gauge("frontend/queue_depth", float(rid))
+                rt.instant("engine/retrace")
+                with rt.span("serve/decode_chunk"):
+                    pass
+        rt.count("fleet/routed", 4.0)           # fleet-level: unlabeled
+
+        text = render_prometheus(runtime=rt)
+        parsed = parse_prometheus_text(text)
+        samples, types = parsed["samples"], parsed["types"]
+
+        tok = dict((lab.get("replica"), v) for lab, v in
+                   samples["dstpu_serve_tokens_out_total"])
+        assert tok == {"0": 10.0, "1": 20.0}
+        depth = dict((lab.get("replica"), v) for lab, v in
+                     samples["dstpu_frontend_queue_depth"])
+        assert depth == {"0": 0.0, "1": 1.0}
+        events = samples["dstpu_engine_retrace_events_total"]
+        assert {lab["replica"] for lab, _ in events} == {"0", "1"}
+        assert samples["dstpu_fleet_routed_total"] == [({}, 4.0)]
+
+        # one TYPE header per family even with N labeled series
+        for fam, kind in (("dstpu_serve_tokens_out_total", "counter"),
+                          ("dstpu_frontend_queue_depth", "gauge")):
+            assert types[fam] == kind
+            assert text.count(f"# TYPE {fam} ") == 1
+        fam = "dstpu_span_serve_decode_chunk_seconds"
+        assert types[fam] == "summary"
+        assert text.count(f"# TYPE {fam} ") == 1
+        counts = dict((lab.get("replica"), v) for lab, v in
+                      samples[fam + "_count"])
+        assert counts == {"0": 1.0, "1": 1.0}
+
+    def test_replica_label_is_thread_local_and_nestable(self):
+        assert tel.core.current_replica() is None
+        with tel.core.replica_label(1):
+            assert tel.core.current_replica() == "1"
+            with tel.core.replica_label(None):       # fleet-level escape
+                assert tel.core.current_replica() is None
+            assert tel.core.current_replica() == "1"
+        assert tel.core.current_replica() is None
+        seen = {}
+
+        def worker():
+            seen["inner"] = tel.core.current_replica()
+
+        with tel.core.replica_label(7):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen["inner"] is None          # labels never leak threads
+
     def test_reservoir_total_is_running_sum(self):
         r = Reservoir(capacity=4)
         for x in range(10):            # overflows capacity

@@ -589,3 +589,77 @@ def test_continuous_batching_beats_sequential(tmp_path):
     assert result["speedup"] > 1.0, result
     assert result["csv_files"], "serving metrics CSVs missing"
     assert os.path.isdir(str(tmp_path / "csv"))
+
+
+class TestShardedServing:
+    """Tensor-parallel and disaggregated-prefill serving are PLACEMENT
+    changes, never math changes: greedy token streams must be
+    bit-identical to the unsharded engine (replication/sharding moves
+    data; the row-parallel psum's f32 reassociation never flips a greedy
+    argmax on these magnitudes), and each mode compiles under its own
+    ``decode_chunk*_fn`` variant name so the pinned dense/paged budgets
+    stay exact."""
+
+    def _engine(self, model, params, **kw):
+        import jax.numpy as jnp
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("decode_chunk", 4)
+        return ServingEngine(model, model_parameters=params,
+                             dtype=jnp.float32, **kw)
+
+    def _prompts(self, n=4):
+        rng = np.random.default_rng(3)
+        return [rng.integers(1, 64, int(rng.integers(3, 9)))
+                .astype(np.int32) for _ in range(n)]
+
+    def test_tp2_bit_identical_with_own_variant(self):
+        from deepspeed_tpu.analysis.auditor import TraceAuditor
+        model, params = _tiny()
+        prompts = self._prompts()
+        base = self._engine(model, params).run(prompts, max_new_tokens=6)
+        with TraceAuditor(audit_jaxprs=False) as aud:
+            tp_eng = self._engine(model, params, tp=2)
+            got = tp_eng.run(prompts, max_new_tokens=6)
+        assert tp_eng.tp == 2
+        # its own program family — zero compiles against the dense name
+        assert aud.compiles("decode_chunk_tp2_fn") >= 1
+        assert aud.compiles("decode_chunk_fn") == 0
+        for b, g in zip(base, got):
+            assert g.status == "done"
+            np.testing.assert_array_equal(b.output_ids, g.output_ids)
+
+    def test_disaggregated_prefill_bit_identical(self):
+        from deepspeed_tpu.analysis.auditor import TraceAuditor
+        from deepspeed_tpu.telemetry import core as telemetry
+        model, params = _tiny()
+        prompts = self._prompts()
+        base = self._engine(model, params, paged=True).run(
+            prompts, max_new_tokens=6)
+        telemetry.enable()
+        try:
+            with TraceAuditor(audit_jaxprs=False) as aud:
+                dis = self._engine(model, params, paged=True,
+                                   disaggregate_prefill=True)
+                got = dis.run(prompts, max_new_tokens=6)
+            assert dis.disaggregated
+            assert aud.compiles("decode_chunk_paged_disagg_fn") >= 1
+            assert aud.compiles("decode_chunk_paged_fn") == 0
+            # every prefill handed its KV to the decode slice
+            counters = telemetry.get_runtime().counter_totals()
+            assert counters.get("serve/disagg_handoffs", 0) >= len(prompts)
+            assert counters.get("serve/disagg_handoff_bytes", 0) > 0
+        finally:
+            telemetry.disable()
+            telemetry.get_runtime().clear()
+        for b, g in zip(base, got):
+            assert g.status == "done"
+            np.testing.assert_array_equal(b.output_ids, g.output_ids)
+
+    def test_tp_mismatch_raises(self):
+        import jax.numpy as jnp
+        import deepspeed_tpu as ds
+        model, params = _tiny()
+        eng = ds.init_inference(model, model_parameters=params,
+                                dtype=jnp.float32)          # tp=1 mesh
+        with pytest.raises(ValueError):
+            ServingEngine(engine=eng, tp=2)
