@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Fleet smoke: the fleet serving benchmark on CPU. Four asserted cases:
+# Fleet smoke: the fleet serving benchmark on CPU. Five asserted cases:
 # 2-replica FleetRouter >= 1.6x a 1-replica router over
 # simulated-compute replicas (real scheduler/admission/stream stack,
 # sleep-for-device — one XLA CPU engine already saturates every host
@@ -9,7 +9,11 @@
 # the 8-virtual-device mesh bit-identical to tp=1 under the pinned
 # decode_chunk_tp2_fn budget; disaggregated prefill bit-identical to
 # co-located paged with exactly one D2D handoff per prefill under the
-# pinned decode_chunk_paged_disagg_fn budget. Writes BENCH_fleet.json
+# pinned decode_chunk_paged_disagg_fn budget; an injected mid-stream
+# replica crash produces a fully-connected journey trace (one trace id
+# per request incl. reroutes), a postmortem whose in-flight set
+# matches the error/rerouted handles, and SLO burn rates that move
+# during the crash window and recover. Writes BENCH_fleet.json
 # at the repo root and exits nonzero on any parity/scaling/budget
 # failure — fast enough for tier-1.
 #

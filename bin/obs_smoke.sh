@@ -9,9 +9,17 @@
 #      the gate actually fires (a sentry that can't fail is decoration);
 #   3. live /metrics scrape — a short frontend_bench run self-scrapes
 #      its own metrics server (TTFT quantiles + arena-headroom gauge
-#      parsed out of real Prometheus text) and asserts /readyz answers
-#      200 while serving. frontend_bench raises on a failed scrape, so
-#      this doubles as the exposition integration test.
+#      parsed out of real Prometheus text), asserts /readyz answers
+#      200 while serving, and live-GETs /slo (schema + dstpu_slo_*
+#      gauges on /metrics). frontend_bench raises on a failed scrape,
+#      so this doubles as the exposition integration test;
+#   4. fleet journey trace — a fleet_bench run with its injected
+#      mid-stream replica crash emits a merged journey trace;
+#      `tputrace journey --validate` must pass (every request one
+#      connected journey under one trace id, rerouted requests carry
+#      the reroute link), the crash postmortem's in-flight set must
+#      exactly match the handles reported error/rerouted, and the SLO
+#      burn-rate gauges must move during the crash window and recover.
 #
 # Usage: bin/obs_smoke.sh    (from the repo root, or anywhere)
 
@@ -21,7 +29,7 @@ cd "$(dirname "$0")/.." || exit 1
 fail=0
 
 # ---- 1. committed baselines must self-diff clean -----------------------
-for bench in BENCH_serving.json BENCH_frontend.json; do
+for bench in BENCH_serving.json BENCH_frontend.json BENCH_fleet.json; do
     if [ ! -f "$bench" ]; then
         echo "obs_smoke: MISSING baseline $bench" >&2
         fail=1
@@ -66,13 +74,54 @@ assert s["readyz"] == 200, s
 assert s["ttft_quantiles_s"], s
 assert s["arena_headroom_bytes"] >= 0, s
 assert d["hbm"] and d["hbm"]["decode_chunk"]["temp_bytes"] > 0, d["hbm"]
+slo = d["slo"]
+assert slo["endpoint_ok"] == 1.0, slo      # live GET /slo parsed clean
+assert slo["n_slos"] >= 4 and slo["n_samples"] > 0, slo
 print("obs_smoke: live /metrics scrape ok "
       f"({s['n_families']} families, ttft p99="
-      f"{s['ttft_quantiles_s'].get('0.99')}s)")
+      f"{s['ttft_quantiles_s'].get('0.99')}s, /slo "
+      f"{slo['n_slos']} objectives over {slo['n_samples']} samples)")
 EOF
     [ $? -ne 0 ] && fail=1
 else
     echo "obs_smoke: FAIL frontend_bench live-scrape run" >&2
+    fail=1
+fi
+
+# ---- 4. fleet journeys: crash-connected trace + postmortem + SLO burn --
+if timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m deepspeed_tpu.benchmarks.fleet_bench \
+    --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
+    --decode-chunk 8 --json-out /tmp/obs_smoke_fleet.json \
+    --trace-out /tmp/obs_smoke_fleet_trace.json > /dev/null; then
+    if python bin/tputrace journey /tmp/obs_smoke_fleet_trace.json \
+        --validate > /dev/null; then
+        echo "obs_smoke: fleet journey trace validates"
+    else
+        echo "obs_smoke: FAIL tputrace journey --validate" >&2
+        fail=1
+    fi
+    python - <<'EOF'
+import json
+d = json.load(open("/tmp/obs_smoke_fleet.json"))
+c, j, s = d["crash"], d["journey"], d["slo"]
+# every in-flight handle at crash time is in the postmortem, and only them
+assert c["postmortem_inflight_match"] == 1.0, c
+assert c["journey_complete"] == 1.0 and c["rerouted_parity"] == 1.0, c
+assert c["rerouted"] > 0 and c["errors"] >= 1, c
+assert j["complete"] == 1.0 and j["rerouted_links"] == c["rerouted"], j
+# burn rate moved during the crash window and recovered after it
+assert s["burn_crash"] > s["burn_pre"], s
+assert s["burn_recovered"] == 0.0, s
+print("obs_smoke: fleet crash observability ok "
+      f"({j['n_traces']} journeys, {c['rerouted']} rerouted, "
+      f"burn {s['burn_pre']} -> {s['burn_crash']} -> "
+      f"{s['burn_recovered']})")
+EOF
+    [ $? -ne 0 ] && fail=1
+else
+    echo "obs_smoke: FAIL fleet_bench crash-observability run" >&2
     fail=1
 fi
 

@@ -1,6 +1,7 @@
-"""Fleet serving benchmark: replica routing, tp=2, and disaggregation.
+"""Fleet serving benchmark: replica routing, tp=2, disaggregation, and
+crash observability.
 
-Four cases over one tiny model (CPU-runnable, smoke-sized):
+Five cases over one tiny model (CPU-runnable, smoke-sized):
 
   * router scaling — a 2-replica :class:`FleetRouter` against a
     1-replica router on SIMULATED-compute replicas: engines that honor
@@ -33,6 +34,15 @@ Four cases over one tiny model (CPU-runnable, smoke-sized):
     greedy parity against the co-located paged engine, pinned compile
     count, and exactly one D2D handoff per prefilled request.
 
+  * crash observability — an injected mid-decode-chunk replica crash
+    over a 2-replica fleet: the flight-recorder postmortem's in-flight
+    set must exactly match the handles that resolved error/rerouted,
+    every request (rerouted included) must render as ONE connected
+    journey under one trace id in the merged Perfetto export
+    (``validate_journeys``), and the availability SLO burn rate must
+    move during the crash window and recover after it (``--slo`` /
+    ``--trace-out``).
+
 Run:  python -m deepspeed_tpu.benchmarks.fleet_bench --json-out BENCH_fleet.json
 (needs XLA_FLAGS=--xla_force_host_platform_device_count=8 for the tp
 case; ``bin/fleet_smoke.sh`` sets it). Compare runs with bin/benchdiff
@@ -45,6 +55,7 @@ import argparse
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -173,7 +184,8 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               max_batch: int = 8, prompt_len: int = 16,
               decode_chunk: int = 8, seed: int = 0,
               sim_requests: int = 16,
-              sim_chunk_time_s: float = 0.005) -> dict:
+              sim_chunk_time_s: float = 0.005,
+              slo: bool = True, trace_out: Optional[str] = None) -> dict:
     import jax.numpy as jnp
     import deepspeed_tpu as ds
     from .. import telemetry
@@ -324,7 +336,193 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             f"prefill; prefix cache covers the warm repeats), "
             f"saw {handoffs}")
 
+    # ---- crash journeys + SLO burn + flight recorder -------------------
+    # LAST on purpose: this case injects a mid-stream replica crash, and
+    # the parity cases above assert their crash counters are zero.
+    result.update(_crash_case(
+        inf, eng_kw, prompts, oracle_out, max_new_tokens,
+        slo=slo, trace_out=trace_out))
+
     return _round_tree(result)
+
+
+def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
+                slo=True, trace_out=None,
+                slo_windows_s=(2.0, 20.0)) -> dict:
+    """Injected mid-stream replica crash over a 2-replica fleet:
+
+    * phase A (healthy) — a routed batch lands on the survivor; every
+      SLO burn rate must be 0;
+    * phase B (crash) — one request is wedged mid-decode-chunk on the
+      crashy replica, the rest queue behind it, then the chunk raises.
+      The running request resolves ``error``, the queued ones re-route
+      to the survivor and finish with greedy parity. The crashed
+      frontend's flight recorder must dump a postmortem whose in-flight
+      set EXACTLY matches the error + rerouted handles, and the
+      availability burn rate must move;
+    * phase C (recovered) — after the fast window drains, a healthy
+      batch brings the fast burn rate back to 0.
+
+    The router's merged Perfetto export must pass
+    ``validate_journeys``: every request — including the rerouted ones —
+    one connected journey under one trace id, with the reroute flow
+    link carrying ``rerouted_from``.
+    """
+    import threading
+
+    import deepspeed_tpu as ds  # noqa: F401 — keeps import side effects
+    from ..serving import FleetRouter, ServingEngine
+    from ..telemetry.journey import validate_journeys
+    from ..telemetry.slo import SLOEngine, default_slos
+
+    out: dict = {}
+    engines = [ServingEngine(engine=inf, **eng_kw) for _ in range(2)]
+    for eng in engines:                     # charge compiles up front
+        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+    router = FleetRouter(engines)
+    crashy, survivor = router.replicas[0], router.replicas[1]
+
+    slo_engine = None
+    if slo:
+        # latency thresholds are parked at 30s (CPU bench timing is
+        # noise); AVAILABILITY is the signal the injected crash moves
+        slo_engine = SLOEngine(
+            default_slos(ttft_threshold_s=30.0, tpot_threshold_s=30.0),
+            windows_s=slo_windows_s)
+        for rep in router.replicas:
+            slo_engine.attach(rep.frontend.tracing)
+
+    def serve_batch():
+        handles = [router.submit(p, max_new_tokens=max_new_tokens)
+                   for p in prompts]
+        for h in handles:
+            if h.result(timeout=120) != "done":
+                raise RuntimeError(
+                    f"healthy fleet batch failed: uid={h.uid} "
+                    f"status={h.status}")
+        return handles
+
+    try:
+        # phase A: healthy traffic (survivor only — deterministic lane)
+        crashy.dead = True
+        serve_batch()
+        burn_pre = (slo_engine.evaluate(export_gauges=False)
+                    ["max_burn_rate"] if slo_engine else 0.0)
+
+        # phase B: wedge one request mid-chunk on the crashy replica,
+        # queue the rest behind it, then let the chunk raise
+        crashy.dead = False
+        survivor.dead = True
+        entered, release = threading.Event(), threading.Event()
+
+        def boom(*a, **k):
+            entered.set()
+            release.wait(30)
+            raise RuntimeError("injected decode fault")
+
+        engines[0]._jit_decode_chunk = boom
+        first = router.submit(prompts[0], max_new_tokens=max_new_tokens)
+        if not entered.wait(30):
+            raise RuntimeError("injected fault never reached the chunk")
+        rest = [router.submit(p, max_new_tokens=max_new_tokens)
+                for p in prompts[1:]]
+        survivor.dead = False       # revive BEFORE the crash fires
+        release.set()
+        first_status = first.result(timeout=60)
+        rest_status = [h.result(timeout=120) for h in rest]
+        if first_status != "error":
+            raise RuntimeError(
+                f"mid-chunk request should resolve error, "
+                f"got {first_status}")
+        if any(s != "done" for s in rest_status):
+            raise RuntimeError(
+                f"queued requests should re-route to the survivor and "
+                f"finish: {rest_status}")
+        rerouted_parity = all(
+            np.array_equal(h.output_ids, oracle_out[1 + i])
+            for i, h in enumerate(rest))
+        if not rerouted_parity:
+            raise RuntimeError(
+                "rerouted greedy streams diverged from ServingEngine.run")
+        burn_crash = (slo_engine.evaluate(export_gauges=False)
+                      ["max_burn_rate"] if slo_engine else 0.0)
+
+        # postmortem: the in-flight set must be EXACTLY the handles the
+        # caller saw resolve error (running) or re-route (queued)
+        pm_path = crashy.frontend.postmortem_path
+        if not pm_path:
+            raise RuntimeError("crashed frontend dumped no postmortem")
+        with open(pm_path) as f:
+            pm = json.load(f)
+        pm_uids = {e["uid"] for e in pm["in_flight"]}
+        expect = {first.uid} | {h.uid for h in rest}
+        pm_match = pm_uids == expect
+        if not pm_match:
+            raise RuntimeError(
+                f"postmortem in-flight set {sorted(pm_uids)} != "
+                f"error/rerouted handles {sorted(expect)}")
+
+        # phase C: drain the fast window, then healthy traffic again
+        if slo_engine:
+            time.sleep(slo_windows_s[0] + 0.5)
+            serve_batch()
+            burn_recovered = slo_engine.fast_burn_rate()
+        else:
+            burn_recovered = 0.0
+
+        stats = router.stats()
+        trace_obj = router.export_chrome(trace_out or None)
+        problems = validate_journeys(trace_obj)
+        if problems:
+            raise RuntimeError(
+                "journey validation failed: " + "; ".join(problems[:5]))
+        n_traces = sum(
+            1 for e in trace_obj["traceEvents"]
+            if e.get("name") == "route")
+    finally:
+        router.close(timeout=60)
+
+    out["crash"] = {
+        "errors": sum(1 for s in [first_status] if s == "error"),
+        "rerouted": stats["rerouted"],
+        "journey_complete": 1.0,
+        "rerouted_parity": float(rerouted_parity),
+        "postmortem_inflight_match": float(pm_match),
+        "postmortem_events": len(pm["events"]),
+        "postmortem": pm_path,
+    }
+    out["journey"] = {
+        "n_traces": n_traces,
+        "complete": 1.0,
+        "rerouted_links": stats["rerouted"],
+        "trace_file": trace_out or "",
+    }
+    if slo_engine:
+        rep = slo_engine.evaluate(export_gauges=False)
+        avail = next(s for s in rep["slos"]
+                     if s["kind"] == "availability")
+        out["slo"] = {
+            "burn_pre": burn_pre,
+            "burn_crash": burn_crash,
+            "burn_recovered": burn_recovered,
+            "burn_moved": float(burn_crash > burn_pre),
+            "burn_recovered_flag": float(
+                burn_recovered < min(1.0, burn_crash)),
+            "windows_s": list(slo_windows_s),
+            "availability_worst_window_s": avail["worst_window_s"],
+            "budget_remaining": min(
+                w["budget_remaining"]
+                for s in rep["slos"] for w in s["windows"].values()),
+        }
+        if burn_crash <= burn_pre:
+            raise RuntimeError(
+                f"availability burn rate did not move during the crash "
+                f"window: pre={burn_pre} crash={burn_crash}")
+        if burn_recovered > 0.0:
+            raise RuntimeError(
+                f"fast burn rate did not recover after the crash "
+                f"window drained: {burn_recovered}")
+    return out
 
 
 def _ensure_virtual_devices(n: int = 8) -> None:
@@ -354,6 +552,13 @@ def main(argv=None):
                     help="simulated device time per decode chunk")
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
+    ap.add_argument("--slo", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="evaluate SLO burn rates across the crash case "
+                         "(--no-slo skips the slo block)")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="write the merged fleet journey Perfetto trace "
+                         "(validated either way)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
     _ensure_virtual_devices(8)
@@ -364,7 +569,8 @@ def main(argv=None):
                        decode_chunk=args.decode_chunk,
                        seed=args.seed,
                        sim_requests=args.sim_requests,
-                       sim_chunk_time_s=args.sim_chunk_time_ms / 1e3)
+                       sim_chunk_time_s=args.sim_chunk_time_ms / 1e3,
+                       slo=args.slo, trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -53,7 +53,7 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
               high_fraction: float = 0.25, ttft_bound_s: float = 10.0,
               seed: int = 0, model=None, params=None,
               timeout_s: float = 300.0, trace_out: str = None,
-              metrics_port: int = 0) -> dict:
+              metrics_port: int = 0, slo: bool = True) -> dict:
     import urllib.request
 
     import jax.numpy as jnp
@@ -61,6 +61,7 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     from .. import telemetry
     from ..telemetry.exposition import MetricsServer, parse_prometheus_text
     from ..telemetry.mfu import mfu_report
+    from ..telemetry.slo import SLOEngine, default_slos
     from ..telemetry.summary import phase_breakdown
     from ..serving import ServingEngine
     from ..serving.frontend import (AdmissionConfig, BackendWatchdog,
@@ -120,12 +121,19 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     # same backend the engine uses.
     watchdog = BackendWatchdog(interval_s=2.0, timeout_s=60.0)
     watchdog.start()
+    # SLO burn-rate engine fed by every terminal trace; served live at
+    # /slo and exported as slo/* gauges on the next /metrics render
+    slo_engine = None
+    if slo:
+        slo_engine = SLOEngine(
+            default_slos(ttft_threshold_s=ttft_bound_s),
+            windows_s=(10.0, 60.0)).attach(frontend.tracing)
     health = HealthMonitor(frontend=frontend, watchdog=watchdog)
     metrics_server = MetricsServer(
         runtime=telemetry.get_runtime(), tracelog=frontend.tracing,
         gauges_fn=lambda: fe_engine.metrics.snapshot(
             fe_engine.scheduler.queue_depth, fe_engine.kv.occupancy),
-        health=health, port=metrics_port)
+        health=health, slo=slo_engine, port=metrics_port)
     handles = [frontend.submit(p, max_new_tokens=max_new_tokens)
                for p in prompts]
     for h, ref in zip(handles, ref_results):
@@ -197,6 +205,42 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         readyz_code = resp.status
     if readyz_code != 200:
         raise RuntimeError(f"/readyz answered {readyz_code} while serving")
+    # live /slo fetch: the endpoint evaluates the rolling windows on GET
+    # and exports slo/* gauges — verified by a second /metrics scrape
+    slo_block = None
+    if slo_engine is not None:
+        with urllib.request.urlopen(f"{metrics_server.url}/slo",
+                                    timeout=10) as resp:
+            slo_payload = json.loads(resp.read().decode("utf-8"))
+        for key in ("schema", "slos", "max_burn_rate", "windows_s",
+                    "n_samples"):
+            if key not in slo_payload:
+                raise RuntimeError(f"/slo payload is missing '{key}'")
+        if not slo_payload["slos"]:
+            raise RuntimeError("/slo reported no SLOs")
+        with urllib.request.urlopen(f"{metrics_server.url}/metrics",
+                                    timeout=10) as resp:
+            rescrape = parse_prometheus_text(
+                resp.read().decode("utf-8"))
+        if not any(fam.startswith("dstpu_slo_")
+                   for fam in rescrape["samples"]):
+            raise RuntimeError(
+                "/metrics carries no slo/* gauges after a /slo "
+                "evaluation — the burn-rate export regressed")
+        worst = max(slo_payload["slos"],
+                    key=lambda s: s["worst_burn_rate"])
+        slo_block = {
+            "endpoint_ok": 1.0,
+            "n_slos": len(slo_payload["slos"]),
+            "n_samples": slo_payload["n_samples"],
+            "worst_burn_rate": round(worst["worst_burn_rate"], 4),
+            "worst_slo": worst["name"],
+            "worst_window_s": worst["worst_window_s"],
+            "budget_remaining_min": round(min(
+                w["budget_remaining"] for s in slo_payload["slos"]
+                for w in s["windows"].values()), 4),
+            "windows_s": slo_payload["windows_s"],
+        }
     metrics_scrape = {
         "url": metrics_server.url,
         "n_families": len(parsed["samples"]),
@@ -295,6 +339,7 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         "mfu": _round_tree(mfu) if mfu else None,
         "hbm": _round_tree(hbm) if hbm else None,
         "metrics_scrape": metrics_scrape,
+        "slo": slo_block,
         "trace_file": trace_out,
     }
 
@@ -309,6 +354,10 @@ def main(argv=None):
     ap.add_argument("--decode-chunk", type=int, default=4)
     ap.add_argument("--high-fraction", type=float, default=0.25)
     ap.add_argument("--ttft-bound-s", type=float, default=10.0)
+    ap.add_argument("--slo", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="wire an SLO burn-rate engine to the frontend "
+                    "tracelog and self-fetch /slo live (--no-slo skips)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="bind /metrics + health endpoints to this port "
                     "for the duration of the bench (0 = ephemeral; the "
@@ -330,7 +379,7 @@ def main(argv=None):
                        high_fraction=args.high_fraction,
                        ttft_bound_s=args.ttft_bound_s,
                        seed=args.seed, trace_out=args.trace_out,
-                       metrics_port=args.metrics_port)
+                       metrics_port=args.metrics_port, slo=args.slo)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -330,6 +330,10 @@ class ServingEngine:
         # the at-most-one in-flight chunk of the double-buffered loop
         # (run()'s pipelined drain and external pump() drivers share it)
         self._pending: Optional[_InflightChunk] = None
+        # crash flight recorder (telemetry.flight_recorder), attached by
+        # the owning ServingFrontend; engine-side records are host-only
+        # deque appends — no device work, no retrace surface
+        self.flight = None
 
         mat = engine._materialize
         module = self.module
@@ -843,19 +847,31 @@ class ServingEngine:
                     nbytes = sum(
                         int(getattr(leaf, "nbytes", 0))
                         for leaf in jax.tree.leaves(cache))
-                    with telemetry.span("serve/disagg_handoff", n=n,
-                                        bucket=bucket):
+                    # the handoff span carries the requests' journey ids
+                    # so the transfer shows up under each trace in the
+                    # merged fleet export
+                    with telemetry.span(
+                            "serve/disagg_handoff", n=n, bucket=bucket,
+                            uids=str([r.uid for r in reqs]),
+                            trace_ids=str([r.trace_id for r in reqs])):
                         cache = jax.device_put(cache,
                                                self._handoff_sharding)
                     telemetry.count("serve/disagg_handoff_bytes",
                                     float(nbytes))
                     telemetry.count("serve/disagg_handoffs", float(n))
+                    if self.flight is not None:
+                        self.flight.record(
+                            "disagg_handoff", n=n, bytes=int(nbytes),
+                            uids=[r.uid for r in reqs])
                 self.kv.insert_batch(cache, [r.slot for r in reqs], lens)
                 toks_host = np.asarray(toks)
             telemetry.count("serve/prefill_tokens", float(lens.sum()))
             self.metrics.on_prefill(n, bucket, int(lens.sum()),
                                     len(self._prefill_shapes))
             self.metrics.on_tokens(n)
+            if self.flight is not None:
+                self.flight.record("prefill", n=n, bucket=bucket,
+                                   uids=[r.uid for r in reqs])
             for i, r in enumerate(reqs):
                 first = int(toks_host[i])
                 self._last_token[r.slot] = first
@@ -984,11 +1000,17 @@ class ServingEngine:
         if self._deact_slots:
             telemetry.instant("serve/deact_patch",
                               n=len(self._deact_slots))
+            if self.flight is not None:
+                self.flight.record("deact_patch",
+                                   slots=sorted(self._deact_slots))
             idx = np.array(sorted(self._deact_slots), np.int32)
             act = act.at[idx].set(False)
         if self._admit_patches:
             telemetry.instant("serve/admit_patch",
                               n=len(self._admit_patches))
+            if self.flight is not None:
+                self.flight.record("admit_patch",
+                                   slots=sorted(self._admit_patches))
             slots = np.array(sorted(self._admit_patches), np.int32)
             vals = [self._admit_patches[int(s)] for s in slots]
             tok = tok.at[slots].set(
@@ -1035,9 +1057,13 @@ class ServingEngine:
                         self._next_rng())
                 carry = (tok_f, pos_f, act_f, rem_f, eos)
             self.kv.update(new_cache)
-        return _InflightChunk(
+        inflight = _InflightChunk(
             slot_uids={s: r.uid for s, r in self.scheduler.running.items()},
             tokens=toks, valid=valid, state=carry)
+        if self.flight is not None:
+            self.flight.record("chunk_launch", k=self.decode_chunk,
+                               slot_uids=dict(inflight.slot_uids))
+        return inflight
 
     def _consume_chunk(self, chunk: _InflightChunk) -> List[Request]:
         """Block on the chunk's token buffer (the ONE host sync per K
@@ -1058,6 +1084,11 @@ class ServingEngine:
                     self._last_token[slot] = seq[-1]
             finished = self.scheduler.step_tokens_chunk(per_slot)
         n_tokens = sum(len(v) for v in per_slot.values())
+        if self.flight is not None:
+            self.flight.record("chunk_retire", n_tokens=n_tokens,
+                               finished=[r.uid for r in finished],
+                               queue_depth=self.scheduler.queue_depth,
+                               occupancy=float(self.kv.occupancy))
         telemetry.count("serve/decode_tokens", float(n_tokens))
         if self.speculative:
             # acceptance accounting from the validity mask itself: a

@@ -53,11 +53,13 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence, Union
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from ...telemetry import core as telemetry
+from ...telemetry.journey import journey_trace_events, new_trace_id
 from ...utils.logging import logger
 from ..frontend.admission import AdmissionConfig, PRIORITY_NORMAL
 from ..frontend.frontend import ServingFrontend, StreamHandle
@@ -105,6 +107,13 @@ class FleetRouter:
         self.n_rerouted = 0
         self.n_reroute_failed = 0
         self.n_replica_crashes = 0
+        # journey journal: placement / reroute / crash records under one
+        # trace id per request — the input to ``export_chrome``'s
+        # journey lanes and the roadmap's future replay loop (bounded:
+        # a long-running router never grows without bound)
+        self._placements: deque = deque(maxlen=4096)
+        self._reroutes: deque = deque(maxlen=1024)
+        self._crashes: deque = deque(maxlen=256)
         self.replicas: List[FleetReplica] = []
         self._by_frontend: Dict[int, FleetReplica] = {}
         for rid, eng in enumerate(engines):
@@ -131,15 +140,35 @@ class FleetRouter:
         """Place one request and enqueue it; returns the chosen
         replica's StreamHandle immediately. With every replica dead the
         handle resolves ``rejected`` (``frontend_closed``) — same
-        no-exception contract as ``ServingFrontend.submit``."""
-        replica = self._place(prompt)
+        no-exception contract as ``ServingFrontend.submit``.
+
+        Every submit mints a ``trace_id`` that rides the handle, the
+        admission ticket, the engine request, and the chosen replica's
+        trace segment; the placement decision (candidate scores,
+        affinity hit, chosen replica) is journaled under that id."""
+        trace_id = new_trace_id()
+        t0 = self._clock()
+        replica, decision = self._place_decision(prompt)
+        t1 = self._clock()
         telemetry.count("fleet/routed")
         with self._lock:
             self.n_routed += 1
-        return replica.frontend.submit(
+        handle = replica.frontend.submit(
             prompt, priority=priority, tenant=tenant,
             slo_ttft_s=slo_ttft_s, deadline_s=deadline_s,
-            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id)
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            trace_id=trace_id)
+        telemetry.instant("fleet/placement", trace_id=trace_id,
+                          replica=replica.rid,
+                          affinity_hit=decision["affinity_hit"])
+        with self._lock:
+            self._placements.append({
+                "trace_id": trace_id, "uid": handle.uid, "t": t0,
+                "dur_s": t1 - t0, "replica": replica.rid,
+                "affinity_hit": decision["affinity_hit"],
+                "scores": decision["scores"],
+                "candidates": decision["candidates"]})
+        return handle
 
     def close(self, timeout: Optional[float] = None) -> None:
         for rep in self.replicas:
@@ -153,12 +182,21 @@ class FleetRouter:
 
     # --------------------------------------------------------- placement
     def _place(self, prompt) -> FleetReplica:
+        return self._place_decision(prompt)[0]
+
+    def _place_decision(self, prompt) -> Tuple[FleetReplica,
+                                               Dict[str, Any]]:
+        """Choose a replica AND return the decision record (candidate
+        rids, per-candidate load scores, affinity hit) that the journey
+        journal attaches to the request's ``route`` span."""
+        decision: Dict[str, Any] = {"affinity_hit": False, "scores": {},
+                                    "candidates": []}
         candidates = [r for r in self.replicas if r.alive]
         if not candidates:
             # every replica is dead: any frontend will reject-with-reason
             # (frontend_closed) — deliberate, so callers get a terminal
             # handle instead of an exception
-            return self.replicas[0]
+            return self.replicas[0], decision
         if self.affinity and len(candidates) > 1:
             key = PrefixCache.key_for(prompt)
             hits = [r for r in candidates if self._holds_prefix(r, key)]
@@ -167,9 +205,13 @@ class FleetRouter:
                 with self._lock:
                     self.n_affinity_hits += 1
                 candidates = hits
+                decision["affinity_hit"] = True
+        decision["candidates"] = [r.rid for r in candidates]
         if len(candidates) == 1:
-            return candidates[0]
-        return min(candidates, key=self._load_score)
+            return candidates[0], decision
+        scores = {r.rid: self._load_score(r) for r in candidates}
+        decision["scores"] = scores
+        return min(candidates, key=lambda r: scores[r.rid]), decision
 
     @staticmethod
     def _holds_prefix(replica: FleetReplica, key: bytes) -> bool:
@@ -197,13 +239,17 @@ class FleetRouter:
                           salvaged: List[StreamHandle],
                           exc: BaseException) -> None:
         """``ServingFrontend`` crash hook (runs on the dead driver
-        thread): mark the replica dead, then re-home every salvaged —
-        never-prefilled, still-unresolved — handle on a survivor."""
+        thread): mark the replica dead, record the crash (with the
+        flight recorder's postmortem path), then re-home every salvaged
+        — never-prefilled, still-unresolved — handle on a survivor."""
         with self._lock:
             rep = self._by_frontend.get(id(frontend))
             if rep is not None and not rep.dead:
                 rep.dead = True
                 self.n_replica_crashes += 1
+        # the crashed frontend dumped its postmortem BEFORE invoking
+        # this hook — attach its path to the crash + reroute records
+        postmortem = getattr(frontend, "postmortem_path", None)
         # the dead driver thread carries its replica label; fleet-level
         # reroute counters must not inherit it
         with telemetry.replica_label(None):
@@ -213,15 +259,34 @@ class FleetRouter:
                 f"fleet replica {rid} crashed "
                 f"({type(exc).__name__}: {exc}); re-routing "
                 f"{len(salvaged)} queued requests")
+            with self._lock:
+                self._crashes.append({
+                    "replica": rid, "t": self._clock(),
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "postmortem": postmortem,
+                    "n_salvaged": len(salvaged)})
             for handle in salvaged:
-                self._reroute(handle, exc)
+                self._reroute(handle, exc, src_rid=rid,
+                              postmortem=postmortem)
 
-    def _reroute(self, handle: StreamHandle, exc: BaseException) -> None:
+    def _reroute(self, handle: StreamHandle, exc: BaseException,
+                 src_rid: Any = None,
+                 postmortem: Optional[str] = None) -> None:
         target = self._place(handle._request.prompt)
-        if target.alive and target.frontend.adopt(handle):
+        if target.alive and target.frontend.adopt(
+                handle,
+                rerouted_from=str(src_rid) if src_rid is not None
+                else None):
             telemetry.count("fleet/rerouted")
+            telemetry.instant("fleet/reroute", trace_id=handle.trace_id,
+                              rerouted_from=src_rid,
+                              rerouted_to=target.rid)
             with self._lock:
                 self.n_rerouted += 1
+                self._reroutes.append({
+                    "trace_id": handle.trace_id, "uid": handle.uid,
+                    "t": self._clock(), "from_replica": src_rid,
+                    "to_replica": target.rid, "postmortem": postmortem})
             return
         with self._lock:
             self.n_reroute_failed += 1
@@ -248,8 +313,45 @@ class FleetRouter:
                 "rerouted": self.n_rerouted,
                 "reroute_failed": self.n_reroute_failed,
                 "replica_crashes": self.n_replica_crashes,
+                "crashes": [dict(c) for c in self._crashes],
             }
         out["per_replica"] = {
             r.rid: {"alive": r.alive, **r.frontend.stats()}
             for r in self.replicas}
         return out
+
+    # ----------------------------------------------------------- journeys
+    def journey_journal(self) -> Dict[str, Any]:
+        """The router's journey input for ``telemetry.journey``:
+        placement / reroute / crash records plus every replica's
+        ``TraceLog.to_json()``."""
+        with self._lock:
+            journal: Dict[str, Any] = {
+                "placements": [dict(p) for p in self._placements],
+                "reroutes": [dict(r) for r in self._reroutes],
+                "crashes": [dict(c) for c in self._crashes],
+            }
+        journal["replicas"] = {r.rid: r.frontend.tracing.to_json()
+                               for r in self.replicas}
+        return journal
+
+    def export_chrome(self, path: Optional[str] = None,
+                      runtime=None) -> Dict[str, Any]:
+        """One Perfetto file for the whole fleet: the shared telemetry
+        runtime (per-replica driver threads, pid 1), every replica's
+        per-request lanes (pid 2 — a rerouted uid's two segments share
+        one lane), and one journey lane per trace id (pid 3) with
+        placement + reroute flow arrows. Writes to ``path`` when given;
+        always returns the trace object."""
+        from ...telemetry import (chrome_trace, request_trace_events,
+                                  write_chrome_trace)
+        from ...telemetry import core as _tcore
+        rt = runtime if runtime is not None else _tcore.get_runtime()
+        journal = self.journey_journal()
+        extra: List[dict] = []
+        for rid in sorted(journal["replicas"]):
+            extra.extend(request_trace_events(journal["replicas"][rid]))
+        extra.extend(journey_trace_events(journal))
+        if path is None:
+            return chrome_trace(rt, extra_events=extra)
+        return write_chrome_trace(path, rt, extra_events=extra)

@@ -158,6 +158,7 @@ class Ticket:
     deadline_s: Optional[float] = None       # absolute clock time
     slo_ttft_s: Optional[float] = None       # target, tracked not enforced
     payload: Any = None
+    trace_id: Optional[str] = None           # distributed journey id
     seq: int = dataclasses.field(default_factory=lambda: next(_seq_counter))
     cancelled: bool = False                  # tombstone (lazy heap removal)
 

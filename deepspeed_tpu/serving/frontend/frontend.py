@@ -45,6 +45,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from ...telemetry import core as telemetry
+from ...telemetry.flight_recorder import FlightRecorder
+from ...telemetry.journey import new_trace_id
 from ...utils.logging import logger
 from ..scheduler import Request
 from .admission import (AdmissionConfig, AdmissionController,
@@ -64,13 +66,15 @@ class StreamHandle:
 
     def __init__(self, request: Request, frontend: "ServingFrontend", *,
                  tenant: str, priority: int,
-                 slo_ttft_s: Optional[float], submit_t: float):
+                 slo_ttft_s: Optional[float], submit_t: float,
+                 trace_id: Optional[str] = None):
         self._request = request
         self._frontend = frontend
         self.tenant = tenant
         self.priority = priority
         self.slo_ttft_s = slo_ttft_s
         self.submit_t = submit_t
+        self.trace_id = trace_id       # distributed journey id (immutable)
         self._cond = threading.Condition()
         self._tokens: List[int] = []
         self._cursor = 0               # poll()/iterator read position
@@ -208,6 +212,7 @@ class ServingFrontend:
                  trace_keep_last: int = 256,
                  on_crash=None,
                  telemetry_label: Optional[str] = None,
+                 flight_recorder: Optional[FlightRecorder] = None,
                  clock=time.monotonic):
         self._engine = engine
         self._clock = clock
@@ -226,6 +231,18 @@ class ServingFrontend:
         self._estimator = ChunkThroughputEstimator()
         self.tracing = TraceLog(monitor, keep_last=trace_keep_last,
                                 clock=clock)
+        # crash flight recorder: one bounded ring per replica; the
+        # engine shares it (chunk launches/retires, slot patches) so a
+        # postmortem covers both planes. Dump path of the most recent
+        # crash postmortem, for the fleet router's reroute records.
+        self.flight = flight_recorder if flight_recorder is not None \
+            else FlightRecorder(label=telemetry_label, clock=clock)
+        self.postmortem_path: Optional[str] = None
+        if getattr(engine, "flight", None) is None:
+            try:
+                engine.flight = self.flight
+            except (AttributeError, TypeError):
+                pass                   # exotic engine stubs: record less
         self._feed_depth = int(feed_depth or engine.max_batch)
         self._idle_wait_s = float(idle_wait_s)
         self._emit_every_s = float(emit_every_s)
@@ -252,7 +269,8 @@ class ServingFrontend:
                slo_ttft_s: Optional[float] = None,
                deadline_s: Optional[float] = None,
                max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> StreamHandle:
+               eos_token_id: Optional[int] = None,
+               trace_id: Optional[str] = None) -> StreamHandle:
         """Enqueue one generation request; returns immediately.
 
         ``deadline_s`` is a RELATIVE budget ("finish within this many
@@ -261,19 +279,26 @@ class ServingFrontend:
         scored in tracing (``slo_ttft_met``), not enforced — deadlines
         enforce. Rejections (rate limit, pending bound, dead/infeasible
         deadline, closed frontend) resolve the handle to ``rejected``
-        with a machine-readable ``reject_reason``; no exception."""
+        with a machine-readable ``reject_reason``; no exception.
+
+        ``trace_id`` is the distributed journey id; minted here when
+        the caller (a fleet router) didn't already mint one."""
         now = self._clock()
+        trace_id = trace_id or new_trace_id()
         req = Request(prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=max_new_tokens,
                       eos_token_id=eos_token_id,
                       deadline_s=(now + deadline_s)
-                      if deadline_s is not None else None)
+                      if deadline_s is not None else None,
+                      trace_id=trace_id)
         handle = StreamHandle(req, self, tenant=tenant, priority=priority,
-                              slo_ttft_s=slo_ttft_s, submit_t=now)
+                              slo_ttft_s=slo_ttft_s, submit_t=now,
+                              trace_id=trace_id)
         meta = dict(tenant=tenant, priority=priority,
                     prompt_len=req.prompt_len,
                     max_new_tokens=req.max_new_tokens,
-                    slo_ttft_s=slo_ttft_s, deadline_s=req.deadline_s)
+                    slo_ttft_s=slo_ttft_s, deadline_s=req.deadline_s,
+                    trace_id=trace_id, replica=self._telemetry_label)
         self.n_submitted += 1
         with self._wake:
             dead = self._closing or self._crashed
@@ -287,13 +312,18 @@ class ServingFrontend:
                         max_new_tokens=req.max_new_tokens,
                         priority=priority, tenant=tenant,
                         deadline_s=req.deadline_s, slo_ttft_s=slo_ttft_s,
-                        payload=handle)
+                        payload=handle, trace_id=trace_id)
         handle._ticket = ticket
         reason = self._controller.offer(ticket)
         if reason is not None:
+            self.flight.record("reject", uid=req.uid, reason=reason,
+                               trace_id=trace_id)
             self.tracing.record_rejected(req.uid, reason, **meta)
             handle._resolve("rejected", reject_reason=reason)
             return handle
+        self.flight.record("submit", uid=req.uid, trace_id=trace_id,
+                           tenant=tenant, priority=priority,
+                           prompt_len=req.prompt_len)
         self.tracing.start(req.uid, **meta)
         self.tracing.mark(req.uid, "submitted", t=now)
         with self._wake:
@@ -395,12 +425,16 @@ class ServingFrontend:
             "terminal": dict(self.tracing.counters),
         }
 
-    def adopt(self, handle: StreamHandle) -> bool:
+    def adopt(self, handle: StreamHandle,
+              rerouted_from: Optional[str] = None) -> bool:
         """Re-home a never-prefilled handle from a crashed peer onto this
         frontend (the fleet router's dead-replica drain path). The SAME
         StreamHandle keeps streaming to its caller; only the backend
-        changes. Returns False — after resolving the handle ``rejected``
-        — when this frontend cannot take it; thread-safe."""
+        changes — the handle keeps its ``trace_id``, and this replica's
+        trace segment records ``rerouted_from=<crashed replica>`` so the
+        journey stays one connected story. Returns False — after
+        resolving the handle ``rejected`` — when this frontend cannot
+        take it; thread-safe."""
         if handle.done:
             return False
         req = handle._request
@@ -415,7 +449,10 @@ class ServingFrontend:
         meta = dict(tenant=handle.tenant, priority=handle.priority,
                     prompt_len=req.prompt_len,
                     max_new_tokens=req.max_new_tokens,
-                    slo_ttft_s=handle.slo_ttft_s, deadline_s=req.deadline_s)
+                    slo_ttft_s=handle.slo_ttft_s, deadline_s=req.deadline_s,
+                    trace_id=handle.trace_id,
+                    replica=self._telemetry_label,
+                    rerouted_from=rerouted_from)
         self.n_submitted += 1
         with self._wake:
             dead = self._closing or self._crashed
@@ -429,13 +466,17 @@ class ServingFrontend:
                         max_new_tokens=req.max_new_tokens,
                         priority=handle.priority, tenant=handle.tenant,
                         deadline_s=req.deadline_s,
-                        slo_ttft_s=handle.slo_ttft_s, payload=handle)
+                        slo_ttft_s=handle.slo_ttft_s, payload=handle,
+                        trace_id=handle.trace_id)
         handle._ticket = ticket
         reason = self._controller.offer(ticket)
         if reason is not None:
             self.tracing.record_rejected(req.uid, reason, **meta)
             handle._resolve("rejected", reject_reason=reason)
             return False
+        self.flight.record("adopt", uid=req.uid,
+                           trace_id=handle.trace_id,
+                           rerouted_from=rerouted_from)
         self.tracing.start(req.uid, **meta)
         self.tracing.mark(req.uid, "submitted", t=now)
         with self._wake:
@@ -505,6 +546,8 @@ class ServingFrontend:
         admits, sheds = self._controller.pop(
             room=room, rate=self._estimator.rate(), backlog_tokens=backlog)
         for ticket, reason in sheds:
+            self.flight.record("shed", uid=ticket.payload.uid,
+                               reason=reason, trace_id=ticket.trace_id)
             self._resolve_rejected(ticket, reason)
         for ticket in admits:
             handle: StreamHandle = ticket.payload
@@ -514,6 +557,8 @@ class ServingFrontend:
                 self._resolve_rejected(ticket, req.reject_reason)
             else:
                 self._handles[req.uid] = handle
+                self.flight.record("admit", uid=req.uid,
+                                   trace_id=ticket.trace_id)
                 self.tracing.mark(req.uid, "admitted")
 
     def _resolve_rejected(self, ticket: Ticket, reason: str) -> None:
@@ -553,6 +598,8 @@ class ServingFrontend:
     def _do_cancel(self, handle: StreamHandle) -> None:
         if handle.done:
             return
+        self.flight.record("cancel", uid=handle.uid,
+                           trace_id=handle.trace_id)
         ticket = handle._ticket
         if ticket is not None and self._controller.remove(ticket):
             # never reached the engine: no slot, no device work
@@ -572,6 +619,13 @@ class ServingFrontend:
         now = self._clock()
         if now - self._last_emit_t >= self._emit_every_s:
             self._last_emit_t = now
+            sched = getattr(self._engine, "scheduler", None)
+            self.flight.record(
+                "snapshot",
+                pending_admission=self._controller.pending,
+                queue_depth=len(sched.queue) if sched is not None else 0,
+                running=len(sched.running) if sched is not None else 0,
+                handles=len(self._handles))
             self.tracing.emit()
 
     def _fail_all(self, exc: BaseException) -> None:
@@ -586,7 +640,12 @@ class ServingFrontend:
         unresolved, so a fleet router can re-home those handles on
         surviving replicas. Requests that prefilled or streamed tokens
         always resolve ``error`` here: their KV state died with the
-        replica."""
+        replica.
+
+        Before resolving ANYTHING the flight recorder dumps a
+        postmortem (``self.postmortem_path``) whose ``in_flight`` list
+        is exactly the handle set this crash is about to resolve
+        ``error`` or hand off for reroute."""
         msg = f"{type(exc).__name__}: {exc}"
         logger.error(f"serving frontend driver crashed: {msg}")
         with self._wake:
@@ -606,14 +665,52 @@ class ServingFrontend:
                 if handle is not None:
                     salvaged.append(handle)
             sched.queue.clear()
+        # ---- postmortem: capture the in-flight set pre-resolution ----
+        in_flight: List[Dict[str, Any]] = []
+        seen: set = set()
+        for disposition, group in (("salvageable", salvaged),
+                                   ("running", self._handles.values()),
+                                   ("cancel_pending", cancels)):
+            for handle in group:
+                if handle.uid in seen:
+                    continue
+                seen.add(handle.uid)
+                in_flight.append({
+                    "uid": handle.uid,
+                    "trace_id": handle.trace_id,
+                    "status": handle.status,
+                    "n_tokens": len(handle.tokens),
+                    "disposition": disposition})
+        slot_uids = {}
+        if sched is not None:
+            slot_uids = {req.slot: req.uid
+                         for req in list(sched.running.values())
+                         if req.slot is not None}
+        try:
+            self.postmortem_path = self.flight.dump(
+                reason="driver_crash", error=msg, in_flight=in_flight,
+                slot_uids=slot_uids,
+                extra={"n_salvageable": len(salvaged),
+                       "n_running": len(self._handles),
+                       "pending_admission": self._controller.pending})
+        except Exception as dump_exc:  # noqa: BLE001 — never block drain
+            logger.error(f"flight recorder dump failed: {dump_exc}")
+        handed: List[StreamHandle] = []
         if self._on_crash is not None and salvaged:
             try:
+                handed = list(salvaged)
                 self._on_crash(self, list(salvaged), exc)
                 salvaged = []
             except Exception as hook_exc:  # noqa: BLE001 — fall back
+                handed = []
                 logger.error(
                     f"crash re-route hook failed ({hook_exc}); resolving "
                     f"{len(salvaged)} salvaged handles as error")
+        # close this replica's trace segment for every handle the hook
+        # re-homed: terminal status ``rerouted`` links the journey's next
+        # segment (the survivor re-opens the same uid/trace_id)
+        for handle in handed:
+            self.tracing.finish(handle.uid, "rerouted", error=msg)
         for handle in salvaged:
             self.tracing.finish(handle.uid, "error", error=msg)
             handle._resolve("error", error=msg)

@@ -61,11 +61,19 @@ class BackendWatchdog:
     def __init__(self, *, interval_s: float = 5.0, timeout_s: float = 10.0,
                  heartbeat_fn: Optional[Callable[[], Any]] = None,
                  max_failures: int = 1,
+                 flight_recorder=None,
                  clock: Callable[[], float] = time.monotonic):
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
         self.heartbeat_fn = heartbeat_fn or default_heartbeat
         self.max_failures = max(1, int(max_failures))
+        # optional telemetry.flight_recorder.FlightRecorder: dumps a
+        # postmortem once per healthy->unhealthy flip (and records every
+        # heartbeat failure); its dumps then include watchdog history
+        self.flight_recorder = flight_recorder
+        if flight_recorder is not None \
+                and getattr(flight_recorder, "watchdog", None) is None:
+            flight_recorder.watchdog = self
         self.clock = clock
         self._lock = threading.Lock()
         self._ok = True                  # optimistic until a probe fails
@@ -120,6 +128,7 @@ class BackendWatchdog:
 
     def _record(self, ok: bool, took: Optional[float],
                 error: Optional[str]) -> None:
+        flipped_unhealthy = False
         with self._lock:
             self.n_beats += 1
             self.last_beat_s = took
@@ -133,7 +142,18 @@ class BackendWatchdog:
                 self._consecutive_failures += 1
                 self.last_error = error
                 if self._consecutive_failures >= self.max_failures:
+                    flipped_unhealthy = self._ok
                     self._ok = False
+        fr = self.flight_recorder
+        if fr is not None and not ok:
+            fr.record("watchdog_failure", error=error, took_s=took,
+                      consecutive=self._consecutive_failures)
+            if flipped_unhealthy:
+                # once per healthy->unhealthy transition, not per beat
+                try:
+                    fr.dump(reason="watchdog_max_failures", error=error)
+                except Exception:  # noqa: BLE001 — probes never raise
+                    pass
         telemetry.gauge("health/backend_ok", 1.0 if self.ok else 0.0)
         if took is not None:
             telemetry.gauge("health/heartbeat_s", float(took))
@@ -190,17 +210,28 @@ class HealthMonitor:
       but a fleet router should stop placing traffic here);
     * ``watchdog`` — ``backend_unresponsive`` when the heartbeat says
       the accelerator is gone;
+    * ``slo`` + ``slo_fast_burn_threshold`` — opt-in (both must be set):
+      ``slo_fast_burn`` when the :class:`~deepspeed_tpu.telemetry.slo
+      .SLOEngine`'s fastest-window burn rate exceeds the threshold.
+      Burning the error budget that fast means the replica is degraded
+      even if every liveness probe still answers;
     * ``checks`` — extra ``name -> callable() -> bool`` probes.
     """
 
     def __init__(self, *, frontend=None, watchdog: Optional[
                      BackendWatchdog] = None,
                  checks: Optional[Dict[str, Callable[[], bool]]] = None,
-                 queue_saturation: float = 0.95):
+                 queue_saturation: float = 0.95,
+                 slo=None,
+                 slo_fast_burn_threshold: Optional[float] = None):
         self.frontend = frontend
         self.watchdog = watchdog
         self.checks = dict(checks or {})
         self.queue_saturation = float(queue_saturation)
+        self.slo = slo
+        self.slo_fast_burn_threshold = (
+            None if slo_fast_burn_threshold is None
+            else float(slo_fast_burn_threshold))
 
     def check(self) -> Tuple[bool, List[str], Dict[str, Any]]:
         reasons: List[str] = []
@@ -226,6 +257,16 @@ class HealthMonitor:
             details["watchdog"] = st
             if not st["ok"]:
                 reasons.append("backend_unresponsive")
+        if self.slo is not None and self.slo_fast_burn_threshold is not None:
+            try:
+                fast = float(self.slo.fast_burn_rate())
+            except Exception as e:  # noqa: BLE001 — a probe never raises
+                fast = 0.0
+                details["slo_error"] = f"{type(e).__name__}: {e}"
+            details["slo_fast_burn_rate"] = fast
+            details["slo_fast_burn_threshold"] = self.slo_fast_burn_threshold
+            if fast > self.slo_fast_burn_threshold:
+                reasons.append("slo_fast_burn")
         for name, probe in self.checks.items():
             try:
                 ok = bool(probe())

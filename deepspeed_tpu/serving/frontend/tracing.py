@@ -50,13 +50,17 @@ class RequestTrace:
 
     __slots__ = ("uid", "tenant", "priority", "prompt_len",
                  "max_new_tokens", "slo_ttft_s", "deadline_s", "events",
-                 "chunks", "status", "reject_reason", "error", "n_tokens")
+                 "chunks", "status", "reject_reason", "error", "n_tokens",
+                 "trace_id", "replica", "rerouted_from")
 
     def __init__(self, uid: int, *, tenant: str = "default",
                  priority: int = 1, prompt_len: int = 0,
                  max_new_tokens: int = 0,
                  slo_ttft_s: Optional[float] = None,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None,
+                 replica: Optional[str] = None,
+                 rerouted_from: Optional[str] = None):
         self.uid = uid
         self.tenant = tenant
         self.priority = priority
@@ -64,6 +68,12 @@ class RequestTrace:
         self.max_new_tokens = max_new_tokens
         self.slo_ttft_s = slo_ttft_s
         self.deadline_s = deadline_s
+        # fleet journey identity: the distributed trace id this request
+        # rides under, which replica recorded this segment, and — for a
+        # segment re-homed after a crash — the replica it came from
+        self.trace_id = trace_id
+        self.replica = replica
+        self.rerouted_from = rerouted_from
         self.events: Dict[str, float] = {}
         self.chunks: List[List[float]] = []      # [t, n_tokens] pairs
         self.status: Optional[str] = None        # terminal status
@@ -103,6 +113,9 @@ class RequestTrace:
     def to_dict(self) -> Dict[str, Any]:
         return {
             "uid": self.uid,
+            "trace_id": self.trace_id,
+            "replica": self.replica,
+            "rerouted_from": self.rerouted_from,
             "tenant": self.tenant,
             "priority": self.priority,
             "prompt_len": self.prompt_len,
@@ -147,6 +160,16 @@ class TraceLog:
             for name in self._HISTOGRAMS}
         self.counters: Dict[str, int] = {}
         self._emit_seq = 0
+        # terminal-record fan-out (SLO engine): called OUTSIDE the lock
+        self._listeners: List[Callable[[RequestTrace], None]] = []
+
+    def add_listener(self,
+                     fn: Callable[["RequestTrace"], None]) -> None:
+        """Subscribe to every terminal record (``finish`` /
+        ``record_rejected``). Listeners run on the finishing thread
+        after the log's lock is released — they may read the trace but
+        must not call back into this log."""
+        self._listeners.append(fn)
 
     # ---------------------------------------------------------- recording
     def start(self, uid: int, **meta) -> RequestTrace:
@@ -183,7 +206,8 @@ class TraceLog:
                error: Optional[str] = None,
                t: Optional[float] = None) -> Optional[RequestTrace]:
         """Close a span with its terminal status; folds its latencies
-        into the histograms and bumps the terminal counters."""
+        into the histograms and bumps the terminal counters. Terminal
+        listeners (``add_listener``) fire after the lock is released."""
         with self._lock:
             trace = self._live.pop(uid, None)
             if trace is None:
@@ -205,7 +229,12 @@ class TraceLog:
                 if v is not None:
                     self.histograms[name].add(v)
             self._done.append(trace)
-            return trace
+        for fn in self._listeners:
+            try:
+                fn(trace)
+            except Exception:  # noqa: BLE001 — observers never break us
+                pass
+        return trace
 
     def record_rejected(self, uid: int, reason: str, **meta) -> None:
         """Shorthand for a request rejected before it ever opened a live

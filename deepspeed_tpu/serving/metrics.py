@@ -71,13 +71,15 @@ class Reservoir:
                 self.values[j] = float(x)
 
     def percentile(self, q: float) -> float:
-        """Linear-interpolated percentile over the sample, q in [0, 100];
-        0.0 when empty (matches the mean-TTFT zero default)."""
+        """Linear-interpolated percentile over the sample, q in [0, 100]
+        (out-of-range q is clamped, never an index error); 0.0 when
+        empty (matches the mean-TTFT zero default)."""
         if not self.values:
             return 0.0
         xs = sorted(self.values)
         if len(xs) == 1:
             return xs[0]
+        q = min(100.0, max(0.0, float(q)))
         pos = (q / 100.0) * (len(xs) - 1)
         lo = int(pos)
         hi = min(lo + 1, len(xs) - 1)

@@ -48,6 +48,9 @@ class Request:
     eos_token_id: Optional[int] = None
     deadline_s: Optional[float] = None     # absolute clock() time budget
     uid: int = dataclasses.field(default_factory=lambda: next(_uid_counter))
+    # distributed trace id (fleet journeys): minted at submit by the
+    # frontend/router, preserved across a crash-reroute
+    trace_id: Optional[str] = None
 
     # ---- filled in by the scheduler ----
     status: str = "new"   # new|queued|running|done|expired|rejected|cancelled

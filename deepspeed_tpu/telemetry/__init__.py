@@ -48,6 +48,12 @@ from .exposition import (MetricsServer, parse_prometheus_text,  # noqa: F401
                          render_prometheus)
 from .regression import (MetricSpec, detect_kind,  # noqa: F401
                          diff_benchmarks)
+from .journey import (PID_JOURNEYS, assemble_journeys,  # noqa: F401
+                      journey_trace_events, new_trace_id,
+                      summarize_journeys, validate_journeys)
+from .slo import SLOEngine, SLOSpec, default_slos  # noqa: F401
+from .flight_recorder import (FlightRecorder, dump_all,  # noqa: F401
+                              install_sigterm_handler)
 
 __all__ = [
     "TelemetryRuntime", "get_runtime", "configure", "enable", "disable",
@@ -59,4 +65,8 @@ __all__ = [
     "compiled_memory_analysis", "live_array_census", "format_bytes",
     "render_prometheus", "parse_prometheus_text", "MetricsServer",
     "MetricSpec", "diff_benchmarks", "detect_kind",
+    "PID_JOURNEYS", "new_trace_id", "assemble_journeys",
+    "journey_trace_events", "validate_journeys", "summarize_journeys",
+    "SLOSpec", "SLOEngine", "default_slos",
+    "FlightRecorder", "install_sigterm_handler", "dump_all",
 ]

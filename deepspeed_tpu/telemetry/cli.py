@@ -9,6 +9,12 @@ Subcommands::
     tputrace convert <tracelog.json> -o OUT   render a frontend
                                               ``TraceLog.dump`` file as
                                               a Perfetto-loadable trace
+    tputrace journey <trace.json> [TRACE_ID]  fleet journeys in a trace:
+                                              table of all, or one
+                                              journey's events in full;
+                                              --validate gates each
+                                              journey's connectedness
+                                              (exit 1 on problems)
 
 Stdlib-only on purpose: like ``bin/tracelint``, the launcher installs a
 synthetic parent package so this file imports in milliseconds without
@@ -23,6 +29,7 @@ import sys
 from typing import Any, Dict, List, Tuple
 
 from .export import chrome_trace, request_trace_events
+from .journey import PID_JOURNEYS, summarize_journeys, validate_journeys
 from .memory import format_bytes
 
 _NUMBER = (int, float)
@@ -196,6 +203,63 @@ def cmd_summary(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------- journey
+
+def cmd_journey(args) -> int:
+    try:
+        obj = _load(args.trace)
+    except (OSError, ValueError) as exc:
+        print(f"tputrace: cannot read {args.trace}: {exc}",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    if args.validate:
+        problems = validate_journeys(obj, pid=args.pid)
+        for p in problems[:50]:
+            print(f"FAIL: {p}", file=sys.stderr)
+        if len(problems) > 50:
+            print(f"... and {len(problems) - 50} more", file=sys.stderr)
+        if problems:
+            return 1
+    journeys = summarize_journeys(obj, pid=args.pid)
+    if args.trace_id:
+        wanted = [j for j in journeys
+                  if str(j["trace_id"]).startswith(args.trace_id)]
+        if not wanted:
+            print(f"tputrace: no journey matching '{args.trace_id}' in "
+                  f"{args.trace}", file=sys.stderr)
+            return 1
+        for j in wanted:
+            print(f"journey {j['trace_id']}  uid={j['uid']}  "
+                  f"status={j['status']}  reroutes={j['n_reroutes']}")
+            print(f"  replicas: {' -> '.join(j['replicas']) or '-'}")
+            print(f"  chunks: {j['n_chunks']}  tokens: {j['n_tokens']}")
+            evs = [e for e in obj.get("traceEvents", ())
+                   if (e.get("args") or {}).get("trace_id")
+                   == j["trace_id"] and e.get("pid") == args.pid]
+            for e in sorted(evs, key=lambda e: e.get("ts", 0.0)):
+                extra = " ".join(
+                    f"{k}={v}" for k, v in (e.get("args") or {}).items()
+                    if k != "trace_id" and v is not None)
+                print(f"  @{e.get('ts', 0.0) / 1e3:>10.2f} ms  "
+                      f"[{e.get('ph')}] {e.get('name')}  {extra}")
+        return rc
+    if not journeys:
+        print(f"{args.trace}: no journey events (pid {args.pid})")
+        return rc
+    print(f"{args.trace}: {len(journeys)} journeys")
+    print(f"  {'trace_id':<18} {'uid':>5} {'status':<9} {'chunks':>6} "
+          f"{'tokens':>6} {'rr':>3}  replicas")
+    for j in journeys:
+        print(f"  {j['trace_id']:<18} {str(j['uid']):>5} "
+              f"{str(j['status']):<9} {j['n_chunks']:>6} "
+              f"{j['n_tokens']:>6} {j['n_reroutes']:>3}  "
+              f"{' -> '.join(j['replicas']) or '-'}")
+    if args.validate:
+        print("journeys OK: every journey connected under one trace_id")
+    return rc
+
+
 # ---------------------------------------------------------------- convert
 
 def cmd_convert(args) -> int:
@@ -231,6 +295,15 @@ def main(argv=None) -> int:
     p.add_argument("tracelog")
     p.add_argument("-o", "--out", required=True)
     p.set_defaults(fn=cmd_convert)
+    p = sub.add_parser("journey",
+                       help="list/inspect/validate fleet journeys")
+    p.add_argument("trace")
+    p.add_argument("trace_id", nargs="?", default=None,
+                   help="show one journey (prefix match) in full")
+    p.add_argument("--validate", action="store_true",
+                   help="gate journey connectedness (exit 1 on problems)")
+    p.add_argument("--pid", type=int, default=PID_JOURNEYS)
+    p.set_defaults(fn=cmd_journey)
     args = ap.parse_args(argv)
     return args.fn(args)
 

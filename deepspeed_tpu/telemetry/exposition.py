@@ -1,4 +1,5 @@
-"""Prometheus text exposition + the /metrics · /healthz · /readyz server.
+"""Prometheus text exposition + the /metrics · /healthz · /readyz ·
+/slo server.
 
 Everything observable in-process — :class:`TelemetryRuntime`
 counters/gauges/span reservoirs, the serving frontend's ``TraceLog``
@@ -119,33 +120,54 @@ def render_prometheus(*, runtime=None, tracelog=None,
             typed.add(m)
             lines.append(f"# TYPE {m} {kind}")
 
-    if runtime is not None:
-        for name, total in sorted(runtime.counter_totals().items()):
-            base, labels = split_embedded_labels(name)
-            m = f"{ns}_{sanitize_metric_name(base)}_total"
-            _header(m, "counter")
-            lines.append(_line(m, float(total), labels))
-        for name, value in sorted(runtime.gauge_values().items()):
-            base, labels = split_embedded_labels(name)
-            m = f"{ns}_{sanitize_metric_name(base)}"
-            _header(m, "gauge")
+    def _label_key(labels) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def _emit_family(m: str, kind: str, entries) -> None:
+        # ALL of a family's samples render contiguously under its one
+        # TYPE header. Sorting the raw embedded-label names instead
+        # interleaves families: '_' (0x5f) sorts before '|' (0x7c), so
+        # e.g. serve/chunk_retire lands BETWEEN serve/chunk and
+        # serve/chunk|replica=1, splitting dstpu_serve_chunk's samples
+        # across the dstpu_serve_chunk_retire header.
+        _header(m, kind)
+        for labels, value in sorted(entries,
+                                    key=lambda e: _label_key(e[0])):
             lines.append(_line(m, float(value), labels))
-        for name, n in sorted(runtime.instant_counts().items()):
+
+    def _grouped(items, suffix: str):
+        groups: Dict[str, List] = {}
+        for name, value in items:
             base, labels = split_embedded_labels(name)
-            m = f"{ns}_{sanitize_metric_name(base)}_events_total"
-            _header(m, "counter")
-            lines.append(_line(m, float(n), labels))
-        for name, st in sorted(runtime.span_stats().items()):
+            m = f"{ns}_{sanitize_metric_name(base)}{suffix}"
+            groups.setdefault(m, []).append((labels, float(value)))
+        return groups
+
+    if runtime is not None:
+        for kind, suffix, items in (
+                ("counter", "_total", runtime.counter_totals().items()),
+                ("gauge", "", runtime.gauge_values().items()),
+                ("counter", "_events_total",
+                 runtime.instant_counts().items())):
+            groups = _grouped(items, suffix)
+            for m in sorted(groups):
+                _emit_family(m, kind, groups[m])
+        span_groups: Dict[str, List] = {}
+        for name, st in runtime.span_stats().items():
             base, labels = split_embedded_labels(name)
             m = f"{ns}_span_{sanitize_metric_name(base)}_seconds"
-            headers = m not in typed
-            typed.add(m)
-            _summary(lines, m,
-                     quantiles={q: st[f"p{round(q * 100)}_s"]
-                                for q in _QUANTILES},
-                     count=st["count"], total=st["total_s"],
-                     help_=f"telemetry span {base} duration",
-                     labels=labels, headers=headers)
+            span_groups.setdefault(m, []).append((base, labels, st))
+        for m in sorted(span_groups):
+            for base, labels, st in sorted(
+                    span_groups[m], key=lambda e: _label_key(e[1])):
+                headers = m not in typed
+                typed.add(m)
+                _summary(lines, m,
+                         quantiles={q: st[f"p{round(q * 100)}_s"]
+                                    for q in _QUANTILES},
+                         count=st["count"], total=st["total_s"],
+                         help_=f"telemetry span {base} duration",
+                         labels=labels, headers=headers)
     if tracelog is not None:
         for name, st in sorted(tracelog.histogram_stats().items()):
             base = name[:-2] if name.endswith("_s") else name
@@ -230,6 +252,14 @@ class _Handler(BaseHTTPRequestHandler):
                            json.dumps({"ready": ready, "reasons": reasons,
                                        "details": details}),
                            "application/json")
+            elif path == "/slo":
+                report = ms.slo_report()
+                if report is None:
+                    self._send(404, "no slo engine wired\n",
+                               "text/plain")
+                else:
+                    self._send(200, json.dumps(report),
+                               "application/json")
             else:
                 self._send(404, "not found\n", "text/plain")
         except BrokenPipeError:
@@ -249,17 +279,20 @@ class MetricsServer:
     ``GET /readyz`` consults ``health.check()`` (a
     :class:`~deepspeed_tpu.serving.frontend.health.HealthMonitor` or
     anything with that signature) and answers 503 with machine-readable
-    reasons when not ready. ``port=0`` binds an ephemeral port (read it
-    back from ``.port`` — the test/bench pattern)."""
+    reasons when not ready. ``GET /slo`` serves the wired
+    :class:`~deepspeed_tpu.telemetry.slo.SLOEngine` report as JSON
+    (404 when none is wired). ``port=0`` binds an ephemeral port (read
+    it back from ``.port`` — the test/bench pattern)."""
 
     def __init__(self, *, runtime=None, tracelog=None,
                  gauges_fn: Optional[Callable[[], Mapping[str, float]]] = None,
-                 health=None, host: str = "127.0.0.1", port: int = 0,
-                 namespace: str = "dstpu"):
+                 health=None, slo=None, host: str = "127.0.0.1",
+                 port: int = 0, namespace: str = "dstpu"):
         self.runtime = runtime
         self.tracelog = tracelog
         self.gauges_fn = gauges_fn
         self.health = health
+        self.slo = slo
         self.namespace = namespace
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
@@ -285,6 +318,14 @@ class MetricsServer:
         if self.health is None:
             return True, [], {}
         return self.health.check()
+
+    def slo_report(self):
+        """The ``/slo`` payload (evaluates the engine's rolling windows
+        and exports the ``slo/*`` gauges as a side effect); None when no
+        SLO engine is wired."""
+        if self.slo is None:
+            return None
+        return self.slo.report()
 
     def stop(self) -> None:
         self._httpd.shutdown()

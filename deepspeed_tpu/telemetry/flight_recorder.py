@@ -1,0 +1,192 @@
+"""Crash flight recorder: a bounded per-replica ring of recent serving
+events that dumps a structured postmortem JSON when the replica dies.
+
+Aggregate counters tell you a replica crashed; they don't tell you what
+the last two seconds looked like. Each :class:`FlightRecorder` keeps
+the last ``capacity`` events — chunk launches/retires, admission
+decisions, slot patches, queue/occupancy snapshots — recorded from any
+thread at deque-append cost, and turns them into a postmortem document
+on three triggers:
+
+* **driver crash** — ``ServingFrontend._fail_all`` dumps before it
+  resolves a single handle, so the ``in_flight`` list is exactly the
+  set of handles the crash will resolve ``error``/reroute;
+* **watchdog max-failures** — ``BackendWatchdog`` dumps once when its
+  consecutive-failure budget flips it unhealthy;
+* **SIGTERM** — :func:`install_sigterm_handler` dumps every live
+  recorder in the process, then chains the previous handler.
+
+Postmortem schema (``dstpu-postmortem-v1``)::
+
+    {"schema": "dstpu-postmortem-v1",
+     "reason": "driver_crash" | "watchdog_max_failures" | "sigterm"
+               | <caller-supplied>,
+     "replica": <label or null>, "t": <monotonic s>, "wall_time_s": ...,
+     "error": <message or null>,
+     "events": [{"t": ..., "kind": ..., **fields}, ...],  # oldest first
+     "in_flight": [{"uid", "trace_id", "status", "n_tokens",
+                    "disposition"}, ...],
+     "slot_uids": {"<slot>": uid, ...},
+     "watchdog": <BackendWatchdog.state() or null>,
+     "extra": {...}}
+
+``FleetRouter`` attaches the dump path to its crash/reroute records —
+the input format for the roadmap's future in-flight replay loop.
+
+Stdlib-only; safe to import without JAX.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import signal
+import tempfile
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+SCHEMA = "dstpu-postmortem-v1"
+
+#: every live recorder, for the SIGTERM sweep (weak: recorders die with
+#: their frontends, the registry must not keep them alive)
+_REGISTRY: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_REGISTRY_LOCK = threading.Lock()
+_dump_seq = itertools.count()
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring of recent events + postmortem dumper.
+
+    ``label`` is the replica label (matches ``telemetry.replica_label``)
+    and lands in the postmortem and the dump filename. ``watchdog`` may
+    be set (or passed to ``BackendWatchdog(flight_recorder=...)``) so
+    dumps include the heartbeat history."""
+
+    def __init__(self, *, capacity: int = 512,
+                 label: Optional[str] = None,
+                 out_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = int(capacity)
+        self.label = label
+        self.out_dir = out_dir
+        self.clock = clock
+        self.watchdog: Any = None
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.n_recorded = 0
+        self.n_dumps = 0
+        self.last_postmortem_path: Optional[str] = None
+        with _REGISTRY_LOCK:
+            _REGISTRY.add(self)
+
+    # ---------------------------------------------------------- recording
+    def record(self, kind: str, **fields: Any) -> None:
+        """Append one event (cheap; safe from any thread)."""
+        ev = {"t": self.clock(), "kind": str(kind)}
+        ev.update(fields)
+        with self._lock:
+            self._events.append(ev)
+            self.n_recorded += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Copy of the ring, oldest first."""
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    # ------------------------------------------------------------ dumping
+    def postmortem(self, *, reason: str,
+                   error: Optional[str] = None,
+                   in_flight: Optional[Iterable[Dict[str, Any]]] = None,
+                   slot_uids: Optional[Dict[Any, Any]] = None,
+                   extra: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+        """Build the postmortem document without writing it."""
+        wd = None
+        if self.watchdog is not None:
+            try:
+                wd = self.watchdog.state()
+            except Exception:  # noqa: BLE001 — postmortems never raise
+                wd = {"error": "watchdog state unavailable"}
+        return {
+            "schema": SCHEMA,
+            "reason": str(reason),
+            "replica": self.label,
+            "t": self.clock(),
+            "wall_time_s": time.time(),
+            "error": error,
+            "n_events_recorded": self.n_recorded,
+            "events": self.snapshot(),
+            "in_flight": [dict(h) for h in (in_flight or ())],
+            "slot_uids": {str(k): v
+                          for k, v in (slot_uids or {}).items()},
+            "watchdog": wd,
+            "extra": dict(extra or {}),
+        }
+
+    def dump(self, *, reason: str, path: Optional[str] = None,
+             error: Optional[str] = None,
+             in_flight: Optional[Iterable[Dict[str, Any]]] = None,
+             slot_uids: Optional[Dict[Any, Any]] = None,
+             extra: Optional[Dict[str, Any]] = None) -> str:
+        """Write the postmortem JSON; returns its path. Atomic-ish
+        (tempfile + rename) so a watcher never reads a half dump."""
+        doc = self.postmortem(reason=reason, error=error,
+                              in_flight=in_flight, slot_uids=slot_uids,
+                              extra=extra)
+        if path is None:
+            label = re.sub(r"[^A-Za-z0-9_.-]", "_",
+                           str(self.label if self.label is not None
+                               else "replica"))
+            path = os.path.join(
+                self.out_dir or tempfile.gettempdir(),
+                f"postmortem_{label}_{os.getpid()}"
+                f"_{next(_dump_seq)}.json")
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, default=str)
+        os.replace(tmp, path)
+        with self._lock:
+            self.n_dumps += 1
+            self.last_postmortem_path = path
+        return path
+
+
+# ----------------------------------------------------------------- SIGTERM
+def dump_all(reason: str = "sigterm") -> List[str]:
+    """Dump a postmortem from every live recorder; never raises."""
+    with _REGISTRY_LOCK:
+        recorders = list(_REGISTRY)
+    paths: List[str] = []
+    for rec in recorders:
+        try:
+            paths.append(rec.dump(reason=reason))
+        except Exception:  # noqa: BLE001 — a dying process keeps dying
+            pass
+    return paths
+
+
+def install_sigterm_handler() -> Optional[Callable]:
+    """Install a SIGTERM handler that dumps every live recorder, then
+    chains to the previously-installed handler (or re-raises the
+    default). Returns the handler (tests invoke it directly), or None
+    when not on the main thread — signal.signal would raise there."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum, frame):
+        dump_all(reason="sigterm")
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+        # SIG_IGN: swallow, matching the prior disposition
+
+    signal.signal(signal.SIGTERM, _handler)
+    return _handler

@@ -1,0 +1,282 @@
+"""Fleet-wide distributed request journeys.
+
+PR 9 scattered a request's causal story across per-replica TraceLogs
+and the shared telemetry ring: router placement, replica admission,
+prefill, decode chunks, and a possible crash-reroute each live in a
+different record keyed by a different id. This module stitches them
+back together under one **trace id**:
+
+* :func:`new_trace_id` mints the id ``FleetRouter.submit`` /
+  ``ServingFrontend.submit`` stamp on the StreamHandle, Ticket, engine
+  ``Request``, and per-replica ``RequestTrace``;
+* :func:`assemble_journeys` joins a router journey journal (placement /
+  reroute records) with every replica's ``TraceLog.to_json()`` into one
+  journey per trace id — ordered cross-replica segments;
+* :func:`journey_trace_events` renders those journeys as one Perfetto
+  lane per request (pid :data:`PID_JOURNEYS`): a ``route`` span with
+  the placement decision (candidate scores, affinity hit, chosen
+  replica), one ``replica<rid>`` span per segment, chunk instants, and
+  ``s``/``f`` flow arrows tying the hops — a rerouted handle keeps its
+  trace id with a ``rerouted_from=<replica>`` link;
+* :func:`validate_journeys` is the CI gate behind
+  ``bin/tputrace journey --validate``: every journey must have a router
+  span, stay on a single lane, carry chunk events when it finished
+  ``done``, and carry the reroute link when any segment was rerouted.
+
+Journal shape (``FleetRouter.journey_journal()``)::
+
+    {"placements": [{trace_id, uid, t, dur_s, replica, affinity_hit,
+                     scores, candidates}],
+     "reroutes":   [{trace_id, uid, t, from_replica, to_replica,
+                     postmortem}],
+     "crashes":    [{replica, t, error, postmortem, n_salvaged}],
+     "replicas":   {rid: TraceLog.to_json()}}
+
+Stdlib-only — ``bin/tputrace`` imports this without JAX.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, Iterable, List, Optional
+
+_US = 1e6
+
+#: pid lane of the journey process in the merged Perfetto file
+#: (PID_RUNTIME = 1 engine/driver threads, PID_REQUESTS = 2 per-replica
+#: request lanes — see export.py)
+PID_JOURNEYS = 3
+
+
+def new_trace_id() -> str:
+    """Mint a fleet-unique trace id (16 hex chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+# --------------------------------------------------------------- assembly
+def _segment_time(rec: Dict[str, Any]) -> float:
+    ev = rec.get("events") or {}
+    t = ev.get("submitted")
+    if t is None:
+        t = min(ev.values()) if ev else 0.0
+    return float(t)
+
+
+def assemble_journeys(journal: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Join the router journal with every replica's trace records into
+    ``{trace_id: journey}``; each journey carries its placement record,
+    time-ordered cross-replica segments, and reroute links."""
+    journeys: Dict[str, Dict[str, Any]] = {}
+
+    def entry(tid: str) -> Dict[str, Any]:
+        if tid not in journeys:
+            journeys[tid] = {"trace_id": tid, "uid": None,
+                             "placement": None, "segments": [],
+                             "reroutes": [], "status": None}
+        return journeys[tid]
+
+    for p in journal.get("placements", ()):
+        j = entry(p["trace_id"])
+        j["placement"] = dict(p)
+        if p.get("uid") is not None:
+            j["uid"] = p["uid"]
+    for rid, trace_json in (journal.get("replicas") or {}).items():
+        for rec in list(trace_json.get("requests", ())) + \
+                list(trace_json.get("live", ())):
+            tid = rec.get("trace_id")
+            if not tid:
+                continue
+            j = entry(tid)
+            if j["uid"] is None:
+                j["uid"] = rec.get("uid")
+            j["segments"].append({"replica": rid, "record": rec})
+    for r in journal.get("reroutes", ()):
+        entry(r["trace_id"])["reroutes"].append(dict(r))
+    for j in journeys.values():
+        j["segments"].sort(key=lambda s: _segment_time(s["record"]))
+        if j["segments"]:
+            j["status"] = j["segments"][-1]["record"].get("status")
+    return journeys
+
+
+# -------------------------------------------------------------- rendering
+def journey_trace_events(journal: Dict[str, Any], *,
+                         pid: int = PID_JOURNEYS,
+                         clock_offset_s: float = 0.0) -> List[dict]:
+    """Render the journal as Perfetto events: one lane (``tid`` = uid)
+    per trace id, covering router placement through every replica the
+    request touched, with flow arrows across the hops."""
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "request journeys"},
+    }]
+
+    def us(t: float) -> float:
+        return (t + clock_offset_s) * _US
+
+    for tid_str, j in sorted(assemble_journeys(journal).items()):
+        uid = j["uid"] if j["uid"] is not None else 0
+        lane = int(uid)
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": lane,
+            "args": {"name": f"journey {tid_str[:8]} (uid {uid})"}})
+        p = j["placement"]
+        if p is not None:
+            events.append({
+                "name": "route", "ph": "X", "ts": us(p["t"]),
+                "dur": max(float(p.get("dur_s") or 0.0) * _US, 1.0),
+                "pid": pid, "tid": lane,
+                "args": {"trace_id": tid_str,
+                         "replica": p.get("replica"),
+                         "affinity_hit": bool(p.get("affinity_hit")),
+                         "scores": str(p.get("scores")),
+                         "candidates": str(p.get("candidates"))}})
+        for seg in j["segments"]:
+            rec, rid = seg["record"], seg["replica"]
+            ev = rec.get("events") or {}
+            sub, fin = ev.get("submitted"), ev.get("finish")
+            if sub is None:
+                continue
+            end = fin
+            if end is None:       # still live: extend to the last mark
+                end = max([sub] + [c[0] for c in rec.get("chunks", ())]
+                          + list(ev.values()))
+            args = {"trace_id": tid_str, "replica": rid,
+                    "status": rec.get("status"), "uid": rec.get("uid"),
+                    "n_tokens": rec.get("n_tokens")}
+            if rec.get("rerouted_from") is not None:
+                args["rerouted_from"] = rec["rerouted_from"]
+            events.append({
+                "name": f"replica{rid}:{rec.get('status') or 'live'}",
+                "ph": "X", "ts": us(sub),
+                "dur": max((end - sub) * _US, 1.0),
+                "pid": pid, "tid": lane, "args": args})
+            for t, n in rec.get("chunks", ()):
+                events.append({
+                    "name": f"chunk({int(n)})", "ph": "i", "s": "t",
+                    "ts": us(t), "pid": pid, "tid": lane,
+                    "args": {"trace_id": tid_str, "replica": rid,
+                             "n_tokens": int(n)}})
+        # flow arrows: placement -> first segment, then one per reroute
+        if p is not None and j["segments"]:
+            first = j["segments"][0]["record"]
+            sub = (first.get("events") or {}).get("submitted")
+            if sub is not None:
+                fid = f"place:{tid_str}"
+                common = {"name": "place", "cat": "place", "id": fid,
+                          "pid": pid, "tid": lane,
+                          "args": {"trace_id": tid_str}}
+                events.append({**common, "ph": "s", "ts": us(p["t"])})
+                events.append({**common, "ph": "f", "bp": "e",
+                               "ts": us(max(sub, p["t"]))})
+        for i, r in enumerate(j["reroutes"]):
+            fid = f"reroute:{tid_str}:{i}"
+            args = {"trace_id": tid_str,
+                    "rerouted_from": r.get("from_replica"),
+                    "rerouted_to": r.get("to_replica"),
+                    "postmortem": r.get("postmortem")}
+            common = {"name": "reroute", "cat": "reroute", "id": fid,
+                      "pid": pid, "tid": lane, "args": args}
+            events.append({**common, "ph": "s", "ts": us(r["t"])})
+            events.append({**common, "ph": "f", "bp": "e",
+                           "ts": us(r["t"]) + 1.0})
+    return events
+
+
+# ------------------------------------------------------------- validation
+def _journey_events(trace_obj: Dict[str, Any],
+                    pid: int = PID_JOURNEYS) -> Dict[str, List[dict]]:
+    """Group the journey-lane events of a Chrome trace by trace id."""
+    by_tid: Dict[str, List[dict]] = {}
+    for e in trace_obj.get("traceEvents", ()):
+        if e.get("pid") != pid or e.get("ph") == "M":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_tid.setdefault(tid, []).append(e)
+    return by_tid
+
+
+def validate_journeys(trace_obj: Dict[str, Any], *,
+                      pid: int = PID_JOURNEYS,
+                      require_chunks: bool = True) -> List[str]:
+    """The ``tputrace journey --validate`` contract over a merged trace:
+
+    * every journey has exactly one ``route`` span (the router's
+      placement decision);
+    * all of a journey's events sit on ONE lane — a single connected
+      journey per trace id, even across a reroute;
+    * a journey that finished ``done`` streamed at least one chunk;
+    * any segment carrying ``rerouted_from`` has a matching ``reroute``
+      flow-arrow pair (``s`` + ``f``).
+
+    Returns a list of problems (empty = valid)."""
+    problems: List[str] = []
+    by_tid = _journey_events(trace_obj, pid)
+    if not by_tid:
+        problems.append("no journey events found (pid %d)" % pid)
+        return problems
+    for tid, evs in sorted(by_tid.items()):
+        lanes = {e.get("tid") for e in evs}
+        if len(lanes) != 1:
+            problems.append(
+                f"journey {tid}: split across lanes {sorted(lanes)}")
+        routes = [e for e in evs if e.get("name") == "route"]
+        if len(routes) != 1:
+            problems.append(
+                f"journey {tid}: expected 1 route span, got {len(routes)}")
+        segments = [e for e in evs if e.get("ph") == "X"
+                    and str(e.get("name", "")).startswith("replica")]
+        if not segments:
+            problems.append(f"journey {tid}: no replica segment span")
+            continue
+        final = max(segments, key=lambda e: e.get("ts", 0.0))
+        status = (final.get("args") or {}).get("status")
+        chunks = [e for e in evs if e.get("ph") == "i"
+                  and str(e.get("name", "")).startswith("chunk")]
+        if require_chunks and status == "done" and not chunks:
+            problems.append(
+                f"journey {tid}: finished done with no chunk events")
+        rerouted = [e for e in segments
+                    if (e.get("args") or {}).get("rerouted_from")
+                    is not None]
+        if rerouted:
+            flows = {e.get("ph") for e in evs
+                     if e.get("cat") == "reroute"}
+            if not {"s", "f"} <= flows:
+                problems.append(
+                    f"journey {tid}: rerouted segment without a "
+                    f"reroute flow link (have phases {sorted(flows)})")
+    return problems
+
+
+def summarize_journeys(trace_obj: Dict[str, Any], *,
+                       pid: int = PID_JOURNEYS) -> List[Dict[str, Any]]:
+    """Per-journey roll-up for the CLI listing (sorted by first ts)."""
+    out: List[Dict[str, Any]] = []
+    for tid, evs in _journey_events(trace_obj, pid).items():
+        segments = [e for e in evs if e.get("ph") == "X"
+                    and str(e.get("name", "")).startswith("replica")]
+        chunks = [e for e in evs if e.get("ph") == "i"
+                  and str(e.get("name", "")).startswith("chunk")]
+        reroutes = [e for e in evs if e.get("cat") == "reroute"
+                    and e.get("ph") == "s"]
+        final = max(segments, key=lambda e: e.get("ts", 0.0)) \
+            if segments else None
+        fargs = (final.get("args") or {}) if final else {}
+        replicas = [str((e.get("args") or {}).get("replica"))
+                    for e in sorted(segments,
+                                    key=lambda e: e.get("ts", 0.0))]
+        out.append({
+            "trace_id": tid,
+            "uid": fargs.get("uid"),
+            "status": fargs.get("status"),
+            "replicas": replicas,
+            "n_chunks": len(chunks),
+            "n_tokens": sum(int((e.get("args") or {}).get("n_tokens", 0))
+                            for e in chunks),
+            "n_reroutes": len(reroutes),
+            "t0": min((e.get("ts", 0.0) for e in evs), default=0.0),
+        })
+    out.sort(key=lambda j: j["t0"])
+    return out

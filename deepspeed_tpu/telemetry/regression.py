@@ -132,6 +132,11 @@ FRONTEND_SPECS: List[MetricSpec] = [
     MetricSpec(("mfu", "flops_per_token"), LOWER, 0.25),
     MetricSpec(("hbm", "decode_chunk", "temp_bytes"), LOWER, 0.25),
     MetricSpec(("hbm", "arena", "arena_bytes"), LOWER, 0.10),
+    # ---- SLO burn-rate engine (live /slo self-fetch) ----
+    MetricSpec(("slo", "endpoint_ok"), SHIFT, abs_tol=0.0,
+               note="the bench GETs /slo live and checks its schema"),
+    MetricSpec(("slo", "n_slos"), SHIFT, abs_tol=0.0,
+               note="stock objective count is deterministic"),
 ]
 
 FLEET_SPECS: List[MetricSpec] = [
@@ -160,6 +165,27 @@ FLEET_SPECS: List[MetricSpec] = [
                note="pinned disagg retrace budget"),
     MetricSpec(("disagg", "handoffs"), SHIFT, abs_tol=0.0,
                note="one D2D handoff per prefilled request"),
+    # ---- crash observability (injected mid-stream replica crash) ----
+    MetricSpec(("crash", "journey_complete"), SHIFT, abs_tol=0.0,
+               note="every request one connected journey, binary"),
+    MetricSpec(("crash", "postmortem_inflight_match"), SHIFT,
+               abs_tol=0.0,
+               note="postmortem in-flight set == error/rerouted "
+                    "handles, binary"),
+    MetricSpec(("crash", "rerouted_parity"), SHIFT, abs_tol=0.0,
+               note="rerouted greedy streams stay bit-identical"),
+    MetricSpec(("crash", "errors"), SHIFT, abs_tol=0.0,
+               note="exactly the one wedged-mid-chunk request errors"),
+    MetricSpec(("crash", "rerouted"), SHIFT, abs_tol=0.0,
+               note="every queued request re-homes on the survivor"),
+    MetricSpec(("journey", "complete"), SHIFT, abs_tol=0.0,
+               note="validate_journeys over the merged export, binary"),
+    MetricSpec(("journey", "rerouted_links"), SHIFT, abs_tol=0.0,
+               note="one reroute flow link per adopted handle"),
+    MetricSpec(("slo", "burn_moved"), SHIFT, abs_tol=0.0,
+               note="availability burn must rise in the crash window"),
+    MetricSpec(("slo", "burn_recovered_flag"), SHIFT, abs_tol=0.0,
+               note="fast burn must fall back after the window drains"),
 ]
 
 SPEC_SETS: Dict[str, List[MetricSpec]] = {

@@ -1,0 +1,276 @@
+"""Declarative SLOs with multi-window error-budget burn rates.
+
+The serving tier already measures TTFT/TPOT histograms and terminal
+counters per replica (``TraceLog``); what's missing is the operator
+question: *are we inside our error budget, and how fast are we burning
+it?* This module answers it the SRE way:
+
+* an :class:`SLOSpec` declares one objective — a per-request latency
+  target (``kind="latency"``: metric + threshold, scored per request),
+  availability (terminal ``error``/``expired`` fraction), or shed rate
+  (``rejected`` fraction) — with a target good-fraction ``objective``;
+* an :class:`SLOEngine` subscribes to ``TraceLog`` finishes
+  (:meth:`SLOEngine.attach`) and keeps a bounded sample window;
+* :meth:`SLOEngine.evaluate` scores every spec over each rolling window
+  in ``windows_s``: ``burn_rate = bad_fraction / (1 - objective)`` —
+  burn 1.0 means exactly on budget, >1 means the budget would exhaust
+  before the window's compliance period ends. Multi-window (fast +
+  slow) is the standard page-on-fast-burn / ticket-on-slow-burn split.
+
+Every evaluation exports ``slo/<name>/burn_rate_<w>`` and
+``slo/<name>/budget_remaining_<w>`` gauges through the telemetry
+runtime (they land on ``/metrics``), and the full report is served as
+JSON by the ``/slo`` endpoint (``telemetry/exposition.py``).
+``HealthMonitor`` can opt in to a fast-burn degraded state so
+``/readyz`` (and therefore a fleet router) backs off a replica that is
+torching its budget.
+
+Stdlib-only; safe to import without JAX.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from .core import gauge as _telemetry_gauge
+
+SCHEMA = "dstpu-slo-v1"
+
+#: terminal statuses that count against availability
+BAD_STATUSES = ("error", "expired")
+#: terminal statuses that form the availability denominator
+TERMINAL_STATUSES = ("done", "error", "expired", "cancelled")
+#: statuses ignored entirely: the request continued on another replica
+CONTINUED_STATUSES = ("rerouted",)
+
+
+@dataclass
+class SLOSpec:
+    """One declarative objective.
+
+    ``kind``:
+      * ``"latency"`` — a finished-``done`` request is good when
+        ``metric`` (a TraceLog sample field, e.g. ``ttft_s``) is at
+        most ``threshold_s``; ``quantile`` is also reported per window.
+      * ``"availability"`` — good = terminal status not in
+        :data:`BAD_STATUSES`.
+      * ``"shed_rate"`` — good = not ``rejected`` (denominator includes
+        rejections).
+    ``objective`` is the target good-fraction; the error budget is
+    ``1 - objective``."""
+    name: str
+    kind: str = "availability"
+    objective: float = 0.99
+    metric: str = "ttft_s"
+    threshold_s: float = 1.0
+    quantile: float = 0.99
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "availability", "shed_rate"):
+            raise ValueError(f"unknown SLO kind: {self.kind!r}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1), got "
+                             f"{self.objective}")
+
+
+def default_slos(*, ttft_threshold_s: float = 2.0,
+                 tpot_threshold_s: float = 0.5,
+                 latency_objective: float = 0.95,
+                 availability_objective: float = 0.99,
+                 shed_objective: float = 0.9) -> List[SLOSpec]:
+    """The serving tier's stock objectives (thresholds are per-request
+    targets; benches tighten or loosen them per run)."""
+    return [
+        SLOSpec("ttft", kind="latency", metric="ttft_s",
+                threshold_s=ttft_threshold_s, quantile=0.99,
+                objective=latency_objective,
+                description="time to first token"),
+        SLOSpec("tpot", kind="latency", metric="tpot_s",
+                threshold_s=tpot_threshold_s, quantile=0.95,
+                objective=latency_objective,
+                description="time per output token"),
+        SLOSpec("availability", kind="availability",
+                objective=availability_objective,
+                description="terminal requests not error/expired"),
+        SLOSpec("shed", kind="shed_rate", objective=shed_objective,
+                description="requests not rejected by admission"),
+    ]
+
+
+def _interp_quantile(xs: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated quantile over a sorted list (same convention
+    as ``serving.metrics.Reservoir.percentile``)."""
+    if not xs:
+        return None
+    q = min(max(q, 0.0), 1.0)
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (pos - lo) * (xs[hi] - xs[lo])
+
+
+@dataclass
+class _Sample:
+    t: float
+    status: Optional[str]
+    metrics: Dict[str, Optional[float]] = field(default_factory=dict)
+
+
+class SLOEngine:
+    """Rolling-window SLO evaluator fed by TraceLog terminal records.
+
+    ``windows_s`` are the rolling evaluation windows, shortest first
+    (the shortest is the fast-burn window). ``capacity`` bounds the
+    retained samples — size it above the expected request rate times
+    the longest window."""
+
+    _METRICS = ("ttft_s", "tpot_s", "queue_wait_s")
+
+    def __init__(self, specs: Optional[Iterable[SLOSpec]] = None, *,
+                 windows_s: Iterable[float] = (60.0, 300.0),
+                 capacity: int = 8192,
+                 clock: Callable[[], float] = time.monotonic,
+                 gauge_fn: Optional[Callable[[str, float], None]] = None):
+        self.specs = list(specs) if specs is not None else default_slos()
+        self.windows_s = tuple(sorted(float(w) for w in windows_s))
+        if not self.windows_s:
+            raise ValueError("need at least one window")
+        self.clock = clock
+        self._gauge = gauge_fn if gauge_fn is not None \
+            else _telemetry_gauge
+        self._samples: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.n_observed = 0
+
+    # ---------------------------------------------------------- ingestion
+    def observe(self, trace: Any) -> None:
+        """TraceLog finish-listener: fold one terminal RequestTrace
+        (anything exposing ``status`` + the latency properties)."""
+        status = getattr(trace, "status", None)
+        if status in CONTINUED_STATUSES:
+            return
+        metrics = {m: getattr(trace, m, None) for m in self._METRICS}
+        self.observe_record(status=status, **metrics)
+
+    def observe_record(self, *, status: Optional[str],
+                       t: Optional[float] = None,
+                       **metrics: Optional[float]) -> None:
+        """Synthetic/bench ingestion path (tests drive windows with an
+        explicit ``t``)."""
+        s = _Sample(t=self.clock() if t is None else float(t),
+                    status=status, metrics=dict(metrics))
+        with self._lock:
+            self._samples.append(s)
+            self.n_observed += 1
+
+    def attach(self, tracelog: Any) -> "SLOEngine":
+        """Subscribe to a ``TraceLog``'s finish fan-out; returns self so
+        ``SLOEngine().attach(log)`` chains."""
+        tracelog.add_listener(self.observe)
+        return self
+
+    # --------------------------------------------------------- evaluation
+    def _score(self, spec: SLOSpec, window: List[_Sample]):
+        """(total, bad, quantile_value) for one spec over one window."""
+        if spec.kind == "latency":
+            vals = [s.metrics.get(spec.metric) for s in window
+                    if s.status == "done"
+                    and s.metrics.get(spec.metric) is not None]
+            bad = sum(1 for v in vals if v > spec.threshold_s)
+            qv = _interp_quantile(sorted(vals), spec.quantile)
+            return len(vals), bad, qv
+        if spec.kind == "availability":
+            pool = [s for s in window if s.status in TERMINAL_STATUSES]
+            bad = sum(1 for s in pool if s.status in BAD_STATUSES)
+            return len(pool), bad, None
+        # shed_rate
+        pool = [s for s in window
+                if s.status in TERMINAL_STATUSES + ("rejected",)]
+        bad = sum(1 for s in pool if s.status == "rejected")
+        return len(pool), bad, None
+
+    @staticmethod
+    def _window_key(w: float) -> str:
+        return f"{int(w)}s" if float(w).is_integer() else f"{w}s"
+
+    def evaluate(self, now: Optional[float] = None, *,
+                 export_gauges: bool = True) -> Dict[str, Any]:
+        """Score every spec over every window; optionally export
+        ``slo/*`` gauges. Empty windows score burn 0 (no evidence of
+        burn, full budget)."""
+        now = self.clock() if now is None else float(now)
+        with self._lock:
+            samples = list(self._samples)
+        slos: List[Dict[str, Any]] = []
+        max_burn = 0.0
+        fast_key = self._window_key(self.windows_s[0])
+        for spec in self.specs:
+            budget = max(1.0 - spec.objective, 1e-9)
+            windows: Dict[str, Any] = {}
+            worst_burn, worst_w = 0.0, self.windows_s[0]
+            for w in self.windows_s:
+                sel = [s for s in samples if now - s.t <= w]
+                total, bad, qv = self._score(spec, sel)
+                frac = (bad / total) if total else 0.0
+                burn = frac / budget
+                entry = {
+                    "window_s": w, "total": total, "bad": bad,
+                    "bad_fraction": frac, "burn_rate": burn,
+                    "budget_remaining": max(0.0, 1.0 - burn),
+                }
+                if spec.kind == "latency":
+                    entry["quantile"] = spec.quantile
+                    entry["quantile_value"] = qv
+                key = self._window_key(w)
+                windows[key] = entry
+                if burn > worst_burn:
+                    worst_burn, worst_w = burn, w
+                if export_gauges:
+                    self._gauge(f"slo/{spec.name}/burn_rate_{key}",
+                                float(burn))
+                    self._gauge(
+                        f"slo/{spec.name}/budget_remaining_{key}",
+                        float(entry["budget_remaining"]))
+            slos.append({
+                "name": spec.name, "kind": spec.kind,
+                "objective": spec.objective,
+                "description": spec.description,
+                "threshold_s": spec.threshold_s
+                if spec.kind == "latency" else None,
+                "metric": spec.metric
+                if spec.kind == "latency" else None,
+                "windows": windows,
+                "worst_burn_rate": worst_burn,
+                "worst_window_s": worst_w,
+                "fast_burn_rate": windows[fast_key]["burn_rate"],
+            })
+            max_burn = max(max_burn, worst_burn)
+        if export_gauges:
+            self._gauge("slo/max_burn_rate", float(max_burn))
+        return {
+            "schema": SCHEMA,
+            "t": now,
+            "windows_s": list(self.windows_s),
+            "n_samples": len(samples),
+            "n_observed": self.n_observed,
+            "max_burn_rate": max_burn,
+            "max_fast_burn_rate": max(
+                (s["fast_burn_rate"] for s in slos), default=0.0),
+            "slos": slos,
+        }
+
+    def report(self) -> Dict[str, Any]:
+        """The ``/slo`` endpoint payload (alias of :meth:`evaluate`)."""
+        return self.evaluate()
+
+    def fast_burn_rate(self, now: Optional[float] = None) -> float:
+        """Max burn rate over the SHORTEST window across all specs —
+        the page-worthy number ``HealthMonitor`` keys its opt-in
+        degraded state on."""
+        rep = self.evaluate(now, export_gauges=False)
+        return float(rep["max_fast_burn_rate"])

@@ -254,7 +254,14 @@ class TestFleetIntegration:
     def test_injected_crash_reroutes_queued_to_survivor(self, tiny_engine):
         """The dead-replica drain: requests the crashed replica never
         prefilled must complete on the survivor — same handles, correct
-        tokens — while prefilled requests resolve ``error``."""
+        tokens — while prefilled requests resolve ``error``. The crash
+        must also leave the full observability story: a postmortem JSON
+        whose in-flight set exactly matches the error/rerouted handles,
+        crash/reroute journal records carrying the trace ids, and a
+        merged journey export where every request — the rerouted ones
+        included — is one connected journey under one trace id."""
+        import json
+        from deepspeed_tpu.telemetry.journey import validate_journeys
         prompts = _prompts(6, seed=1)
         oracle = _serving(tiny_engine)
         want = [r.output_ids for r in oracle.run(prompts,
@@ -286,10 +293,55 @@ class TestFleetIntegration:
             assert stats["replica_crashes"] == 1
             assert stats["rerouted"] == len(rest)
             assert stats["alive"] == 1
+            # every handle carries the trace id minted at submit
+            for h in [first] + rest:
+                assert h.trace_id
+            # flight recorder: the crashed frontend dumped a postmortem
+            # BEFORE resolving any handle, so its in-flight set is
+            # exactly the handles the caller saw error/re-route
+            pm_path = router.replicas[0].frontend.postmortem_path
+            assert pm_path
+            with open(pm_path) as f:
+                pm = json.load(f)
+            assert pm["schema"] == "dstpu-postmortem-v1"
+            assert pm["reason"] == "driver_crash"
+            assert "injected decode fault" in pm["error"]
+            assert ({e["uid"] for e in pm["in_flight"]}
+                    == {first.uid} | {h.uid for h in rest})
+            # the wedged request was mid-chunk: its slot is mapped
+            assert first.uid in pm["slot_uids"].values()
+            # crash + reroute journal records carry the postmortem path
+            # and the preserved trace ids
+            crash_rec = stats["crashes"][0]
+            assert crash_rec["replica"] == 0
+            assert crash_rec["postmortem"] == pm_path
+            assert crash_rec["n_salvaged"] == len(rest)
+            journal = router.journey_journal()
+            assert ({r["trace_id"] for r in journal["reroutes"]}
+                    == {h.trace_id for h in rest})
+            for r in journal["reroutes"]:
+                assert r["from_replica"] == 0
+                assert r["to_replica"] == 1
+                assert r["postmortem"] == pm_path
             # post-crash traffic lands on the survivor
             late = router.submit(prompts[0], max_new_tokens=6)
             assert late.result(timeout=60) == "done"
             assert np.array_equal(want[0], late.output_ids)
+            # merged journey export: one connected lane per trace id,
+            # reroute flow links present — the bin/tputrace journey
+            # --validate contract
+            trace = router.export_chrome()
+            assert validate_journeys(trace) == []
+            # a rerouted journey has both replicas' segments under ONE
+            # trace id, the survivor segment tagged rerouted_from
+            segs = [e for e in trace["traceEvents"]
+                    if (e.get("args") or {}).get("trace_id")
+                    == rest[0].trace_id
+                    and str(e.get("name", "")).startswith("replica")]
+            replicas_seen = {e["args"]["replica"] for e in segs}
+            assert replicas_seen == {0, 1}
+            assert any(e["args"].get("rerouted_from") == "0"
+                       for e in segs)
 
     def test_concurrent_engines_do_not_cross_retrace(self, tiny_engine):
         """Two engines pumped from separate threads must keep their

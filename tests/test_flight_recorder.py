@@ -1,0 +1,296 @@
+"""Crash flight recorder: ring semantics, postmortem dumps, triggers.
+
+Covers the PR-10 flight-recorder tentpole without JAX:
+
+* bounded ring: capacity eviction, oldest-first snapshots, thread-safe
+  recording;
+* postmortem documents: schema, atomic dump files, slot/uid maps,
+  watchdog state embedding;
+* the three dump triggers:
+  - driver crash (``ServingFrontend._fail_all`` on a JAX-free engine
+    whose pump raises) — the in-flight set must exactly match the
+    handles the caller saw resolve ``error``;
+  - watchdog max-failures — exactly ONE dump per healthy->unhealthy
+    flip, not one per failing beat;
+  - SIGTERM — every live recorder dumps, previous disposition chained.
+"""
+
+import json
+import signal
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+
+from deepspeed_tpu.telemetry.flight_recorder import (FlightRecorder,
+                                                     dump_all,
+                                                     install_sigterm_handler)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------ ring basics
+class TestRing:
+    def test_record_snapshot_oldest_first(self):
+        clock = FakeClock()
+        fr = FlightRecorder(capacity=8, label="r0", clock=clock)
+        for i in range(3):
+            fr.record("ev", i=i)
+            clock.advance(1.0)
+        snap = fr.snapshot()
+        assert [e["i"] for e in snap] == [0, 1, 2]
+        assert [e["t"] for e in snap] == [0.0, 1.0, 2.0]
+        assert all(e["kind"] == "ev" for e in snap)
+        # snapshots are copies
+        snap[0]["i"] = 99
+        assert fr.snapshot()[0]["i"] == 0
+
+    def test_capacity_bounds_the_ring(self):
+        fr = FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.record("ev", i=i)
+        snap = fr.snapshot()
+        assert len(snap) == 4
+        assert [e["i"] for e in snap] == [6, 7, 8, 9]
+        assert fr.n_recorded == 10      # total seen, not retained
+
+    def test_concurrent_records_never_lose_the_ring(self):
+        fr = FlightRecorder(capacity=64)
+        threads = [threading.Thread(
+            target=lambda k=k: [fr.record("ev", src=k)
+                                for _ in range(200)])
+            for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert fr.n_recorded == 800
+        assert len(fr.snapshot()) == 64
+
+
+# -------------------------------------------------------------- postmortem
+class TestPostmortem:
+    def test_dump_schema_and_roundtrip(self, tmp_path):
+        fr = FlightRecorder(capacity=8, label="r1",
+                            out_dir=str(tmp_path))
+        fr.record("chunk_launch", k=4)
+        fr.record("chunk_retire", n_tokens=8)
+        path = fr.dump(reason="driver_crash", error="boom",
+                       in_flight=[{"uid": 7, "trace_id": "abc",
+                                   "status": "running", "n_tokens": 3,
+                                   "disposition": "running"}],
+                       slot_uids={0: 7}, extra={"n_running": 1})
+        assert path.startswith(str(tmp_path))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == "dstpu-postmortem-v1"
+        assert doc["reason"] == "driver_crash"
+        assert doc["replica"] == "r1"
+        assert doc["error"] == "boom"
+        assert [e["kind"] for e in doc["events"]] == [
+            "chunk_launch", "chunk_retire"]
+        assert doc["in_flight"][0]["uid"] == 7
+        assert doc["slot_uids"] == {"0": 7}    # JSON keys are strings
+        assert doc["extra"] == {"n_running": 1}
+        assert doc["watchdog"] is None
+        assert fr.n_dumps == 1
+        assert fr.last_postmortem_path == path
+
+    def test_dump_embeds_watchdog_state(self, tmp_path):
+        fr = FlightRecorder(label="r2", out_dir=str(tmp_path))
+        fr.watchdog = SimpleNamespace(
+            state=lambda: {"ok": False, "n_failures": 3})
+        doc = json.load(open(fr.dump(reason="watchdog_max_failures")))
+        assert doc["watchdog"] == {"ok": False, "n_failures": 3}
+
+    def test_unserializable_fields_stringify(self, tmp_path):
+        fr = FlightRecorder(out_dir=str(tmp_path))
+        fr.record("ev", arr=np.arange(3))     # not JSON-serializable
+        doc = json.load(open(fr.dump(reason="test")))
+        assert isinstance(doc["events"][0]["arr"], str)
+
+
+# ----------------------------------------------- trigger: watchdog flip
+class TestWatchdogTrigger:
+    def _watchdog(self, fr, heartbeat, max_failures=2):
+        from deepspeed_tpu.serving.frontend.health import BackendWatchdog
+        return BackendWatchdog(heartbeat_fn=heartbeat, timeout_s=5.0,
+                               max_failures=max_failures,
+                               flight_recorder=fr)
+
+    def test_flip_dumps_exactly_once(self, tmp_path):
+        fr = FlightRecorder(label="wd", out_dir=str(tmp_path))
+
+        def failing():
+            raise RuntimeError("backend gone")
+
+        wd = self._watchdog(fr, failing, max_failures=2)
+        assert fr.watchdog is wd          # dumps include beat history
+        assert wd.beat()                  # 1st failure: still ok
+        assert fr.n_dumps == 0
+        assert not wd.beat()              # 2nd: flips unhealthy -> dump
+        assert fr.n_dumps == 1
+        assert not wd.beat()              # still unhealthy: NO new dump
+        assert not wd.beat()
+        assert fr.n_dumps == 1
+        doc = json.load(open(fr.last_postmortem_path))
+        assert doc["reason"] == "watchdog_max_failures"
+        assert "backend gone" in doc["error"]
+        assert doc["watchdog"]["ok"] is False
+        # every failing beat was recorded in the ring
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds.count("watchdog_failure") >= 2
+
+    def test_recovery_rearms_the_flip(self, tmp_path):
+        fr = FlightRecorder(label="wd2", out_dir=str(tmp_path))
+        ok = {"v": False}
+
+        def heartbeat():
+            if not ok["v"]:
+                raise RuntimeError("down")
+
+        wd = self._watchdog(fr, heartbeat, max_failures=1)
+        assert not wd.beat()
+        assert fr.n_dumps == 1
+        ok["v"] = True
+        assert wd.beat()                  # recovered
+        ok["v"] = False
+        assert not wd.beat()              # a NEW flip dumps again
+        assert fr.n_dumps == 2
+
+
+# --------------------------------------------------- trigger: SIGTERM
+class TestSigterm:
+    def test_handler_dumps_all_and_chains(self, tmp_path):
+        prev = signal.getsignal(signal.SIGTERM)
+        seen = []
+        try:
+            # installing over a callable must chain to it
+            signal.signal(signal.SIGTERM,
+                          lambda s, f: seen.append(s))
+            fr = FlightRecorder(label="st", out_dir=str(tmp_path))
+            fr.record("ev", i=1)
+            handler = install_sigterm_handler()
+            assert handler is not None
+            n_before = fr.n_dumps
+            handler(signal.SIGTERM, None)   # invoke directly, no kill
+            assert fr.n_dumps == n_before + 1
+            doc = json.load(open(fr.last_postmortem_path))
+            assert doc["reason"] == "sigterm"
+            assert seen == [signal.SIGTERM]
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_dump_all_never_raises(self, tmp_path):
+        fr = FlightRecorder(label="bad", out_dir="/nonexistent/dir")
+        good = FlightRecorder(label="good", out_dir=str(tmp_path))
+        paths = dump_all(reason="sigterm")
+        assert any(p.startswith(str(tmp_path)) for p in paths)
+        assert fr.n_dumps == 0
+
+
+# --------------------------------------- trigger: frontend driver crash
+class _CrashyEngine:
+    """``ServingEngine``'s frontend surface with a pump that wedges
+    (event-gated, like the fleet crash tests) and then raises. Real
+    scheduler + slot accounting so the postmortem's ``slot_uids`` map
+    is the true device state."""
+
+    def __init__(self, max_batch=2):
+        from deepspeed_tpu.serving.kv_cache import SlotAllocator
+        from deepspeed_tpu.serving.scheduler import \
+            ContinuousBatchScheduler
+        self.max_batch = max_batch
+        self.max_seq_len = 64
+        self.decode_chunk = 4
+        self.scheduler = ContinuousBatchScheduler(
+            SlotAllocator(max_batch, self.max_seq_len), max_queue=16)
+        self.chunk_in_flight = False
+        self.metrics = SimpleNamespace(tokens_out=0)
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def submit(self, req):
+        self.scheduler.submit(req)
+        return req
+
+    def cancel(self, req):
+        return self.scheduler.cancel(req)
+
+    def pump(self):
+        self.scheduler.admit()            # slots assigned before the
+        self.entered.set()                # fault, as on a real device
+        self.release.wait(30)
+        raise RuntimeError("injected host fault")
+
+
+class TestDriverCrashTrigger:
+    def test_postmortem_in_flight_matches_resolved_handles(self):
+        from deepspeed_tpu.serving.frontend import ServingFrontend
+        eng = _CrashyEngine(max_batch=2)
+        fe = ServingFrontend(eng)
+        try:
+            first = fe.submit(np.arange(1, 5, dtype=np.int32),
+                              max_new_tokens=8)
+            assert eng.entered.wait(30)   # driver wedged mid-pump
+            rest = [fe.submit(np.arange(1, 4, dtype=np.int32),
+                              max_new_tokens=8) for _ in range(3)]
+            eng.release.set()
+            for h in [first] + rest:
+                assert h.result(timeout=30) == "error"
+                assert "injected host fault" in h.error
+            assert fe.crashed
+            pm_path = fe.postmortem_path
+            assert pm_path
+            with open(pm_path) as f:
+                pm = json.load(f)
+            assert pm["schema"] == "dstpu-postmortem-v1"
+            assert pm["reason"] == "driver_crash"
+            assert "injected host fault" in pm["error"]
+            # the in-flight set is EXACTLY the handles that resolved
+            # error — dumped before _fail_all resolved any of them
+            assert ({e["uid"] for e in pm["in_flight"]}
+                    == {h.uid for h in [first] + rest})
+            by_uid = {e["uid"]: e for e in pm["in_flight"]}
+            assert by_uid[first.uid]["disposition"] == "running"
+            assert all(by_uid[h.uid]["disposition"] == "salvageable"
+                       for h in rest)
+            assert first.uid in pm["slot_uids"].values()
+            assert pm["extra"]["n_running"] >= 1
+            assert pm["extra"]["n_salvageable"] == len(rest)
+            # the ring captured the submits that preceded the crash
+            kinds = [e["kind"] for e in pm["events"]]
+            assert kinds.count("submit") == 1 + len(rest)
+            assert all(e["trace_id"] for e in pm["in_flight"])
+        finally:
+            fe.close(timeout=5)
+
+    def test_frontend_builds_default_recorder_with_label(self):
+        from deepspeed_tpu.serving.frontend import ServingFrontend
+        eng = _CrashyEngine()
+        fe = ServingFrontend(eng, telemetry_label="3")
+        try:
+            assert isinstance(fe.flight, FlightRecorder)
+            assert fe.flight.label == "3"
+            assert eng.flight is fe.flight     # engine records too
+        finally:
+            fe.close(timeout=5)
+
+    def test_injected_recorder_is_used(self, tmp_path):
+        from deepspeed_tpu.serving.frontend import ServingFrontend
+        fr = FlightRecorder(label="mine", out_dir=str(tmp_path))
+        eng = _CrashyEngine()
+        fe = ServingFrontend(eng, flight_recorder=fr)
+        try:
+            assert fe.flight is fr
+        finally:
+            fe.close(timeout=5)

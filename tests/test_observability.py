@@ -35,6 +35,13 @@ from deepspeed_tpu.serving.frontend import (AdmissionConfig,
                                             ServingFrontend, Ticket,
                                             TraceLog)
 from deepspeed_tpu.serving.metrics import Reservoir
+from deepspeed_tpu.telemetry.cli import main as tputrace_main
+from deepspeed_tpu.telemetry.journey import (PID_JOURNEYS, assemble_journeys,
+                                             journey_trace_events,
+                                             new_trace_id,
+                                             summarize_journeys,
+                                             validate_journeys)
+from deepspeed_tpu.telemetry.slo import SLOEngine, SLOSpec, default_slos
 
 pytestmark = pytest.mark.observability
 
@@ -681,3 +688,450 @@ class TestReadinessIntegration:
             assert not ready and reasons == ["backend_unresponsive"]
         finally:
             release.set()
+
+# ================================================ SLO burn-rate engine
+class TestSLOEngine:
+    def _engine(self, specs, windows=(10.0, 100.0), t=0.0):
+        clock = FakeClock(t)
+        eng = SLOEngine(specs, windows_s=windows, clock=clock,
+                        gauge_fn=lambda *_: None)
+        return eng, clock
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            SLOSpec("x", kind="latencyy")
+        with pytest.raises(ValueError):
+            SLOSpec("x", objective=1.0)
+        with pytest.raises(ValueError):
+            SLOSpec("x", objective=0.0)
+        names = [s.name for s in default_slos()]
+        assert names == ["ttft", "tpot", "availability", "shed"]
+
+    def test_latency_burn_math(self):
+        """10 done requests, 1 over threshold, objective 0.9 -> the
+        budget (0.1) is exactly consumed: burn 1.0."""
+        spec = SLOSpec("ttft", kind="latency", metric="ttft_s",
+                       threshold_s=1.0, objective=0.9, quantile=0.5)
+        eng, clock = self._engine([spec])
+        clock.t = 100.0
+        for _ in range(9):
+            eng.observe_record(status="done", t=95.0, ttft_s=0.1)
+        eng.observe_record(status="done", t=95.0, ttft_s=5.0)
+        rep = eng.evaluate(export_gauges=False)
+        assert rep["schema"] == "dstpu-slo-v1"
+        assert rep["n_samples"] == 10
+        win = rep["slos"][0]["windows"]["10s"]
+        assert win["total"] == 10 and win["bad"] == 1
+        assert win["bad_fraction"] == pytest.approx(0.1)
+        assert win["burn_rate"] == pytest.approx(1.0)
+        assert win["budget_remaining"] == pytest.approx(0.0)
+        assert win["quantile"] == 0.5
+        assert win["quantile_value"] == pytest.approx(0.1)
+        assert rep["max_burn_rate"] == pytest.approx(1.0)
+
+    def test_multi_window_split(self):
+        """Bad samples older than the fast window burn ONLY the slow
+        window: page-on-fast, ticket-on-slow."""
+        spec = SLOSpec("avail", kind="availability", objective=0.9)
+        eng, clock = self._engine([spec])
+        clock.t = 100.0
+        for _ in range(4):
+            eng.observe_record(status="error", t=20.0)   # slow-only
+        for _ in range(4):
+            eng.observe_record(status="done", t=99.0)    # recent, good
+        s = eng.evaluate(export_gauges=False)["slos"][0]
+        assert s["windows"]["10s"]["burn_rate"] == pytest.approx(0.0)
+        assert s["windows"]["100s"]["burn_rate"] == pytest.approx(5.0)
+        assert s["worst_window_s"] == 100.0
+        assert s["fast_burn_rate"] == pytest.approx(0.0)
+        assert eng.fast_burn_rate() == pytest.approx(0.0)
+        clock.t = 105.0          # the errors never enter the fast window
+        eng.observe_record(status="error", t=104.0)
+        assert eng.fast_burn_rate() > 0.0
+
+    def test_availability_ignores_rejected_shed_counts_it(self):
+        specs = [SLOSpec("avail", kind="availability", objective=0.5),
+                 SLOSpec("shed", kind="shed_rate", objective=0.5)]
+        eng, clock = self._engine(specs)
+        clock.t = 5.0
+        eng.observe_record(status="done", t=1.0)
+        eng.observe_record(status="rejected", t=1.0)
+        eng.observe_record(status="cancelled", t=1.0)
+        rep = eng.evaluate(export_gauges=False)
+        avail = rep["slos"][0]["windows"]["10s"]
+        shed = rep["slos"][1]["windows"]["10s"]
+        assert avail["total"] == 2 and avail["bad"] == 0
+        assert shed["total"] == 3 and shed["bad"] == 1
+
+    def test_empty_window_is_full_budget(self):
+        eng, _ = self._engine([SLOSpec("a", objective=0.99)])
+        rep = eng.evaluate(export_gauges=False)
+        win = rep["slos"][0]["windows"]["10s"]
+        assert win["total"] == 0 and win["burn_rate"] == 0.0
+        assert win["budget_remaining"] == 1.0
+        assert rep["max_burn_rate"] == 0.0
+
+    def test_gauge_export_names(self):
+        seen = {}
+        clock = FakeClock(50.0)
+        eng = SLOEngine([SLOSpec("avail", objective=0.9)],
+                        windows_s=(10.0, 100.0), clock=clock,
+                        gauge_fn=lambda n, v: seen.__setitem__(n, v))
+        eng.observe_record(status="error", t=49.0)
+        eng.evaluate()
+        assert seen["slo/avail/burn_rate_10s"] == pytest.approx(10.0)
+        assert seen["slo/avail/budget_remaining_10s"] == 0.0
+        assert seen["slo/max_burn_rate"] == pytest.approx(10.0)
+
+    def test_attach_tracelog_feeds_terminals_and_skips_rerouted(self):
+        clock = FakeClock(0.0)
+        log = TraceLog(clock=clock)
+        eng = SLOEngine([SLOSpec("avail", objective=0.9)],
+                        windows_s=(60.0,), clock=clock,
+                        gauge_fn=lambda *_: None).attach(log)
+        log.start(1, trace_id="t1")
+        log.mark(1, "submitted")
+        clock.advance(0.5)
+        log.chunk(1, 4)
+        log.finish(1, "done")
+        log.start(2, trace_id="t2")
+        log.finish(2, "rerouted")          # continued elsewhere: ignored
+        log.start(3, trace_id="t3")
+        log.finish(3, "error")
+        assert eng.n_observed == 2
+        rep = eng.evaluate(export_gauges=False)
+        win = rep["slos"][0]["windows"]["60s"]
+        assert win["total"] == 2 and win["bad"] == 1
+
+
+class TestSLOEndpoint:
+    def test_slo_endpoint_and_metrics_gauges(self):
+        rt = tel.TelemetryRuntime(enabled=True)
+        clock = FakeClock(100.0)
+        eng = SLOEngine(default_slos(), windows_s=(10.0, 60.0),
+                        clock=clock, gauge_fn=rt.gauge)
+        eng.observe_record(status="done", t=99.0, ttft_s=0.1, tpot_s=0.01)
+        eng.observe_record(status="error", t=99.0)
+        server = MetricsServer(runtime=rt, slo=eng)
+        try:
+            with urllib.request.urlopen(f"{server.url}/slo",
+                                        timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"] == "application/json"
+                rep = json.load(resp)
+            assert rep["schema"] == "dstpu-slo-v1"
+            assert rep["n_samples"] == 2
+            assert {s["name"] for s in rep["slos"]} == \
+                {"ttft", "tpot", "availability", "shed"}
+            assert rep["max_burn_rate"] > 0.0      # the error burned it
+            # the evaluation exported slo/* gauges onto /metrics
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=5) as resp:
+                families = parse_prometheus_text(
+                    resp.read().decode())["samples"]
+            slo_fams = [f for f in families if f.startswith("dstpu_slo_")]
+            assert "dstpu_slo_max_burn_rate" in slo_fams
+            assert any("burn_rate_10s" in f for f in slo_fams)
+        finally:
+            server.stop()
+
+    def test_slo_endpoint_404_when_not_wired(self):
+        server = MetricsServer()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{server.url}/slo", timeout=5)
+            assert exc.value.code == 404
+            assert b"no slo engine wired" in exc.value.read()
+        finally:
+            server.stop()
+
+
+class TestHealthMonitorFastBurn:
+    class _FakeSLO:
+        def __init__(self, rate):
+            self.rate = rate
+
+        def fast_burn_rate(self):
+            if isinstance(self.rate, Exception):
+                raise self.rate
+            return self.rate
+
+    def test_opt_in_threshold_flips_readiness(self):
+        slo = self._FakeSLO(1.0)
+        mon = HealthMonitor(slo=slo, slo_fast_burn_threshold=14.4)
+        ready, reasons, details = mon.check()
+        assert ready and details["slo_fast_burn_rate"] == 1.0
+        slo.rate = 20.0
+        ready, reasons, details = mon.check()
+        assert not ready and reasons == ["slo_fast_burn"]
+        assert details["slo_fast_burn_threshold"] == 14.4
+        slo.rate = 0.0                      # burn recovers -> ready again
+        assert mon.check()[0] is True
+
+    def test_without_threshold_slo_never_degrades(self):
+        mon = HealthMonitor(slo=self._FakeSLO(1e9))
+        ready, reasons, details = mon.check()
+        assert ready and reasons == []
+        assert "slo_fast_burn_rate" not in details
+
+    def test_slo_evaluation_error_does_not_flip(self):
+        mon = HealthMonitor(slo=self._FakeSLO(RuntimeError("nope")),
+                            slo_fast_burn_threshold=1.0)
+        ready, reasons, details = mon.check()
+        assert ready and "nope" in details["slo_error"]
+
+
+# =============================================== distributed journeys
+def _synthetic_journal():
+    """Two journeys over two replicas: A served clean on replica 0,
+    B rerouted 0 -> 1 after a crash (the test-double of
+    ``FleetRouter.journey_journal()``)."""
+    clock = FakeClock(10.0)
+    log0, log1 = TraceLog(clock=clock), TraceLog(clock=clock)
+    tid_a, tid_b = "aaaa000011112222", "bbbb000011112222"
+
+    log0.start(1, trace_id=tid_a, replica="0")
+    log0.mark(1, "submitted")
+    clock.advance(0.1)
+    log0.chunk(1, 4)
+    clock.advance(0.1)
+    log0.finish(1, "done")
+
+    log0.start(2, trace_id=tid_b, replica="0")
+    log0.mark(2, "submitted")
+    clock.advance(0.1)
+    log0.finish(2, "rerouted", error="RuntimeError: boom")
+    t_crash = clock.t
+    clock.advance(0.05)
+    log1.start(2, trace_id=tid_b, replica="1", rerouted_from="0")
+    log1.mark(2, "submitted")
+    clock.advance(0.1)
+    log1.chunk(2, 4)
+    clock.advance(0.1)
+    log1.finish(2, "done")
+
+    place = dict(dur_s=0.001, affinity_hit=False,
+                 scores={0: 0.5, 1: 0.4}, candidates=[0, 1])
+    return {
+        "placements": [
+            dict(place, trace_id=tid_a, uid=1, t=9.9, replica=0),
+            dict(place, trace_id=tid_b, uid=2, t=10.1, replica=0)],
+        "reroutes": [{"trace_id": tid_b, "uid": 2, "t": t_crash,
+                      "from_replica": 0, "to_replica": 1,
+                      "postmortem": "/tmp/pm.json"}],
+        "crashes": [{"replica": 0, "t": t_crash,
+                     "error": "RuntimeError: boom",
+                     "postmortem": "/tmp/pm.json", "n_salvaged": 1}],
+        "replicas": {0: log0.to_json(), 1: log1.to_json()},
+    }
+
+
+class TestJourneys:
+    def test_new_trace_id_shape(self):
+        a, b = new_trace_id(), new_trace_id()
+        assert len(a) == 16 and a != b
+        int(a, 16)                       # hex
+
+    def test_assemble_orders_segments_across_replicas(self):
+        js = assemble_journeys(_synthetic_journal())
+        assert len(js) == 2
+        a = js["aaaa000011112222"]
+        assert a["uid"] == 1 and a["status"] == "done"
+        assert [s["replica"] for s in a["segments"]] == [0]
+        b = js["bbbb000011112222"]
+        assert [s["replica"] for s in b["segments"]] == [0, 1]
+        assert b["segments"][0]["record"]["status"] == "rerouted"
+        assert b["segments"][1]["record"]["rerouted_from"] == "0"
+        assert b["status"] == "done"     # final segment wins
+        assert len(b["reroutes"]) == 1
+
+    def test_rendered_trace_validates_and_links_reroute(self):
+        events = journey_trace_events(_synthetic_journal())
+        trace = {"traceEvents": events}
+        assert validate_journeys(trace) == []
+        b = [e for e in events
+             if (e.get("args") or {}).get("trace_id")
+             == "bbbb000011112222"]
+        lanes = {e["tid"] for e in b}
+        assert lanes == {2}              # uid is the lane: one lane
+        flows = [e for e in b if e.get("cat") == "reroute"]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert flows[0]["args"]["rerouted_from"] == 0
+        assert flows[0]["args"]["postmortem"] == "/tmp/pm.json"
+        names = {e["name"] for e in b}
+        assert "route" in names
+        assert "replica0:rerouted" in names and "replica1:done" in names
+
+    def test_validate_failure_modes(self):
+        events = journey_trace_events(_synthetic_journal())
+
+        def drop(pred):
+            return {"traceEvents": [e for e in events if not pred(e)]}
+
+        no_route = drop(lambda e: e.get("name") == "route")
+        assert any("route span" in p for p in validate_journeys(no_route))
+        no_chunks = drop(lambda e: str(e.get("name", ""))
+                         .startswith("chunk"))
+        assert any("no chunk events" in p
+                   for p in validate_journeys(no_chunks))
+        no_flow = drop(lambda e: e.get("cat") == "reroute")
+        assert any("reroute flow link" in p
+                   for p in validate_journeys(no_flow))
+        assert any("no journey events" in p
+                   for p in validate_journeys({"traceEvents": []}))
+        split = {"traceEvents": [dict(e) for e in events]}
+        for e in split["traceEvents"]:
+            if (e.get("args") or {}).get("trace_id") \
+                    == "bbbb000011112222" and e.get("name") == "route":
+                e["tid"] = 99
+        assert any("split across lanes" in p
+                   for p in validate_journeys(split))
+
+    def test_summarize_rollup(self):
+        trace = {"traceEvents": journey_trace_events(_synthetic_journal())}
+        rows = summarize_journeys(trace)
+        by_tid = {r["trace_id"]: r for r in rows}
+        b = by_tid["bbbb000011112222"]
+        assert b["replicas"] == ["0", "1"]
+        assert b["status"] == "done"
+        assert b["n_reroutes"] == 1 and b["n_chunks"] == 1
+        assert b["n_tokens"] == 4
+        assert rows[0]["t0"] <= rows[1]["t0"]
+
+    def test_cli_journey_validate_and_lookup(self, tmp_path, capsys):
+        p = tmp_path / "journeys.json"
+        p.write_text(json.dumps(
+            {"traceEvents": journey_trace_events(_synthetic_journal())}))
+        assert tputrace_main(["journey", str(p), "--validate"]) == 0
+        out = capsys.readouterr().out
+        assert "journeys OK" in out
+        assert "0 -> 1" in out            # the rerouted journey's hops
+        # prefix lookup prints the per-event detail
+        assert tputrace_main(["journey", str(p), "bbbb"]) == 0
+        out = capsys.readouterr().out
+        assert "bbbb000011112222" in out and "rerouted" in out
+        # unknown id
+        assert tputrace_main(["journey", str(p), "ffff"]) == 1
+        capsys.readouterr()
+
+    def test_cli_journey_validate_fails_on_broken_trace(self, tmp_path,
+                                                        capsys):
+        events = [e for e in journey_trace_events(_synthetic_journal())
+                  if e.get("cat") != "reroute"]
+        p = tmp_path / "broken.json"
+        p.write_text(json.dumps({"traceEvents": events}))
+        assert tputrace_main(["journey", str(p), "--validate"]) == 1
+        assert "FAIL" in capsys.readouterr().err
+
+
+# ==================================== exposition under concurrent load
+def _assert_families_contiguous(text):
+    """Every sample line must sit under its own family's TYPE header —
+    series of one family never interleave another's block, and no
+    family emits two TYPE headers."""
+    import re
+    cur, seen = None, set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            fam = line.split()[2]
+            assert fam not in seen, f"duplicate TYPE header for {fam}"
+            seen.add(fam)
+            cur = fam
+        elif line and not line.startswith("#"):
+            name = re.match(r"[a-zA-Z_:][a-zA-Z0-9_:]*", line).group(0)
+            assert cur is not None, f"sample before any TYPE: {line}"
+            assert name in (cur, cur + "_sum", cur + "_count"), \
+                f"series {name} interleaved into family {cur}"
+
+
+class TestExpositionConcurrencyStress:
+    def test_families_stay_contiguous_under_concurrent_emission(self):
+        """N replica threads hammer one runtime (counter + gauge + span
+        + a sibling family whose name is a prefix of the first) while
+        the exposition renders: families must never interleave. The
+        prefix pair (stress/x, stress/x_sub) is the trap — byte-sorted
+        raw names would split stress/x's replicas around it."""
+        rt = tel.TelemetryRuntime(enabled=True)
+        stop = threading.Event()
+        errors = []
+
+        def writer(rid):
+            while not stop.is_set():
+                with tel.core.replica_label(rid):
+                    rt.count("stress/x", 1.0)
+                    rt.count("stress/x_sub", 1.0)
+                    rt.gauge("stress/depth", float(rid))
+                    rt.instant("stress/tick")
+                    with rt.span("stress/op"):
+                        pass
+
+        threads = [threading.Thread(target=writer, args=(rid,))
+                   for rid in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 1.0
+            n_renders = 0
+            while time.monotonic() < deadline:
+                text = render_prometheus(runtime=rt)
+                try:
+                    _assert_families_contiguous(text)
+                    parse_prometheus_text(text)
+                except AssertionError as e:
+                    errors.append(e)
+                    break
+                n_renders += 1
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors[:1]
+        assert n_renders > 0
+        # final render carries one series per replica in each family
+        parsed = parse_prometheus_text(render_prometheus(runtime=rt))
+        xs = parsed["samples"]["dstpu_stress_x_total"]
+        assert {lab["replica"] for lab, _ in xs} == {"0", "1", "2", "3"}
+
+
+# =============================== reservoir small-n percentile pinning
+class TestReservoirSmallN:
+    """Regression pins for the small-sample quantile path: linear
+    interpolation over n-1 gaps, p99 strictly below the max for n>1,
+    out-of-range q clamped instead of indexing off the end."""
+
+    def test_n1_every_percentile_is_the_value(self):
+        r = Reservoir()
+        r.add(5.0)
+        assert r.percentile(50) == 5.0
+        assert r.percentile(95) == 5.0
+        assert r.percentile(99) == 5.0
+
+    def test_n2_interpolates_the_gap(self):
+        r = Reservoir()
+        r.add(3.0)
+        r.add(1.0)
+        assert r.percentile(50) == pytest.approx(2.0)
+        assert r.percentile(95) == pytest.approx(2.9)
+        assert r.percentile(99) == pytest.approx(2.98)
+
+    def test_n5_pins(self):
+        r = Reservoir()
+        for x in (5.0, 3.0, 1.0, 4.0, 2.0):
+            r.add(x)
+        assert r.percentile(50) == pytest.approx(3.0)
+        assert r.percentile(95) == pytest.approx(4.8)
+        assert r.percentile(99) == pytest.approx(4.96)
+        assert r.percentile(99) < 5.0        # never snaps to the max
+        assert r.percentile(0) == 1.0
+        assert r.percentile(100) == 5.0
+
+    def test_out_of_range_q_clamps(self):
+        r = Reservoir()
+        for x in (1.0, 2.0, 3.0):
+            r.add(x)
+        assert r.percentile(150.0) == 3.0
+        assert r.percentile(-5.0) == 1.0
+        assert r.percentile(50) == 2.0
+
+    def test_empty_is_zero(self):
+        assert Reservoir().percentile(99) == 0.0
