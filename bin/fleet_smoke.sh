@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Fleet smoke: the fleet serving benchmark on CPU. Five asserted cases:
+# Fleet smoke: the fleet serving benchmark on CPU. Six asserted cases:
 # 2-replica FleetRouter >= 1.6x a 1-replica router over
 # simulated-compute replicas (real scheduler/admission/stream stack,
 # sleep-for-device — one XLA CPU engine already saturates every host
@@ -10,12 +10,19 @@
 # decode_chunk_tp2_fn budget; disaggregated prefill bit-identical to
 # co-located paged with exactly one D2D handoff per prefill under the
 # pinned decode_chunk_paged_disagg_fn budget; an injected mid-stream
-# replica crash produces a fully-connected journey trace (one trace id
-# per request incl. reroutes), a postmortem whose in-flight set
-# matches the error/rerouted handles, and SLO burn rates that move
-# during the crash window and recover. Writes BENCH_fleet.json
-# at the repo root and exits nonzero on any parity/scaling/budget
-# failure — fast enough for tier-1.
+# replica crash loses NOTHING (the wedged request replays its prompt +
+# emitted prefix on the survivor, bit-identical) while producing a
+# fully-connected journey trace (one trace id per request incl.
+# reroutes), a postmortem whose in-flight set matches the rerouted
+# handles with every record salvageable, and a TTFT burn rate that
+# moves during the crash window and recovers (availability stays
+# clean); and the elastic case — kill a replica mid-stream at 2x load
+# — where the ElasticController restores the below-target fleet from
+# the replica factory (EWMA warm-started), retires a surge replica
+# gracefully once burn calms, and ends at exactly target size with
+# zero lost requests and bounded recovery TTFT p99. Writes
+# BENCH_fleet.json at the repo root and exits nonzero on any
+# parity/scaling/budget failure — fast enough for tier-1.
 #
 # Usage: bin/fleet_smoke.sh        (from the repo root, or anywhere)
 

@@ -1,7 +1,7 @@
-"""Fleet serving benchmark: replica routing, tp=2, disaggregation, and
-crash observability.
+"""Fleet serving benchmark: replica routing, tp=2, disaggregation,
+crash observability, and elastic recovery.
 
-Five cases over one tiny model (CPU-runnable, smoke-sized):
+Six cases over one tiny model (CPU-runnable, smoke-sized):
 
   * router scaling — a 2-replica :class:`FleetRouter` against a
     1-replica router on SIMULATED-compute replicas: engines that honor
@@ -35,13 +35,25 @@ Five cases over one tiny model (CPU-runnable, smoke-sized):
     count, and exactly one D2D handoff per prefilled request.
 
   * crash observability — an injected mid-decode-chunk replica crash
-    over a 2-replica fleet: the flight-recorder postmortem's in-flight
-    set must exactly match the handles that resolved error/rerouted,
-    every request (rerouted included) must render as ONE connected
-    journey under one trace id in the merged Perfetto export
-    (``validate_journeys``), and the availability SLO burn rate must
-    move during the crash window and recover after it (``--slo`` /
-    ``--trace-out``).
+    over a 2-replica fleet: ZERO requests resolve error (the wedged
+    mid-chunk request REPLAYS its prompt + emitted prefix on the
+    survivor, finishing bit-identical), the flight-recorder
+    postmortem's in-flight set must exactly match the rerouted handles
+    with every record ``salvageable``, every request must render as
+    ONE connected journey under one trace id in the merged Perfetto
+    export (``validate_journeys``), and the TTFT SLO burn rate —
+    replayed journeys keep their original submit time — must move
+    during the crash window and recover after it, while availability
+    stays clean (``--slo`` / ``--trace-out``).
+
+  * elastic recovery — kill a replica mid-stream at 2x load with an
+    :class:`ElasticController` holding the fleet at target size: zero
+    lost requests, replayed streams bit-identical with no duplicate
+    tokens, bounded recovery TTFT p99, the below-target fleet restored
+    immediately from the replica factory (EWMA warm-started from a
+    peer), a surge replica retired gracefully (drain -> idle -> close)
+    once burn calms, and the fleet finishing at exactly target size
+    with a clean fast window.
 
 Run:  python -m deepspeed_tpu.benchmarks.fleet_bench --json-out BENCH_fleet.json
 (needs XLA_FLAGS=--xla_force_host_platform_device_count=8 for the tp
@@ -337,29 +349,42 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             f"saw {handoffs}")
 
     # ---- crash journeys + SLO burn + flight recorder -------------------
-    # LAST on purpose: this case injects a mid-stream replica crash, and
-    # the parity cases above assert their crash counters are zero.
+    # LAST on purpose: these cases inject mid-stream replica crashes,
+    # and the parity cases above assert their crash counters are zero.
+    # Replayed requests re-prefill prompt + emitted prefix, so the
+    # crash-path engines need prompt headroom for the whole stream.
+    crash_kw = dict(eng_kw, max_prompt_len=prompt_len + max_new_tokens)
     result.update(_crash_case(
-        inf, eng_kw, prompts, oracle_out, max_new_tokens,
+        inf, crash_kw, prompts, oracle_out, max_new_tokens,
         slo=slo, trace_out=trace_out))
+
+    # ---- elastic fleet: kill a replica mid-stream at 2x load -----------
+    result.update(_elastic_case(
+        inf, crash_kw, prompts, oracle_out, max_new_tokens))
 
     return _round_tree(result)
 
 
 def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
                 slo=True, trace_out=None,
-                slo_windows_s=(2.0, 20.0)) -> dict:
+                slo_windows_s=(2.0, 20.0),
+                ttft_threshold_s=2.0, wedge_hold_s=3.0) -> dict:
     """Injected mid-stream replica crash over a 2-replica fleet:
 
     * phase A (healthy) — a routed batch lands on the survivor; every
       SLO burn rate must be 0;
     * phase B (crash) — one request is wedged mid-decode-chunk on the
-      crashy replica, the rest queue behind it, then the chunk raises.
-      The running request resolves ``error``, the queued ones re-route
-      to the survivor and finish with greedy parity. The crashed
-      frontend's flight recorder must dump a postmortem whose in-flight
-      set EXACTLY matches the error + rerouted handles, and the
-      availability burn rate must move;
+      crashy replica, the rest queue behind it, the wedge holds past
+      the TTFT threshold, then the chunk raises. NOTHING resolves
+      ``error``: the queued requests re-route and the wedged one
+      REPLAYS (prompt + emitted prefix) on the survivor, every stream
+      finishing with greedy parity. The crashed frontend's flight
+      recorder must dump a postmortem whose in-flight set EXACTLY
+      matches the rerouted handles (all ``salvageable``), and the TTFT
+      burn rate must move — with full replay the availability budget
+      never burns, so the crash's cost shows up as latency: ``adopt``
+      keeps the ORIGINAL submit time, putting the recovery delay inside
+      the survivor segment's TTFT;
     * phase C (recovered) — after the fast window drains, a healthy
       batch brings the fast burn rate back to 0.
 
@@ -384,10 +409,14 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
 
     slo_engine = None
     if slo:
-        # latency thresholds are parked at 30s (CPU bench timing is
-        # noise); AVAILABILITY is the signal the injected crash moves
+        # tpot is parked at 30s (CPU chunk timing is noise); TTFT at
+        # ``ttft_threshold_s`` is the signal the crash moves — the
+        # wedge holds longer than the threshold, and replayed journeys
+        # keep their original submit time, so the recovery delay lands
+        # inside TTFT while availability stays clean (zero errors)
         slo_engine = SLOEngine(
-            default_slos(ttft_threshold_s=30.0, tpot_threshold_s=30.0),
+            default_slos(ttft_threshold_s=ttft_threshold_s,
+                         tpot_threshold_s=30.0),
             windows_s=slo_windows_s)
         for rep in router.replicas:
             slo_engine.attach(rep.frontend.tracing)
@@ -410,7 +439,8 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
                     ["max_burn_rate"] if slo_engine else 0.0)
 
         # phase B: wedge one request mid-chunk on the crashy replica,
-        # queue the rest behind it, then let the chunk raise
+        # queue the rest behind it, hold past the TTFT threshold, then
+        # let the chunk raise
         crashy.dead = False
         survivor.dead = True
         entered, release = threading.Event(), threading.Event()
@@ -426,41 +456,45 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
             raise RuntimeError("injected fault never reached the chunk")
         rest = [router.submit(p, max_new_tokens=max_new_tokens)
                 for p in prompts[1:]]
+        time.sleep(wedge_hold_s)    # outage longer than the threshold
         survivor.dead = False       # revive BEFORE the crash fires
         release.set()
-        first_status = first.result(timeout=60)
-        rest_status = [h.result(timeout=120) for h in rest]
-        if first_status != "error":
+        all_handles = [first] + rest
+        statuses = [h.result(timeout=120) for h in all_handles]
+        n_errors = sum(1 for s in statuses if s == "error")
+        if any(s != "done" for s in statuses):
             raise RuntimeError(
-                f"mid-chunk request should resolve error, "
-                f"got {first_status}")
-        if any(s != "done" for s in rest_status):
-            raise RuntimeError(
-                f"queued requests should re-route to the survivor and "
-                f"finish: {rest_status}")
+                f"crash must lose nothing — the wedged request replays "
+                f"and the queued ones re-route: {statuses}")
         rerouted_parity = all(
-            np.array_equal(h.output_ids, oracle_out[1 + i])
-            for i, h in enumerate(rest))
+            np.array_equal(h.output_ids, oracle_out[i])
+            for i, h in enumerate(all_handles))
         if not rerouted_parity:
             raise RuntimeError(
                 "rerouted greedy streams diverged from ServingEngine.run")
+        if any(len(h.tokens) != max_new_tokens for h in all_handles):
+            raise RuntimeError("replayed stream dropped or duplicated "
+                               "tokens")
         burn_crash = (slo_engine.evaluate(export_gauges=False)
                       ["max_burn_rate"] if slo_engine else 0.0)
 
         # postmortem: the in-flight set must be EXACTLY the handles the
-        # caller saw resolve error (running) or re-route (queued)
+        # caller saw re-route, every one of them salvageable (v2: the
+        # record is a replay manifest, not a casualty list)
         pm_path = crashy.frontend.postmortem_path
         if not pm_path:
             raise RuntimeError("crashed frontend dumped no postmortem")
         with open(pm_path) as f:
             pm = json.load(f)
         pm_uids = {e["uid"] for e in pm["in_flight"]}
-        expect = {first.uid} | {h.uid for h in rest}
-        pm_match = pm_uids == expect
+        expect = {h.uid for h in all_handles}
+        pm_match = (pm_uids == expect and all(
+            e["disposition"] == "salvageable" for e in pm["in_flight"]))
         if not pm_match:
             raise RuntimeError(
                 f"postmortem in-flight set {sorted(pm_uids)} != "
-                f"error/rerouted handles {sorted(expect)}")
+                f"rerouted handles {sorted(expect)}, or a prefilled "
+                f"request was not marked salvageable")
 
         # phase C: drain the fast window, then healthy traffic again
         if slo_engine:
@@ -483,8 +517,9 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
         router.close(timeout=60)
 
     out["crash"] = {
-        "errors": sum(1 for s in [first_status] if s == "error"),
+        "errors": n_errors,
         "rerouted": stats["rerouted"],
+        "replayed": stats["replayed"],
         "journey_complete": 1.0,
         "rerouted_parity": float(rerouted_parity),
         "postmortem_inflight_match": float(pm_match),
@@ -499,6 +534,7 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
     }
     if slo_engine:
         rep = slo_engine.evaluate(export_gauges=False)
+        ttft = next(s for s in rep["slos"] if s["name"] == "ttft")
         avail = next(s for s in rep["slos"]
                      if s["kind"] == "availability")
         out["slo"] = {
@@ -509,20 +545,224 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
             "burn_recovered_flag": float(
                 burn_recovered < min(1.0, burn_crash)),
             "windows_s": list(slo_windows_s),
-            "availability_worst_window_s": avail["worst_window_s"],
+            "ttft_threshold_s": ttft_threshold_s,
+            "ttft_worst_window_s": ttft["worst_window_s"],
+            # with full replay the availability budget must NOT burn —
+            # the whole crash cost moved into latency
+            "availability_burn": avail["worst_burn_rate"],
             "budget_remaining": min(
                 w["budget_remaining"]
                 for s in rep["slos"] for w in s["windows"].values()),
         }
         if burn_crash <= burn_pre:
             raise RuntimeError(
-                f"availability burn rate did not move during the crash "
+                f"ttft burn rate did not move during the crash "
                 f"window: pre={burn_pre} crash={burn_crash}")
         if burn_recovered > 0.0:
             raise RuntimeError(
                 f"fast burn rate did not recover after the crash "
                 f"window drained: {burn_recovered}")
+        if avail["worst_burn_rate"] > 0.0:
+            raise RuntimeError(
+                f"availability burned during a zero-loss crash: "
+                f"{avail['worst_burn_rate']}")
     return out
+
+
+def _elastic_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
+                  slo_windows_s=(2.0, 20.0), ttft_threshold_s=2.0,
+                  wedge_hold_s=3.0, recovery_p99_bound_s=30.0) -> dict:
+    """Elastic fleet under failure: kill a replica mid-stream at 2x
+    load, then watch the :class:`ElasticController` put the fleet back.
+
+    One scripted incident over a 2-replica fleet with a checkpoint-
+    backed replica factory (fresh engines share the committed params
+    and are warmed before joining):
+
+    * 2x the pinned workload is aimed at one replica (the other is
+      briefly unroutable — a deterministic lane), the first request
+      wedges mid-decode-chunk, the outage holds past the TTFT
+      threshold, then the chunk raises;
+    * ZERO requests are lost: the 2N streams re-route — the prefilled
+      one REPLAYS — and every one finishes greedy bit-identical with
+      no duplicate or dropped tokens;
+    * the controller restores the below-target fleet immediately (no
+      cooldown) via the factory, with the newcomer's EWMA warm-started
+      from the survivor;
+    * a manual surge replica is then retired gracefully once the burn
+      calms: ``draining`` excludes it from placement, ``poll_draining``
+      closes it idle, and the fleet ends at exactly ``target`` size;
+    * the TTFT burn rate moves during the incident (replayed journeys
+      keep their ORIGINAL submit time) and the fast window is clean
+      after recovery; recovery-window TTFT p99 stays bounded.
+    """
+    import threading
+
+    from ..serving import FleetRouter, ServingEngine
+    from ..serving.fleet import ElasticConfig, ElasticController
+    from ..telemetry.slo import default_slos
+
+    def factory():
+        eng = ServingEngine(engine=inf, **eng_kw)
+        # checkpoint-backed warm start: committed params, compiles
+        # charged on the pinned workload before the replica takes
+        # traffic (a cold compile inside the recovery window would
+        # read as burn)
+        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+        return eng
+
+    load_prompts = list(prompts) + list(prompts)        # 2x load
+    load_out = list(oracle_out) + list(oracle_out)
+    engines = [ServingEngine(engine=inf, **eng_kw) for _ in range(2)]
+    for eng in engines:
+        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+    router = FleetRouter(engines, replica_factory=factory)
+    ctrl = ElasticController(
+        router,
+        ElasticConfig(min_replicas=1, max_replicas=4, cooldown_s=0.5),
+        slos=default_slos(ttft_threshold_s=ttft_threshold_s,
+                          tpot_threshold_s=30.0),
+        windows_s=slo_windows_s)
+    crashy, survivor = router.replicas[0], router.replicas[1]
+
+    def max_fast_burn():
+        burns = ctrl.burn_rates()
+        return max(burns.values(), default=0.0)
+
+    try:
+        rec0 = ctrl.step()                  # sensors + inferred target
+        if ctrl.target != 2 or rec0["action"] != "none":
+            raise RuntimeError(f"controller mis-read the fleet: {rec0}")
+
+        # healthy 1x traffic, burn baseline
+        for h in [router.submit(p, max_new_tokens=max_new_tokens)
+                  for p in prompts]:
+            if h.result(timeout=120) != "done":
+                raise RuntimeError("healthy elastic batch failed")
+        burn_pre = max_fast_burn()
+
+        # the incident: 2x load onto the crashy replica, wedge, hold,
+        # crash
+        survivor.dead = True                # deterministic lane
+        entered, release = threading.Event(), threading.Event()
+
+        def boom(*a, **k):
+            entered.set()
+            release.wait(30)
+            raise RuntimeError("injected decode fault")
+
+        engines[0]._jit_decode_chunk = boom
+        first = router.submit(load_prompts[0],
+                              max_new_tokens=max_new_tokens)
+        if not entered.wait(30):
+            raise RuntimeError("injected fault never reached the chunk")
+        rest = [router.submit(p, max_new_tokens=max_new_tokens)
+                for p in load_prompts[1:]]
+        time.sleep(wedge_hold_s)
+        survivor.dead = False               # revive BEFORE the crash
+        release.set()
+        all_handles = [first] + rest
+        statuses = [h.result(timeout=180) for h in all_handles]
+        n_errors = sum(1 for s in statuses if s == "error")
+        n_lost = sum(1 for s in statuses if s != "done")
+        if n_lost:
+            raise RuntimeError(
+                f"elastic crash lost {n_lost} requests: {statuses}")
+        replay_parity = all(
+            np.array_equal(h.output_ids, load_out[i])
+            for i, h in enumerate(all_handles))
+        if not replay_parity:
+            raise RuntimeError(
+                "replayed/rerouted streams diverged from the oracle")
+        n_dup = sum(1 for h in all_handles
+                    if len(h.tokens) != max_new_tokens)
+        if n_dup:
+            raise RuntimeError(
+                f"{n_dup} streams dropped or duplicated tokens")
+
+        # recovery TTFT (original submit time -> survivor first token)
+        crash_uids = {h.uid for h in all_handles}
+        recs = survivor.frontend.tracing.to_json()["requests"]
+        ttfts = [t["ttft_s"] for t in recs
+                 if t["uid"] in crash_uids and t["status"] == "done"
+                 and t["ttft_s"] is not None]
+        if len(ttfts) != len(all_handles):
+            raise RuntimeError(
+                f"survivor adopted {len(ttfts)} of "
+                f"{len(all_handles)} crashed streams")
+        recovery_p99 = float(np.percentile(ttfts, 99))
+        if recovery_p99 > recovery_p99_bound_s:
+            raise RuntimeError(
+                f"recovery TTFT p99 {recovery_p99:.2f}s above the "
+                f"{recovery_p99_bound_s}s bound")
+        burn_crash = max_fast_burn()
+        if burn_crash <= burn_pre:
+            raise RuntimeError(
+                f"ttft burn did not move during the incident: "
+                f"pre={burn_pre} crash={burn_crash}")
+
+        # autoscale: restore the below-target fleet (no cooldown wait)
+        rec1 = ctrl.step()
+        if rec1["action"] != "scale_up" or rec1["reason"] != "below_target":
+            raise RuntimeError(
+                f"controller did not restore the crashed fleet: {rec1}")
+        restored = router.replicas[-1]
+        seeded = restored.frontend._estimator.snapshot()
+        if seeded["tokens_per_s"] is None or seeded["n_samples"] != 0:
+            raise RuntimeError(
+                f"restored replica's EWMA was not warm-started from a "
+                f"peer: {seeded}")
+
+        # surge + graceful scale-down back to target once burn calms
+        router.add_replica()
+        time.sleep(slo_windows_s[0] + 0.5)  # drain the fast window
+        deadline = time.monotonic() + 30.0
+        while (router.n_drained < 1 or router.n_routable != ctrl.target) \
+                and time.monotonic() < deadline:
+            ctrl.step()
+            time.sleep(0.1)
+        if router.n_drained < 1 or router.n_routable != ctrl.target:
+            raise RuntimeError(
+                f"fleet did not return to target: "
+                f"routable={router.n_routable} target={ctrl.target} "
+                f"drained={router.n_drained}")
+
+        # recovered: healthy traffic on the final fleet, clean fast burn
+        for h in [router.submit(p, max_new_tokens=max_new_tokens)
+                  for p in prompts]:
+            if h.result(timeout=120) != "done":
+                raise RuntimeError("post-recovery batch failed")
+        burn_recovered = max_fast_burn()
+        if burn_recovered > 0.0:
+            raise RuntimeError(
+                f"fast burn did not recover: {burn_recovered}")
+        stats = router.stats()
+    finally:
+        ctrl.stop()
+        router.close(timeout=60)
+
+    return {"elastic": {
+        "n_requests": len(load_prompts),
+        "load_factor": 2,
+        "errors": n_errors,
+        "lost": n_lost,
+        "rerouted": stats["rerouted"],
+        "replayed": stats["replayed"],
+        "replay_parity": float(replay_parity),
+        "duplicate_tokens": n_dup,
+        "scale_up": stats["scale_up"],
+        "scale_down": stats["scale_down"],
+        "drained": stats["drained"],
+        "target": ctrl.target,
+        "final_routable": stats["routable"],
+        "returned_to_target": float(stats["routable"] == ctrl.target),
+        "recovery_ttft_p99_s": recovery_p99,
+        "burn_pre": burn_pre,
+        "burn_crash": burn_crash,
+        "burn_recovered": burn_recovered,
+        "burn_moved": float(burn_crash > burn_pre),
+        "burn_recovered_flag": float(burn_recovered == 0.0),
+    }}
 
 
 def _ensure_virtual_devices(n: int = 8) -> None:

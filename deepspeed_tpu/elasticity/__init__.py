@@ -1,12 +1,24 @@
-"""Batch-size elasticity (reference: deepspeed/elasticity/elasticity.py).
+"""Elasticity (reference: deepspeed/elasticity/elasticity.py).
 
-Picks a total train batch size whose factor structure admits MANY valid
-device counts, so a resource scheduler can grow/shrink the job across
-restarts without changing convergence (batch size and thus the effective
-data distribution stay fixed; only micro-batch x GAS x world factorization
-changes). Not fault tolerance — that's checkpoint/resume.
+Two generations under one heritage surface:
+
+* **Batch-size elasticity** (training): pick a total train batch size
+  whose factor structure admits MANY valid device counts, so a resource
+  scheduler can grow/shrink the job across restarts without changing
+  convergence (batch size and thus the effective data distribution stay
+  fixed; only micro-batch x GAS x world factorization changes). Not
+  fault tolerance — that's checkpoint/resume.
+* **Serving elasticity** (the jax_graft successor): the fleet's replica
+  count becomes a controlled variable.
+  :class:`~deepspeed_tpu.serving.fleet.elastic.ElasticController`
+  (re-exported here) drives ``FleetRouter.add_replica`` /
+  ``retire_replica`` from per-replica SLO burn rates and drain-time
+  estimates, with graceful drain and in-flight replay of prefilled
+  requests on crash. See docs/serving.md "Elastic fleet".
 """
 
+from ..serving.fleet.elastic import (ElasticConfig,  # noqa: F401
+                                     ElasticController)
 from .elasticity import (ElasticityConfig, ElasticityConfigError,
                          ElasticityError, ElasticityIncompatibleWorldSize,
                          compute_elastic_config, elasticity_enabled,
@@ -16,4 +28,5 @@ from .elasticity import (ElasticityConfig, ElasticityConfigError,
 __all__ = ["compute_elastic_config", "elasticity_enabled",
            "ensure_immutable_elastic_config", "ElasticityConfig",
            "ElasticityError", "ElasticityConfigError",
-           "ElasticityIncompatibleWorldSize", "highly_composite_numbers"]
+           "ElasticityIncompatibleWorldSize", "highly_composite_numbers",
+           "ElasticController", "ElasticConfig"]

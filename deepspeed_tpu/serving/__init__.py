@@ -15,4 +15,5 @@ from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
 from .metrics import (Reservoir, ServingMetrics,  # noqa: F401
                       csv_monitor_master)
 from .engine import ServingEngine  # noqa: F401
-from .fleet import FleetReplica, FleetRouter  # noqa: F401
+from .fleet import (ElasticConfig, ElasticController,  # noqa: F401
+                    FleetReplica, FleetRouter)

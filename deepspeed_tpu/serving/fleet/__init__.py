@@ -4,9 +4,12 @@ One ``ServingEngine`` is one replica; a deployment runs N of them
 (optionally tensor-parallel via the engine's ``tp=`` knob, optionally
 prefill/decode-disaggregated via ``disaggregate_prefill=True``) behind
 one :class:`FleetRouter` — least-loaded placement, prefix-affinity
-routing, and dead-replica drain. See docs/serving.md.
+routing, dead-replica drain with in-flight replay, and SLO-driven
+elastic sizing via :class:`ElasticController`. See docs/serving.md.
 """
 
+from .elastic import ElasticConfig, ElasticController  # noqa: F401
 from .router import FleetReplica, FleetRouter  # noqa: F401
 
-__all__ = ["FleetRouter", "FleetReplica"]
+__all__ = ["FleetRouter", "FleetReplica",
+           "ElasticController", "ElasticConfig"]

@@ -1,0 +1,237 @@
+"""ElasticController: SLO-driven autoscaling over a ``FleetRouter``.
+
+DeepSpeed's ``elasticity/`` layer resized training jobs so a scheduler
+could grow/shrink world size without touching convergence. This is its
+serving-side successor: the fleet's replica count becomes a controlled
+variable, driven by the sensors the serving tier already publishes —
+per-replica SLO fast/slow burn rates (:mod:`...telemetry.slo`) and
+``load_snapshot()`` drain-time estimates — instead of being fixed at
+``FleetRouter`` construction.
+
+The control loop, each tick (``step()``; ``start()`` runs it on a
+daemon thread):
+
+1. **Sense** — lazily attach one :class:`SLOEngine` per replica to its
+   frontend's ``TraceLog`` (new replicas get a sensor the tick after
+   they join), read every routable replica's fast-burn rate and
+   estimated drain time, and finalize any retirement whose replica has
+   gone idle (``FleetRouter.poll_draining``).
+2. **Restore** — a crash (or an over-eager drain) that leaves fewer
+   routable replicas than ``target_replicas`` is repaired immediately,
+   no cooldown: ``add_replica()`` builds a fresh engine from the
+   router's ``replica_factory`` (checkpoint-backed — committed params,
+   nothing to transfer) and warm-starts its EWMA from a peer.
+3. **Scale up** — fast burn at/above ``scale_up_fast_burn`` (the
+   page-worthy threshold), or every replica's drain-time estimate above
+   ``scale_up_drain_s``, grows the fleet by one (bounded by
+   ``max_replicas``, rate-limited by ``cooldown_s``).
+4. **Scale down** — fast burn at/below ``scale_down_fast_burn`` with
+   more routable replicas than the target retires the least-loaded one
+   *gracefully*: placement stops instantly, the admission tail is
+   adopted by survivors, in-engine chunks retire naturally, and the
+   retirement completes via ``poll_draining`` on a later tick.
+
+``fleet/target_size`` is exported as a gauge every tick; scale actions
+land on the ``fleet/scale_up|scale_down|drained`` counters the router
+owns. Host-side only — never imports JAX.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from ...telemetry import core as telemetry
+from ...telemetry.slo import SLOEngine, SLOSpec
+from ...utils.logging import logger
+
+
+@dataclass
+class ElasticConfig:
+    """Autoscaler policy knobs.
+
+    ``target_replicas`` is the steady-state fleet size (None = the
+    router's routable count when the controller first steps). Burn
+    thresholds are in SLO burn-rate units: 1.0 = exactly on error
+    budget; the stock page-worthy fast burn is ~2. ``scale_up_drain_s``
+    optionally adds a load-based growth trigger: grow when even the
+    least-loaded replica would take this long to drain its backlog.
+    ``cooldown_s`` rate-limits burn/load-driven actions; restoring a
+    below-target fleet (crash repair) never waits."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_replicas: Optional[int] = None
+    scale_up_fast_burn: float = 2.0
+    scale_down_fast_burn: float = 0.5
+    scale_up_drain_s: Optional[float] = None
+    cooldown_s: float = 5.0
+    poll_every_s: float = 0.25
+
+
+class ElasticController:
+    """Drive ``FleetRouter.add_replica``/``retire_replica`` from SLO
+    burn + drain-time sensors.
+
+    ``slos``/``windows_s`` configure the per-replica :class:`SLOEngine`
+    sensors (defaults: the stock serving SLOs over 60 s/300 s windows;
+    benches pass tighter windows so burn moves within a run). ``step()``
+    is the whole control loop for one tick — tests and benches call it
+    directly; ``start()``/``stop()`` wrap it in a daemon thread for
+    real deployments."""
+
+    def __init__(self, router: Any,
+                 config: Optional[ElasticConfig] = None, *,
+                 slos: Optional[Iterable[SLOSpec]] = None,
+                 windows_s: Iterable[float] = (60.0, 300.0),
+                 clock: Callable[[], float] = time.monotonic):
+        self.router = router
+        self.config = config or ElasticConfig()
+        self._slos = list(slos) if slos is not None else None
+        self._windows_s = tuple(windows_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._sensors: Dict[int, SLOEngine] = {}
+        self.target: Optional[int] = self.config.target_replicas
+        self._last_action_t: Optional[float] = None
+        self.n_steps = 0
+        self.actions: List[Dict[str, Any]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ----------------------------------------------------------- sensors
+    def _ensure_sensors(self) -> None:
+        """One SLOEngine per replica, attached to its frontend's
+        TraceLog; replicas added after construction get theirs on the
+        next tick."""
+        for rep in list(self.router.replicas):
+            if rep.rid not in self._sensors:
+                eng = SLOEngine(self._slos, windows_s=self._windows_s,
+                                clock=self._clock)
+                eng.attach(rep.frontend.tracing)
+                self._sensors[rep.rid] = eng
+
+    def burn_rates(self) -> Dict[int, float]:
+        """Fast-burn rate per ALIVE replica (draining included — their
+        in-flight tail still burns budget)."""
+        out: Dict[int, float] = {}
+        for rep in list(self.router.replicas):
+            if rep.alive and rep.rid in self._sensors:
+                out[rep.rid] = self._sensors[rep.rid].fast_burn_rate()
+        return out
+
+    def drain_times(self) -> Dict[int, float]:
+        """Estimated seconds for each ROUTABLE replica to drain its
+        outstanding work (the router's load score)."""
+        return {rep.rid: float(self.router._load_score(rep))
+                for rep in list(self.router.replicas) if rep.routable}
+
+    def sensor(self, rid: int) -> Optional[SLOEngine]:
+        return self._sensors.get(rid)
+
+    # ------------------------------------------------------ control loop
+    def step(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One control tick: sense, finalize drains, and take at most
+        one scale action. Returns the decision record."""
+        cfg = self.config
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            self._ensure_sensors()
+            if self.target is None:
+                self.target = max(cfg.min_replicas,
+                                  self.router.n_routable)
+            retired = self.router.poll_draining()
+            burns = self.burn_rates()
+            drains = self.drain_times()
+            routable = self.router.n_routable
+            fast_burn = max(burns.values(), default=0.0)
+            min_drain = min(drains.values(), default=0.0)
+            telemetry.gauge("fleet/target_size", float(self.target))
+            in_cooldown = (self._last_action_t is not None
+                           and now - self._last_action_t < cfg.cooldown_s)
+            action, reason = "none", None
+            if routable < self.target:
+                # crash repair / drain overshoot: restore immediately
+                action, reason = self._try_add("below_target")
+            elif (not in_cooldown and routable < cfg.max_replicas
+                  and (fast_burn >= cfg.scale_up_fast_burn
+                       or (cfg.scale_up_drain_s is not None and drains
+                           and min_drain > cfg.scale_up_drain_s))):
+                action, reason = self._try_add(
+                    "fast_burn" if fast_burn >= cfg.scale_up_fast_burn
+                    else "drain_time")
+            elif (not in_cooldown and routable > self.target
+                  and routable > cfg.min_replicas
+                  and fast_burn <= cfg.scale_down_fast_burn):
+                rep = self.router.retire_replica(
+                    min_routable=max(cfg.min_replicas, self.target))
+                if rep is not None:
+                    action, reason = "scale_down", "above_target_calm"
+            if action != "none":
+                self._last_action_t = now
+            self.n_steps += 1
+            record = {"t": now, "action": action, "reason": reason,
+                      "routable": self.router.n_routable,
+                      "target": self.target, "fast_burn": fast_burn,
+                      "burns": burns, "drain_s": drains,
+                      "retired": retired}
+            if action != "none":
+                self.actions.append(record)
+                logger.info(f"elastic controller: {action} ({reason}) "
+                            f"routable={record['routable']} "
+                            f"target={self.target} "
+                            f"fast_burn={fast_burn:.2f}")
+            return record
+
+    def _try_add(self, reason: str):
+        """Grow by one replica via the router's factory; a fleet built
+        without one simply can't grow (the decision records why)."""
+        if self.router.replica_factory is None:
+            return "none", "no_replica_factory"
+        if len([r for r in self.router.replicas if r.routable]) \
+                >= self.config.max_replicas:
+            return "none", "at_max_replicas"
+        self.router.add_replica()
+        return "scale_up", reason
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "ElasticController":
+        """Run ``step()`` every ``poll_every_s`` on a daemon thread."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="elastic-controller", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                logger.error(f"elastic controller step failed: {e}")
+            self._stop.wait(self.config.poll_every_s)
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "ElasticController":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------ queries
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "target": self.target,
+                "n_steps": self.n_steps,
+                "n_actions": len(self.actions),
+                "actions": [dict(a) for a in self.actions],
+                "sensors": sorted(self._sensors),
+            }

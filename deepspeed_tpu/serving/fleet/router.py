@@ -29,21 +29,35 @@ Placement, in order:
    ``load_snapshot()`` (admission-pending + engine backlog tokens)
    over the replica's EWMA decode throughput.
 
-**Dead-replica drain**: each frontend gets the router as its
-``on_crash`` hook. When a driver crashes, work that never touched the
-device (admission-pending tickets, engine-queued requests) is re-homed
-on surviving replicas via ``ServingFrontend.adopt`` — the SAME handle
-objects keep streaming to their callers — while prefilled/running
-requests still resolve ``error`` (their KV state died with the
-replica). The crashed replica is marked dead and drops out of
-placement.
+**Dead-replica drain + in-flight replay**: each frontend gets the
+router as its ``on_crash`` hook. When a driver crashes, EVERY live
+handle is re-homed on surviving replicas via
+``ServingFrontend.adopt`` — the SAME handle objects keep streaming to
+their callers. Work that never touched the device restarts from
+scratch; requests that already prefilled/streamed are REPLAYED (the
+survivor re-prefills prompt + emitted tokens — a paged ``PrefixCache``
+hit when a twin stream replayed first — and emitted-token dedup keeps
+the stream seamless). The crashed replica is marked dead and drops out
+of placement.
+
+**Elastic fleet**: the replica set is no longer fixed at construction.
+``add_replica()`` grows the fleet (from a ``replica_factory`` —
+checkpoint-backed engines share committed params — with the EWMA
+warm-started from a peer), ``retire_replica()`` shrinks it gracefully:
+the replica enters a ``draining`` placement state (excluded from
+routing, still ``alive``), its admission tail is adopted by survivors,
+in-engine chunks retire naturally, and ``poll_draining()`` finalizes
+the retirement once idle. :class:`~.elastic.ElasticController` turns
+this crank from SLO burn rates and drain-time estimates.
 
 Telemetry: every replica's driver thread is labeled (``replica=<id>``
 via ``telemetry.replica_label``) so per-replica gauges/counters stay
 distinguishable in one process-wide runtime; the router's own counters
 (``fleet/routed``, ``fleet/affinity_hits``, ``fleet/rerouted``,
-``fleet/reroute_failed``, ``fleet/replica_crashes``) are recorded
-unlabeled — they are fleet-level, not per-replica.
+``fleet/replayed``, ``fleet/reroute_failed``,
+``fleet/replica_crashes``, ``fleet/scale_up``, ``fleet/scale_down``,
+``fleet/drained``) are recorded unlabeled — they are fleet-level, not
+per-replica.
 
 Host-side only — this module never imports JAX.
 """
@@ -69,15 +83,29 @@ from ..paged_kv import PrefixCache
 @dataclasses.dataclass
 class FleetReplica:
     """One replica's slot in the fleet: engine + owning frontend +
-    router-side health mark."""
+    router-side health/lifecycle marks.
+
+    ``draining`` is the graceful-retirement state: the replica is still
+    ``alive`` (its driver keeps pumping so in-engine chunks retire
+    naturally) but no longer ``routable`` — placement skips it. Once
+    idle, ``FleetRouter.poll_draining`` closes the frontend and flips
+    ``retired``."""
     rid: int
     engine: Any
     frontend: ServingFrontend
     dead: bool = False
+    draining: bool = False
+    retired: bool = False
 
     @property
     def alive(self) -> bool:
-        return not self.dead and self.frontend.driver_alive
+        return (not self.dead and not self.retired
+                and self.frontend.driver_alive)
+
+    @property
+    def routable(self) -> bool:
+        """Eligible for NEW placements: alive and not draining."""
+        return self.alive and not self.draining
 
 
 class FleetRouter:
@@ -96,38 +124,61 @@ class FleetRouter:
                  affinity: bool = True,
                  feed_depth: Optional[int] = None,
                  idle_wait_s: float = 0.005,
+                 replica_factory=None,
                  clock=time.monotonic):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self._clock = clock
         self.affinity = bool(affinity)
         self._lock = threading.Lock()
+        # per-replica frontend construction knobs, kept so add_replica()
+        # builds elastically grown replicas exactly like the originals
+        self._admission = admission
+        self._feed_depth = feed_depth
+        self._idle_wait_s = idle_wait_s
+        # ``replica_factory()`` -> a fresh engine with committed params
+        # (checkpoint-backed warm start): the elastic controller's
+        # growth path when ``add_replica`` isn't handed an engine
+        self.replica_factory = replica_factory
         self.n_routed = 0
         self.n_affinity_hits = 0
         self.n_rerouted = 0
+        self.n_replayed = 0
         self.n_reroute_failed = 0
         self.n_replica_crashes = 0
+        self.n_scale_up = 0
+        self.n_scale_down = 0
+        self.n_drained = 0
         # journey journal: placement / reroute / crash records under one
         # trace id per request — the input to ``export_chrome``'s
-        # journey lanes and the roadmap's future replay loop (bounded:
+        # journey lanes and the in-flight replay loop (bounded:
         # a long-running router never grows without bound)
         self._placements: deque = deque(maxlen=4096)
         self._reroutes: deque = deque(maxlen=1024)
         self._crashes: deque = deque(maxlen=256)
         self.replicas: List[FleetReplica] = []
         self._by_frontend: Dict[int, FleetReplica] = {}
-        for rid, eng in enumerate(engines):
-            cfg = dataclasses.replace(admission) if admission is not None \
-                else None
-            fe = ServingFrontend(eng, admission=cfg,
-                                 feed_depth=feed_depth,
-                                 idle_wait_s=idle_wait_s,
-                                 on_crash=self._on_replica_crash,
-                                 telemetry_label=str(rid),
-                                 clock=clock)
-            rep = FleetReplica(rid=rid, engine=eng, frontend=fe)
-            self.replicas.append(rep)
-            self._by_frontend[id(fe)] = rep
+        self._next_rid = 0
+        for eng in engines:
+            self._spawn_replica(eng)
+
+    def _spawn_replica(self, engine: Any) -> FleetReplica:
+        """Wrap one engine in a frontend + FleetReplica and register it
+        (construction path and ``add_replica`` share it)."""
+        rid = self._next_rid
+        self._next_rid += 1
+        cfg = dataclasses.replace(self._admission) \
+            if self._admission is not None else None
+        fe = ServingFrontend(engine, admission=cfg,
+                             feed_depth=self._feed_depth,
+                             idle_wait_s=self._idle_wait_s,
+                             on_crash=self._on_replica_crash,
+                             telemetry_label=str(rid),
+                             clock=self._clock)
+        rep = FleetReplica(rid=rid, engine=engine, frontend=fe)
+        self.replicas.append(rep)
+        self._by_frontend[id(fe)] = rep
+        return rep
 
     # ------------------------------------------------------- public API
     def submit(self, prompt: Union[Sequence[int], np.ndarray], *,
@@ -191,11 +242,14 @@ class FleetRouter:
         journal attaches to the request's ``route`` span."""
         decision: Dict[str, Any] = {"affinity_hit": False, "scores": {},
                                     "candidates": []}
-        candidates = [r for r in self.replicas if r.alive]
+        candidates = [r for r in self.replicas if r.routable]
         if not candidates:
-            # every replica is dead: any frontend will reject-with-reason
-            # (frontend_closed) — deliberate, so callers get a terminal
-            # handle instead of an exception
+            # no routable replica: fall back to any alive-but-draining
+            # one (serving late beats rejecting), else any frontend will
+            # reject-with-reason (frontend_closed) — deliberate, so
+            # callers get a terminal handle instead of an exception
+            candidates = [r for r in self.replicas if r.alive]
+        if not candidates:
             return self.replicas[0], decision
         if self.affinity and len(candidates) > 1:
             key = PrefixCache.key_for(prompt)
@@ -234,6 +288,97 @@ class FleetRouter:
         rate = snap["throughput"]["tokens_per_s"]
         return outstanding / rate if rate else outstanding
 
+    # --------------------------------------------------------- elasticity
+    def add_replica(self, engine: Any = None, *,
+                    warm_start: bool = True) -> FleetReplica:
+        """Grow the fleet by one replica. ``engine`` defaults to a fresh
+        one from ``replica_factory`` (checkpoint-backed: the factory
+        builds it from the same committed params the fleet serves, so
+        it joins ready — no weight transfer on the serving path). With
+        ``warm_start`` the new replica's throughput EWMA is seeded from
+        the fastest measured peer's ``load_snapshot()``, so the
+        autoscaler's drain-time scores don't flap while the newcomer is
+        still unmeasured."""
+        if engine is None:
+            if self.replica_factory is None:
+                raise ValueError(
+                    "add_replica() needs an engine or a replica_factory")
+            engine = self.replica_factory()
+        donor_rate: Optional[float] = None
+        if warm_start:
+            rates = [r.frontend.load_snapshot()["throughput"]
+                     ["tokens_per_s"] for r in self.replicas if r.alive]
+            rates = [float(x) for x in rates if x]
+            if rates:
+                donor_rate = max(rates)
+        rep = self._spawn_replica(engine)
+        if donor_rate is not None:
+            rep.frontend._estimator.seed(donor_rate)
+        with self._lock:
+            self.n_scale_up += 1
+        telemetry.count("fleet/scale_up")
+        telemetry.gauge("fleet/size", float(self.n_routable))
+        logger.info(f"fleet scale-up: replica {rep.rid} joined "
+                    f"(ewma seed={donor_rate})")
+        return rep
+
+    def retire_replica(self, rid: Optional[int] = None, *,
+                       min_routable: int = 1) -> Optional[FleetReplica]:
+        """Shrink the fleet by one replica, gracefully: mark it
+        ``draining`` (placement stops immediately; the driver keeps
+        running so in-engine chunks retire naturally) and adopt its
+        admission-pending tail onto survivors. Picks the
+        least-loaded routable replica when ``rid`` is None. Refuses —
+        returning None — when retirement would leave fewer than
+        ``min_routable`` routable replicas. ``poll_draining()``
+        finalizes the retirement once the replica is idle."""
+        with self._lock:
+            routable = [r for r in self.replicas if r.routable]
+            if len(routable) <= min_routable:
+                return None
+            if rid is None:
+                rep = min(routable, key=self._load_score)
+            else:
+                rep = next((r for r in routable if r.rid == rid), None)
+                if rep is None:
+                    return None
+            rep.draining = True
+            rep.frontend.draining = True   # /readyz mirrors the drain
+            self.n_scale_down += 1
+        telemetry.count("fleet/scale_down")
+        telemetry.gauge("fleet/size", float(self.n_routable))
+        # re-home the admission tail NOW — those requests never reached
+        # the engine, so survivors can start them without replay
+        tail = rep.frontend.drain_pending()
+        logger.info(f"fleet scale-down: replica {rep.rid} draining "
+                    f"({len(tail)} pending re-homed)")
+        for handle in tail:
+            self._reroute(handle, None, src_rid=rep.rid)
+        return rep
+
+    def poll_draining(self) -> List[int]:
+        """Finalize retirements: close every draining replica that has
+        gone idle (no pending admission, nothing queued or running in
+        its engine) and mark it ``retired``. Returns the rids retired
+        by this call. The elastic controller calls this each tick;
+        tests/benches may call it directly."""
+        retired: List[int] = []
+        for rep in self.replicas:
+            if not rep.draining or rep.retired or rep.dead:
+                continue
+            snap = rep.frontend.load_snapshot()
+            if (snap["admission"]["pending"] == 0
+                    and snap["engine_queue_depth"] == 0
+                    and snap["engine_running"] == 0):
+                rep.frontend.close(timeout=30.0)
+                rep.retired = True
+                with self._lock:
+                    self.n_drained += 1
+                telemetry.count("fleet/drained")
+                logger.info(f"fleet replica {rep.rid} drained + retired")
+                retired.append(rep.rid)
+        return retired
+
     # ------------------------------------------------------- crash drain
     def _on_replica_crash(self, frontend: ServingFrontend,
                           salvaged: List[StreamHandle],
@@ -241,7 +386,8 @@ class FleetRouter:
         """``ServingFrontend`` crash hook (runs on the dead driver
         thread): mark the replica dead, record the crash (with the
         flight recorder's postmortem path), then re-home every salvaged
-        — never-prefilled, still-unresolved — handle on a survivor."""
+        still-unresolved handle on a survivor — never-prefilled work
+        restarts from scratch, prefilled work replays."""
         with self._lock:
             rep = self._by_frontend.get(id(frontend))
             if rep is not None and not rep.dead:
@@ -269,38 +415,57 @@ class FleetRouter:
                 self._reroute(handle, exc, src_rid=rid,
                               postmortem=postmortem)
 
-    def _reroute(self, handle: StreamHandle, exc: BaseException,
+    def _reroute(self, handle: StreamHandle,
+                 exc: Optional[BaseException] = None,
                  src_rid: Any = None,
                  postmortem: Optional[str] = None) -> None:
+        """Re-home one handle on a survivor (crash drain AND graceful
+        drain share this). A handle that already streamed tokens counts
+        as a REPLAY — the survivor's ``adopt`` re-prefills prompt +
+        emitted prefix and resumes the stream."""
+        n_emitted = len(handle.tokens)
         target = self._place(handle._request.prompt)
         if target.alive and target.frontend.adopt(
                 handle,
                 rerouted_from=str(src_rid) if src_rid is not None
                 else None):
             telemetry.count("fleet/rerouted")
+            if n_emitted:
+                telemetry.count("fleet/replayed")
             telemetry.instant("fleet/reroute", trace_id=handle.trace_id,
                               rerouted_from=src_rid,
-                              rerouted_to=target.rid)
+                              rerouted_to=target.rid,
+                              replayed_tokens=n_emitted)
             with self._lock:
                 self.n_rerouted += 1
+                if n_emitted:
+                    self.n_replayed += 1
                 self._reroutes.append({
                     "trace_id": handle.trace_id, "uid": handle.uid,
                     "t": self._clock(), "from_replica": src_rid,
-                    "to_replica": target.rid, "postmortem": postmortem})
+                    "to_replica": target.rid,
+                    "replayed_tokens": n_emitted,
+                    "postmortem": postmortem})
             return
         with self._lock:
             self.n_reroute_failed += 1
         telemetry.count("fleet/reroute_failed")
         if not handle.done:   # adopt() resolves on its own rejections
+            why = (f"replica crashed ({type(exc).__name__}: {exc})"
+                   if exc is not None else
+                   f"replica {src_rid} drained")
             handle._resolve(
                 "error",
-                error=f"replica crashed ({type(exc).__name__}: {exc}) "
-                      f"and no survivor accepted the request")
+                error=f"{why} and no survivor accepted the request")
 
     # ----------------------------------------------------------- queries
     @property
     def n_alive(self) -> int:
         return sum(1 for r in self.replicas if r.alive)
+
+    @property
+    def n_routable(self) -> int:
+        return sum(1 for r in self.replicas if r.routable)
 
     def stats(self) -> Dict[str, Any]:
         """Fleet-level counters plus every replica's own stats."""
@@ -308,11 +473,19 @@ class FleetRouter:
             out: Dict[str, Any] = {
                 "replicas": len(self.replicas),
                 "alive": self.n_alive,
+                "routable": self.n_routable,
+                "draining": sum(1 for r in self.replicas if r.draining
+                                and not r.retired),
+                "retired": sum(1 for r in self.replicas if r.retired),
                 "routed": self.n_routed,
                 "affinity_hits": self.n_affinity_hits,
                 "rerouted": self.n_rerouted,
+                "replayed": self.n_replayed,
                 "reroute_failed": self.n_reroute_failed,
                 "replica_crashes": self.n_replica_crashes,
+                "scale_up": self.n_scale_up,
+                "scale_down": self.n_scale_down,
+                "drained": self.n_drained,
                 "crashes": [dict(c) for c in self._crashes],
             }
         out["per_replica"] = {

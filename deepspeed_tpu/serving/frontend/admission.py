@@ -111,6 +111,22 @@ class ChunkThroughputEstimator:
         with self._lock:
             return self._rate
 
+    def seed(self, tokens_per_s: Optional[float]) -> bool:
+        """Warm-start the EWMA from a peer's measurement (elastic
+        scale-up: a fresh replica joins with the donor's rate instead of
+        an unmeasured cold start, so drain-time scores don't flap).
+        Only applies while unmeasured — real local samples always win.
+        Returns True when the seed took."""
+        if tokens_per_s is None or tokens_per_s <= 0:
+            return False
+        with self._lock:
+            if self._rate is not None:
+                return False
+            self._rate = float(tokens_per_s)
+            # n_samples stays 0: the snapshot still tells a router this
+            # rate is inherited, not locally observed
+            return True
+
     def snapshot(self) -> Dict[str, Any]:
         """One consistent read of the placement signal: EWMA tokens/s
         (None before the first chunk) and how many samples back it."""
@@ -320,9 +336,17 @@ class AdmissionController:
                     for tenant, b in self._buckets.items()},
             }
 
+    def tickets(self) -> List[Ticket]:
+        """Locked copy of the live pending tickets (no pops, no
+        tombstones): the frontend's ``request_snapshot`` accessor uses
+        it to find handles that haven't reached the engine yet."""
+        with self._lock:
+            return [t for _, _, t in self._heap if not t.cancelled]
+
     def drain(self) -> List[Ticket]:
         """Remove and return every live pending ticket (crash/teardown:
-        the frontend resolves their handles with a terminal status)."""
+        the frontend resolves their handles with a terminal status, or a
+        router re-homes them — graceful drain / crash re-route)."""
         with self._lock:
             out = [t for _, _, t in self._heap if not t.cancelled]
             self._heap = []

@@ -19,8 +19,10 @@ touching the device programs:
   delivery per ``decode_chunk`` tokens;
 * ``cancel()`` frees the slot within one chunk through the engine's
   host-event patch path; ``close()`` drains in-flight work; a driver
-  crash resolves every outstanding handle with an ``error`` status
-  instead of hanging callers;
+  crash hands every outstanding handle to the fleet ``on_crash`` hook
+  for replay on a survivor (``adopt`` re-prefills prompt + emitted
+  tokens and dedups on emitted-token count), or resolves it ``error``
+  when no hook/survivor exists — callers never hang;
 * admission decisions (priority ordering, deadline-feasibility shedding,
   per-tenant rate limits) live in :mod:`.admission`; per-request spans
   and latency histograms in :mod:`.tracing`.
@@ -70,6 +72,12 @@ class StreamHandle:
                  trace_id: Optional[str] = None):
         self._request = request
         self._frontend = frontend
+        # the ORIGINAL prompt and token budget, immutable for the
+        # handle's lifetime: crash replay rewrites the Request's prompt
+        # to prompt+emitted and shrinks its budget, so caller-facing
+        # views (output_ids, request_snapshot) must read these instead
+        self._prompt = np.asarray(request.prompt, np.int32)
+        self._max_new_tokens = int(request.max_new_tokens)
         self.tenant = tenant
         self.priority = priority
         self.slo_ttft_s = slo_ttft_s
@@ -145,7 +153,7 @@ class StreamHandle:
         ``ServingEngine.run``."""
         with self._cond:
             toks = np.asarray(self._tokens, np.int32)
-        return np.concatenate([self._request.prompt, toks])
+        return np.concatenate([self._prompt, toks])
 
     def poll(self) -> List[int]:
         """Non-blocking: tokens that arrived since the last
@@ -253,6 +261,10 @@ class ServingFrontend:
         self._closing = False
         self._closed = False
         self._crashed = False
+        # set by FleetRouter.retire_replica: placement has stopped and
+        # /readyz reports not-ready so external balancers mirror the
+        # router's exclusion while in-engine chunks retire
+        self.draining = False
         self._crash_error: Optional[BaseException] = None
         # uid -> handle for requests inside the engine (driver-only)
         self._handles: Dict[int, StreamHandle] = {}
@@ -412,6 +424,51 @@ class ServingFrontend:
             "engine_running": len(sched.running),
         }
 
+    @staticmethod
+    def _handle_snapshot(handle: StreamHandle) -> Dict[str, Any]:
+        """One locked read of everything replay (and a postmortem)
+        needs about one handle: the ORIGINAL prompt and budget, the
+        tokens emitted to the caller so far, and the sampling/admission
+        parameters. The shared shape behind ``request_snapshot`` and
+        the flight recorder's ``in_flight`` records."""
+        with handle._cond:
+            emitted = list(handle._tokens)
+            status = handle._status or "pending"
+        req = handle._request
+        return {
+            "uid": handle.uid,
+            "trace_id": handle.trace_id,
+            "status": status,
+            "prompt": handle._prompt.copy(),
+            "prompt_len": int(handle._prompt.shape[0]),
+            "tokens_emitted": emitted,
+            "max_new_tokens": handle._max_new_tokens,
+            "sampling": {"eos_token_id": req.eos_token_id,
+                         "deadline_s": req.deadline_s,
+                         "priority": handle.priority,
+                         "tenant": handle.tenant,
+                         "slo_ttft_s": handle.slo_ttft_s},
+        }
+
+    def request_snapshot(self, uid: int) -> Optional[Dict[str, Any]]:
+        """Locked accessor for one outstanding request: original prompt,
+        tokens emitted so far, and sampling params — the stable API
+        replay and postmortems share instead of poking ``_handles``.
+        Finds the handle whether it is admission-pending or inside the
+        engine; returns None for unknown/finished-and-reaped uids.
+        Thread-safe (dict/heap reads are locked or GIL-atomic; the
+        token read locks the handle)."""
+        handle = self._handles.get(uid)
+        if handle is None:
+            for ticket in self._controller.tickets():
+                payload = ticket.payload
+                if payload is not None and payload.uid == uid:
+                    handle = payload
+                    break
+        if handle is None:
+            return None
+        return self._handle_snapshot(handle)
+
     def stats(self) -> Dict[str, Any]:
         """Control-plane counters (thread-safe, approximate under
         concurrency)."""
@@ -425,34 +482,90 @@ class ServingFrontend:
             "terminal": dict(self.tracing.counters),
         }
 
+    def drain_pending(self) -> List[StreamHandle]:
+        """Graceful drain, phase one: pull every admission-pending
+        ticket off this frontend (thread-safe) and return the still-live
+        handles so a router can re-home them on survivors. Requests
+        already inside the engine are NOT touched — their chunks retire
+        naturally, which is the rest of the drain. Each returned
+        handle's trace segment here closes ``rerouted``; the adopter
+        re-opens the same uid/trace_id."""
+        handles: List[StreamHandle] = []
+        for ticket in self._controller.drain():
+            handle: StreamHandle = ticket.payload
+            if handle is None or handle.done:
+                continue
+            self.tracing.finish(handle.uid, "rerouted")
+            handles.append(handle)
+        return handles
+
     def adopt(self, handle: StreamHandle,
               rerouted_from: Optional[str] = None) -> bool:
-        """Re-home a never-prefilled handle from a crashed peer onto this
-        frontend (the fleet router's dead-replica drain path). The SAME
-        StreamHandle keeps streaming to its caller; only the backend
-        changes — the handle keeps its ``trace_id``, and this replica's
-        trace segment records ``rerouted_from=<crashed replica>`` so the
-        journey stays one connected story. Returns False — after
-        resolving the handle ``rejected`` — when this frontend cannot
-        take it; thread-safe."""
+        """Re-home a handle from a crashed or draining peer onto this
+        frontend. The SAME StreamHandle keeps streaming to its caller;
+        only the backend changes — the handle keeps its ``trace_id``,
+        and this replica's trace segment records
+        ``rerouted_from=<source replica>`` so the journey stays one
+        connected story.
+
+        Never-prefilled handles restart from scratch. Handles that
+        already streamed tokens are REPLAYED: this engine re-prefills
+        the original prompt + the tokens already emitted (a paged
+        ``PrefixCache`` hit when a peer replayed the same stream), the
+        token budget shrinks by the emitted count, and the delivery
+        cursor resets so ``_push_progress`` hands the caller only
+        freshly generated tokens — zero duplicates, greedy
+        bit-identical to an uncrashed run. The replay is rebuilt from
+        the handle's ORIGINAL prompt/budget each time, so repeated
+        crashes compose. The survivor's ``submitted`` trace mark keeps
+        the ORIGINAL submit time: a journey's latency clock never
+        resets, so recovery delay lands in TTFT/queue-wait SLOs.
+
+        Returns False — after resolving the handle ``rejected`` — when
+        this frontend cannot take it; thread-safe."""
         if handle.done:
             return False
         req = handle._request
-        # the request never prefilled on the dead replica: no slot, no
-        # tokens, no device state — reset the scheduler-side lifecycle
-        # fields so a fresh engine accepts it as new work
+        emitted = handle.tokens
+        n_emitted = len(emitted)
+        if req.status == "done" or n_emitted >= handle._max_new_tokens \
+                or (req.eos_token_id is not None
+                    and n_emitted and emitted[-1] == req.eos_token_id):
+            # the stream already delivered its full output — the crash
+            # only stole the final status. Nothing to replay: close the
+            # journey here as done.
+            self.tracing.start(req.uid, trace_id=handle.trace_id,
+                               replica=self._telemetry_label,
+                               rerouted_from=rerouted_from)
+            self.tracing.finish(req.uid, "done")
+            handle._resolve("done")
+            return True
+        # rebuild the scheduler-side lifecycle from the handle's
+        # original prompt/budget: replay prompt = prompt + emitted
+        # prefix, remaining budget = original budget - emitted count
+        req.prompt = handle._prompt
+        req.max_new_tokens = handle._max_new_tokens
+        if n_emitted:
+            req.prompt = np.concatenate(
+                [handle._prompt, np.asarray(emitted, np.int32)])
+            req.max_new_tokens = handle._max_new_tokens - n_emitted
+        req.tokens = []
         req.status = "new"
         req.slot = None
         req.submit_t = None
+        req.first_token_t = None
+        req.finish_t = None
+        handle._pushed = 0
+        handle._prefill_marked = False
         handle._frontend = self
-        now = self._clock()
         meta = dict(tenant=handle.tenant, priority=handle.priority,
                     prompt_len=req.prompt_len,
                     max_new_tokens=req.max_new_tokens,
                     slo_ttft_s=handle.slo_ttft_s, deadline_s=req.deadline_s,
                     trace_id=handle.trace_id,
                     replica=self._telemetry_label,
-                    rerouted_from=rerouted_from)
+                    rerouted_from=rerouted_from,
+                    replayed_tokens=n_emitted)
         self.n_submitted += 1
         with self._wake:
             dead = self._closing or self._crashed
@@ -476,9 +589,10 @@ class ServingFrontend:
             return False
         self.flight.record("adopt", uid=req.uid,
                            trace_id=handle.trace_id,
-                           rerouted_from=rerouted_from)
+                           rerouted_from=rerouted_from,
+                           replayed_tokens=n_emitted)
         self.tracing.start(req.uid, **meta)
-        self.tracing.mark(req.uid, "submitted", t=now)
+        self.tracing.mark(req.uid, "submitted", t=handle.submit_t)
         with self._wake:
             self._wake.notify()
         return True
@@ -629,32 +743,37 @@ class ServingFrontend:
             self.tracing.emit()
 
     def _fail_all(self, exc: BaseException) -> None:
-        """Driver crash: convert every outstanding request — pending
-        admission, queued, running — into a structured ``error`` result
-        so no caller blocks forever, then mark the frontend dead (new
-        submits reject with ``frontend_closed``).
+        """Driver crash: every outstanding request — pending admission,
+        queued, running — either reroutes to a survivor or resolves to a
+        structured ``error`` result so no caller blocks forever, then
+        the frontend is marked dead (new submits reject with
+        ``frontend_closed``).
 
-        With an ``on_crash`` hook installed, work that never touched the
-        device — admission-pending tickets plus engine-queued (never
-        prefilled) requests — is handed to the hook instead, still
-        unresolved, so a fleet router can re-home those handles on
-        surviving replicas. Requests that prefilled or streamed tokens
-        always resolve ``error`` here: their KV state died with the
-        replica.
+        With an ``on_crash`` hook installed, EVERY live handle is
+        salvageable: admission-pending and engine-queued requests
+        restart from scratch on a survivor, and requests that already
+        prefilled or streamed tokens are REPLAYED — the handle carries
+        the original prompt plus every emitted token, which is all a
+        survivor's ``adopt()`` needs to re-prefill and resume the
+        stream with zero duplicates (the device KV died with the
+        replica; the journey did not). Only cancel-pending handles are
+        excluded — the caller already gave up on them.
 
         Before resolving ANYTHING the flight recorder dumps a
         postmortem (``self.postmortem_path``) whose ``in_flight`` list
-        is exactly the handle set this crash is about to resolve
-        ``error`` or hand off for reroute."""
+        is exactly the handle set this crash is about to hand off for
+        reroute or resolve ``error``."""
         msg = f"{type(exc).__name__}: {exc}"
         logger.error(f"serving frontend driver crashed: {msg}")
         with self._wake:
             self._crashed = True
             self._crash_error = exc
             cancels, self._cancel_requests = self._cancel_requests, []
+        cancel_uids = {h.uid for h in cancels}
         salvaged: List[StreamHandle] = []
         for ticket in self._controller.drain():
-            salvaged.append(ticket.payload)
+            if ticket.payload.uid not in cancel_uids:
+                salvaged.append(ticket.payload)
         # engine-queued requests were fed but never admitted to a slot:
         # host-only state, safe to replay elsewhere (scheduler data is
         # driver-owned and this IS the driver thread, post-crash)
@@ -662,14 +781,26 @@ class ServingFrontend:
         if sched is not None:
             for req in list(sched.queue):
                 handle = self._handles.pop(req.uid, None)
-                if handle is not None:
+                if handle is not None and handle.uid not in cancel_uids:
                     salvaged.append(handle)
             sched.queue.clear()
+        # running handles (admitted, possibly mid-stream): flush any
+        # recorded-but-unpushed tokens first so the handle's emitted
+        # prefix matches what the device actually committed — the
+        # replay prompt is built from exactly this prefix
+        running: List[StreamHandle] = []
+        for uid, handle in list(self._handles.items()):
+            try:
+                self._push_progress(handle._request, handle)
+            except Exception:  # noqa: BLE001 — salvage beats bookkeeping
+                pass
+            if uid not in cancel_uids:
+                running.append(handle)
         # ---- postmortem: capture the in-flight set pre-resolution ----
         in_flight: List[Dict[str, Any]] = []
         seen: set = set()
         for disposition, group in (("salvageable", salvaged),
-                                   ("running", self._handles.values()),
+                                   ("salvageable", running),
                                    ("cancel_pending", cancels)):
             for handle in group:
                 if handle.uid in seen:
@@ -680,6 +811,8 @@ class ServingFrontend:
                     "trace_id": handle.trace_id,
                     "status": handle.status,
                     "n_tokens": len(handle.tokens),
+                    "prompt_len": int(handle._prompt.shape[0]),
+                    "max_new_tokens": handle._max_new_tokens,
                     "disposition": disposition})
         slot_uids = {}
         if sched is not None:
@@ -690,32 +823,32 @@ class ServingFrontend:
             self.postmortem_path = self.flight.dump(
                 reason="driver_crash", error=msg, in_flight=in_flight,
                 slot_uids=slot_uids,
-                extra={"n_salvageable": len(salvaged),
-                       "n_running": len(self._handles),
+                extra={"n_salvageable": len(salvaged) + len(running),
+                       "n_running": len(running),
                        "pending_admission": self._controller.pending})
         except Exception as dump_exc:  # noqa: BLE001 — never block drain
             logger.error(f"flight recorder dump failed: {dump_exc}")
+        # hand never-prefilled work first: survivors fill slots with
+        # cheap restarts while the replays re-prefill behind them
+        to_hand: List[StreamHandle] = salvaged + running
         handed: List[StreamHandle] = []
-        if self._on_crash is not None and salvaged:
+        if self._on_crash is not None and to_hand:
             try:
-                handed = list(salvaged)
-                self._on_crash(self, list(salvaged), exc)
-                salvaged = []
+                handed = list(to_hand)
+                self._on_crash(self, list(to_hand), exc)
+                to_hand = []
             except Exception as hook_exc:  # noqa: BLE001 — fall back
                 handed = []
                 logger.error(
                     f"crash re-route hook failed ({hook_exc}); resolving "
-                    f"{len(salvaged)} salvaged handles as error")
+                    f"{len(to_hand)} salvaged handles as error")
         # close this replica's trace segment for every handle the hook
         # re-homed: terminal status ``rerouted`` links the journey's next
         # segment (the survivor re-opens the same uid/trace_id)
         for handle in handed:
             self.tracing.finish(handle.uid, "rerouted", error=msg)
-        for handle in salvaged:
+        for handle in to_hand:
             self.tracing.finish(handle.uid, "error", error=msg)
-            handle._resolve("error", error=msg)
-        for uid, handle in list(self._handles.items()):
-            self.tracing.finish(uid, "error", error=msg)
             handle._resolve("error", error=msg)
         self._handles.clear()
         for handle in cancels:

@@ -204,10 +204,14 @@ class HealthMonitor:
     check passes. Checks (all optional — wire what the process has):
 
     * ``frontend`` — its driver thread must be alive and not crashed
-      (``driver_dead`` / ``driver_crashed``), and its pending admission
-      queue below ``queue_saturation`` of ``max_pending``
-      (``admission_saturated``: shedding load is degraded, not dead —
-      but a fleet router should stop placing traffic here);
+      (``driver_dead`` / ``driver_crashed``), not ``draining`` (set by
+      ``FleetRouter.retire_replica``: the replica is finishing its
+      in-engine work and must receive nothing new, so external
+      balancers mirror the router's placement exclusion), and its
+      pending admission queue below ``queue_saturation`` of
+      ``max_pending`` (``admission_saturated``: shedding load is
+      degraded, not dead — but a fleet router should stop placing
+      traffic here);
     * ``watchdog`` — ``backend_unresponsive`` when the heartbeat says
       the accelerator is gone;
     * ``slo`` + ``slo_fast_burn_threshold`` — opt-in (both must be set):
@@ -245,6 +249,9 @@ class HealthMonitor:
                 details["crash_error"] = str(fe.crash_error)
             elif not alive:
                 reasons.append("driver_dead")
+            if getattr(fe, "draining", False):
+                reasons.append("draining")
+                details["draining"] = True
             pending = fe.pending_admission
             cap = fe.max_pending
             details["pending_admission"] = pending

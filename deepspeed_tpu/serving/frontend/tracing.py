@@ -51,7 +51,7 @@ class RequestTrace:
     __slots__ = ("uid", "tenant", "priority", "prompt_len",
                  "max_new_tokens", "slo_ttft_s", "deadline_s", "events",
                  "chunks", "status", "reject_reason", "error", "n_tokens",
-                 "trace_id", "replica", "rerouted_from")
+                 "trace_id", "replica", "rerouted_from", "replayed_tokens")
 
     def __init__(self, uid: int, *, tenant: str = "default",
                  priority: int = 1, prompt_len: int = 0,
@@ -60,7 +60,8 @@ class RequestTrace:
                  deadline_s: Optional[float] = None,
                  trace_id: Optional[str] = None,
                  replica: Optional[str] = None,
-                 rerouted_from: Optional[str] = None):
+                 rerouted_from: Optional[str] = None,
+                 replayed_tokens: int = 0):
         self.uid = uid
         self.tenant = tenant
         self.priority = priority
@@ -74,6 +75,10 @@ class RequestTrace:
         self.trace_id = trace_id
         self.replica = replica
         self.rerouted_from = rerouted_from
+        # tokens the caller had ALREADY received when this segment
+        # opened: >0 marks an in-flight replay after a crash (the
+        # survivor re-prefilled prompt + this many emitted tokens)
+        self.replayed_tokens = replayed_tokens
         self.events: Dict[str, float] = {}
         self.chunks: List[List[float]] = []      # [t, n_tokens] pairs
         self.status: Optional[str] = None        # terminal status
@@ -116,6 +121,7 @@ class RequestTrace:
             "trace_id": self.trace_id,
             "replica": self.replica,
             "rerouted_from": self.rerouted_from,
+            "replayed_tokens": self.replayed_tokens,
             "tenant": self.tenant,
             "priority": self.priority,
             "prompt_len": self.prompt_len,

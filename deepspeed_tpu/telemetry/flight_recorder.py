@@ -16,22 +16,27 @@ on three triggers:
 * **SIGTERM** — :func:`install_sigterm_handler` dumps every live
   recorder in the process, then chains the previous handler.
 
-Postmortem schema (``dstpu-postmortem-v1``)::
+Postmortem schema (``dstpu-postmortem-v2``)::
 
-    {"schema": "dstpu-postmortem-v1",
+    {"schema": "dstpu-postmortem-v2",
      "reason": "driver_crash" | "watchdog_max_failures" | "sigterm"
                | <caller-supplied>,
      "replica": <label or null>, "t": <monotonic s>, "wall_time_s": ...,
      "error": <message or null>,
      "events": [{"t": ..., "kind": ..., **fields}, ...],  # oldest first
      "in_flight": [{"uid", "trace_id", "status", "n_tokens",
-                    "disposition"}, ...],
+                    "prompt_len", "max_new_tokens", "disposition"}, ...],
      "slot_uids": {"<slot>": uid, ...},
      "watchdog": <BackendWatchdog.state() or null>,
      "extra": {...}}
 
+v2 (elastic fleet): every ``in_flight`` record carries the original
+``prompt_len`` and ``max_new_tokens``, and requests that already
+prefilled are labelled ``salvageable`` rather than ``running`` — the
+postmortem is now a complete replay manifest, not just a casualty list.
+
 ``FleetRouter`` attaches the dump path to its crash/reroute records —
-the input format for the roadmap's future in-flight replay loop.
+the input format the in-flight replay loop consumes.
 
 Stdlib-only; safe to import without JAX.
 """
@@ -50,7 +55,7 @@ import weakref
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
-SCHEMA = "dstpu-postmortem-v1"
+SCHEMA = "dstpu-postmortem-v2"
 
 #: every live recorder, for the SIGTERM sweep (weak: recorders die with
 #: their frontends, the registry must not keep them alive)

@@ -170,22 +170,60 @@ FLEET_SPECS: List[MetricSpec] = [
                note="every request one connected journey, binary"),
     MetricSpec(("crash", "postmortem_inflight_match"), SHIFT,
                abs_tol=0.0,
-               note="postmortem in-flight set == error/rerouted "
-                    "handles, binary"),
+               note="postmortem in-flight set == rerouted handles, "
+                    "all salvageable, binary"),
     MetricSpec(("crash", "rerouted_parity"), SHIFT, abs_tol=0.0,
                note="rerouted greedy streams stay bit-identical"),
     MetricSpec(("crash", "errors"), SHIFT, abs_tol=0.0,
-               note="exactly the one wedged-mid-chunk request errors"),
+               note="zero: the wedged mid-chunk request replays on the "
+                    "survivor instead of erroring"),
     MetricSpec(("crash", "rerouted"), SHIFT, abs_tol=0.0,
-               note="every queued request re-homes on the survivor"),
+               note="every in-flight request re-homes on the survivor"),
+    MetricSpec(("crash", "replayed"), SHIFT, abs_tol=0.0,
+               note="exactly the prefilled request replays its emitted "
+                    "prefix"),
     MetricSpec(("journey", "complete"), SHIFT, abs_tol=0.0,
                note="validate_journeys over the merged export, binary"),
     MetricSpec(("journey", "rerouted_links"), SHIFT, abs_tol=0.0,
                note="one reroute flow link per adopted handle"),
     MetricSpec(("slo", "burn_moved"), SHIFT, abs_tol=0.0,
-               note="availability burn must rise in the crash window"),
+               note="ttft burn must rise in the crash window (replay "
+                    "keeps the original submit time)"),
     MetricSpec(("slo", "burn_recovered_flag"), SHIFT, abs_tol=0.0,
                note="fast burn must fall back after the window drains"),
+    MetricSpec(("slo", "availability_burn"), SHIFT, abs_tol=0.0,
+               note="zero-loss crash: the availability budget never "
+                    "burns"),
+    # ---- elastic fleet (kill a replica mid-stream at 2x load) ----
+    MetricSpec(("elastic", "errors"), SHIFT, abs_tol=0.0,
+               note="zero requests resolve error across the incident"),
+    MetricSpec(("elastic", "lost"), SHIFT, abs_tol=0.0,
+               note="zero requests lost (every status is done)"),
+    MetricSpec(("elastic", "replay_parity"), SHIFT, abs_tol=0.0,
+               note="replayed/rerouted streams bit-identical, binary"),
+    MetricSpec(("elastic", "duplicate_tokens"), SHIFT, abs_tol=0.0,
+               note="dedup at the chunk boundary: no stream drops or "
+                    "repeats a token"),
+    MetricSpec(("elastic", "replayed"), SHIFT, abs_tol=0.0,
+               note="the prefilled stream replays, deterministic count"),
+    MetricSpec(("elastic", "rerouted"), SHIFT, abs_tol=0.0,
+               note="all 2x-load requests re-home, deterministic count"),
+    MetricSpec(("elastic", "returned_to_target"), SHIFT, abs_tol=0.0,
+               note="the controller ends the incident at target size"),
+    MetricSpec(("elastic", "scale_up"), SHIFT, abs_tol=0.0,
+               note="below-target restore + surge, deterministic"),
+    MetricSpec(("elastic", "scale_down"), SHIFT, abs_tol=0.0,
+               note="the surge retires gracefully once burn calms"),
+    MetricSpec(("elastic", "drained"), SHIFT, abs_tol=0.0,
+               note="poll_draining finalizes the retirement"),
+    MetricSpec(("elastic", "burn_moved"), SHIFT, abs_tol=0.0,
+               note="ttft burn must rise during the incident"),
+    MetricSpec(("elastic", "burn_recovered_flag"), SHIFT, abs_tol=0.0,
+               note="the fast window is clean after recovery"),
+    MetricSpec(("elastic", "recovery_ttft_p99_s"), LOWER, 1.00,
+               abs_tol=2.0,
+               note="recovery-window TTFT stays bounded (wedge hold + "
+                    "survivor backlog; CPU timing is noisy)"),
 ]
 
 SPEC_SETS: Dict[str, List[MetricSpec]] = {

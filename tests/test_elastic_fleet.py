@@ -1,0 +1,311 @@
+"""Elastic fleet control: autoscaling policy, graceful drain, warm start.
+
+The elastic-fleet tentpole at tier-1 speed, all on JAX-free fake
+replicas (the ``_FakeEngine`` / ``_stub_load`` idiom from
+``test_fleet.py``):
+
+* ``ChunkThroughputEstimator.seed`` — the cold-start warm-start
+  contract: a donor rate applies only while unmeasured, real samples
+  always win, and the snapshot keeps ``n_samples == 0`` so a router can
+  tell inherited from observed;
+* ``FleetRouter`` elasticity — ``add_replica`` (factory fallback, EWMA
+  warm start from the fastest measured peer), ``retire_replica``
+  (draining placement state, least-loaded pick, ``min_routable``
+  floor), ``poll_draining`` (idle draining replicas close + retire);
+* ``ElasticController.step`` — target inference on the first tick,
+  immediate below-target restore (crash repair ignores cooldown),
+  burn-driven scale-up with cooldown + ``max_replicas`` bounds,
+  drain-time-driven scale-up, calm scale-down to target, and the
+  decision-record/``stats()`` surfaces.
+
+End-to-end elasticity on real engines (kill a replica mid-stream under
+2x load) lives in ``benchmarks/fleet_bench.py``; replay correctness in
+``test_replay.py``.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.fleet import (ElasticConfig, ElasticController,
+                                         FleetRouter)
+from deepspeed_tpu.serving.frontend import ChunkThroughputEstimator
+
+from tests.test_fleet import FakeClock, _FakeEngine, _stub_load
+
+
+# --------------------------------------- satellite: EWMA warm start
+class TestEstimatorSeed:
+    def test_seed_applies_only_while_unmeasured(self):
+        est = ChunkThroughputEstimator()
+        assert est.seed(120.0)
+        assert est.rate() == 120.0
+        # the snapshot still says "inherited, not observed"
+        snap = est.snapshot()
+        assert snap["tokens_per_s"] == 120.0
+        assert snap["n_samples"] == 0
+        # a second seed must not clobber the first
+        assert not est.seed(999.0)
+        assert est.rate() == 120.0
+
+    def test_real_samples_win_over_the_seed(self):
+        est = ChunkThroughputEstimator(alpha=1.0)
+        assert est.seed(120.0)
+        est.record(50, 1.0)
+        assert est.rate() == pytest.approx(50.0)
+        assert est.snapshot()["n_samples"] == 1
+
+    def test_seed_refused_after_measurement(self):
+        est = ChunkThroughputEstimator()
+        est.record(80, 1.0)
+        assert not est.seed(120.0)
+        assert est.rate() == pytest.approx(80.0)
+
+    def test_seed_rejects_garbage(self):
+        est = ChunkThroughputEstimator()
+        assert not est.seed(None)
+        assert not est.seed(0.0)
+        assert not est.seed(-5.0)
+        assert est.rate() is None
+
+
+# ------------------------------------------- router elasticity verbs
+class TestRouterElasticity:
+    def test_add_replica_grows_and_warm_starts_from_peer(self):
+        with FleetRouter([_FakeEngine()], affinity=False) as router:
+            _stub_load(router, 0, rate=50.0)
+            rep = router.add_replica(_FakeEngine())
+            assert rep.rid == 1
+            assert router.n_routable == 2
+            assert router.n_scale_up == 1
+            # EWMA inherited from the measured peer, marked inherited
+            snap = rep.frontend._estimator.snapshot()
+            assert snap["tokens_per_s"] == pytest.approx(50.0)
+            assert snap["n_samples"] == 0
+            # the new replica routes like any other
+            _stub_load(router, 0, pending=5, backlog=100, rate=50.0)
+            _stub_load(router, 1, rate=50.0)
+            assert router._place(
+                np.arange(1, 5, dtype=np.int32)).rid == 1
+
+    def test_add_replica_without_factory_or_engine_raises(self):
+        with FleetRouter([_FakeEngine()], affinity=False) as router:
+            with pytest.raises(ValueError):
+                router.add_replica()
+
+    def test_add_replica_uses_factory(self):
+        built = []
+
+        def factory():
+            eng = _FakeEngine()
+            built.append(eng)
+            return eng
+
+        with FleetRouter([_FakeEngine()], affinity=False,
+                         replica_factory=factory) as router:
+            rep = router.add_replica()
+            assert built and rep.engine is built[0]
+
+    def test_retire_picks_least_loaded_and_respects_floor(self):
+        with FleetRouter([_FakeEngine(), _FakeEngine(), _FakeEngine()],
+                         affinity=False) as router:
+            _stub_load(router, 0, backlog=100)
+            _stub_load(router, 1, backlog=5)      # least loaded
+            _stub_load(router, 2, backlog=50)
+            rep = router.retire_replica(min_routable=2)
+            assert rep is not None and rep.rid == 1
+            assert rep.draining and not rep.retired
+            assert rep.frontend.draining          # /readyz mirrors it
+            assert rep.alive                      # drain, not death
+            assert not rep.routable
+            assert router.n_routable == 2
+            assert router.n_scale_down == 1
+            # placement never lands on the draining replica
+            for _ in range(4):
+                assert router._place(
+                    np.arange(1, 5, dtype=np.int32)).rid in (0, 2)
+            # the floor refuses the next retirement
+            assert router.retire_replica(min_routable=2) is None
+            assert router.n_scale_down == 1
+
+    def test_retire_by_rid_and_unknown_rid(self):
+        with FleetRouter([_FakeEngine(), _FakeEngine()],
+                         affinity=False) as router:
+            _stub_load(router, 0)
+            _stub_load(router, 1)
+            assert router.retire_replica(rid=77, min_routable=1) is None
+            rep = router.retire_replica(rid=1, min_routable=1)
+            assert rep is not None and rep.rid == 1
+
+    def test_poll_draining_retires_idle_replicas(self):
+        with FleetRouter([_FakeEngine(), _FakeEngine()],
+                         affinity=False) as router:
+            _stub_load(router, 0)
+            _stub_load(router, 1)
+            rep = router.retire_replica(rid=1, min_routable=1)
+            assert rep is not None
+            assert router.poll_draining() == [1]
+            assert rep.retired and not rep.alive
+            assert router.n_drained == 1
+            # idempotent: a second poll retires nothing
+            assert router.poll_draining() == []
+            stats = router.stats()
+            assert stats["retired"] == 1
+            assert stats["draining"] == 0
+            assert stats["drained"] == 1
+            assert stats["scale_down"] == 1
+
+
+# ------------------------------------------------ controller policy
+def _fleet(n=2, factory=True, **cfg_kw):
+    clock = FakeClock()
+    router = FleetRouter(
+        [_FakeEngine() for _ in range(n)], affinity=False,
+        replica_factory=(_FakeEngine if factory else None), clock=clock)
+    for rid in range(n):
+        _stub_load(router, rid, rate=50.0)
+    cfg_kw.setdefault("max_replicas", 4)
+    cfg_kw.setdefault("cooldown_s", 5.0)
+    ctrl = ElasticController(router, ElasticConfig(**cfg_kw),
+                             windows_s=(60.0,), clock=clock)
+    return router, ctrl, clock
+
+
+def _burn(ctrl, rid, clock, n=8, status="error"):
+    """Synthesize page-worthy burn on one replica's sensor (one error
+    in <=100 requests blows a 99% availability budget)."""
+    for _ in range(n):
+        ctrl.sensor(rid).observe_record(status=status, t=clock.t)
+
+
+class TestElasticController:
+    def test_first_step_infers_target_and_attaches_sensors(self):
+        router, ctrl, clock = _fleet(n=2)
+        with router, ctrl:
+            rec = ctrl.step()
+            assert ctrl.target == 2
+            assert rec["action"] == "none"
+            assert rec["routable"] == 2
+            assert sorted(rec["burns"]) == [0, 1]
+            assert ctrl.stats()["sensors"] == [0, 1]
+
+    def test_below_target_restore_ignores_cooldown(self):
+        router, ctrl, clock = _fleet(n=2)
+        with router, ctrl:
+            ctrl.step()
+            # burn-driven scale-up just happened -> cooldown is active
+            _burn(ctrl, 0, clock)
+            clock.advance(0.1)
+            assert ctrl.step()["action"] == "scale_up"   # 3 routable now
+            router.replicas[0].dead = True        # double crash inside
+            router.replicas[1].dead = True        # the cooldown window
+            clock.advance(0.1)
+            rec = ctrl.step()
+            assert rec["action"] == "scale_up"
+            assert rec["reason"] == "below_target"
+            assert router.n_routable >= ctrl.target
+
+    def test_burn_scale_up_respects_cooldown_and_max(self):
+        router, ctrl, clock = _fleet(n=2, max_replicas=3)
+        with router, ctrl:
+            ctrl.step()
+            _burn(ctrl, 0, clock)
+            clock.advance(0.1)
+            rec = ctrl.step()
+            assert (rec["action"], rec["reason"]) == ("scale_up",
+                                                      "fast_burn")
+            assert router.n_routable == 3
+            # the new replica exists but burn persists: cooldown holds
+            _burn(ctrl, 0, clock)
+            clock.advance(1.0)
+            assert ctrl.step()["action"] == "none"
+            # past cooldown the fleet is at max_replicas: no growth
+            clock.advance(10.0)
+            _burn(ctrl, 0, clock)
+            rec = ctrl.step()
+            assert rec["action"] == "none"
+            assert router.n_routable == 3
+
+    def test_no_factory_cannot_grow(self):
+        router, ctrl, clock = _fleet(n=2, factory=False)
+        with router, ctrl:
+            ctrl.step()
+            router.replicas[0].dead = True
+            clock.advance(0.1)
+            rec = ctrl.step()
+            assert rec["action"] == "none"
+            assert rec["reason"] == "no_replica_factory"
+
+    def test_drain_time_trigger(self):
+        router, ctrl, clock = _fleet(n=2, scale_up_drain_s=10.0)
+        with router, ctrl:
+            ctrl.step()
+            # both replicas >10s from drained: load-based growth
+            _stub_load(router, 0, backlog=5000, rate=50.0)
+            _stub_load(router, 1, backlog=8000, rate=50.0)
+            clock.advance(6.0)
+            rec = ctrl.step()
+            assert (rec["action"], rec["reason"]) == ("scale_up",
+                                                      "drain_time")
+
+    def test_calm_scale_down_returns_to_target_and_finalizes(self):
+        router, ctrl, clock = _fleet(n=2)
+        with router, ctrl:
+            ctrl.step()                           # target = 2
+            rep = router.add_replica(_FakeEngine())  # manual surge
+            _stub_load(router, rep.rid, rate=50.0)
+            clock.advance(6.0)                    # calm, past cooldown
+            rec = ctrl.step()
+            assert (rec["action"], rec["reason"]) == ("scale_down",
+                                                      "above_target_calm")
+            assert router.n_routable == 2
+            draining = [r for r in router.replicas
+                        if r.draining and not r.retired]
+            assert len(draining) == 1
+            # a later tick finalizes the retirement (replica idle)
+            clock.advance(6.0)
+            rec2 = ctrl.step()
+            assert rec2["retired"] == [draining[0].rid]
+            assert router.n_drained == 1
+            # and the fleet holds at target afterwards
+            clock.advance(6.0)
+            assert ctrl.step()["action"] == "none"
+
+    def test_scale_down_never_below_min_replicas(self):
+        router, ctrl, clock = _fleet(n=1, min_replicas=1,
+                                     target_replicas=1)
+        with router, ctrl:
+            ctrl.step()
+            clock.advance(6.0)
+            rec = ctrl.step()
+            assert rec["action"] == "none"
+            assert router.n_routable == 1
+
+    def test_stats_and_decision_records(self):
+        router, ctrl, clock = _fleet(n=2)
+        with router, ctrl:
+            ctrl.step()
+            _burn(ctrl, 1, clock)
+            clock.advance(0.1)
+            ctrl.step()
+            st = ctrl.stats()
+            assert st["target"] == 2
+            assert st["n_steps"] == 2
+            assert st["n_actions"] == 1
+            (act,) = st["actions"]
+            assert act["action"] == "scale_up"
+            assert act["fast_burn"] >= 2.0
+            assert act["burns"][1] >= 2.0
+
+    def test_start_stop_background_thread(self):
+        router, ctrl, clock = _fleet(n=2, poll_every_s=0.01)
+        with router:
+            ctrl.start()
+            assert ctrl._thread is not None
+            deadline = 200
+            while ctrl.n_steps == 0 and deadline:
+                import time
+                time.sleep(0.01)
+                deadline -= 1
+            ctrl.stop()
+            assert ctrl.n_steps >= 1
+            assert ctrl.target == 2

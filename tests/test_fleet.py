@@ -252,14 +252,17 @@ class TestFleetIntegration:
         assert sum(per[r]["submitted"] for r in per) == 6
 
     def test_injected_crash_reroutes_queued_to_survivor(self, tiny_engine):
-        """The dead-replica drain: requests the crashed replica never
-        prefilled must complete on the survivor — same handles, correct
-        tokens — while prefilled requests resolve ``error``. The crash
-        must also leave the full observability story: a postmortem JSON
-        whose in-flight set exactly matches the error/rerouted handles,
-        crash/reroute journal records carrying the trace ids, and a
-        merged journey export where every request — the rerouted ones
-        included — is one connected journey under one trace id."""
+        """The dead-replica drain: EVERY request on the crashed replica
+        must complete on the survivor — same handles, correct tokens.
+        Requests it never prefilled restart from scratch; the wedged
+        mid-chunk request REPLAYS (the survivor re-prefills prompt +
+        emitted prefix and the stream stays greedy bit-identical). The
+        crash must also leave the full observability story: a
+        postmortem JSON whose in-flight set exactly matches the
+        rerouted handles, crash/reroute journal records carrying the
+        trace ids, and a merged journey export where every request —
+        the rerouted ones included — is one connected journey under
+        one trace id."""
         import json
         from deepspeed_tpu.telemetry.journey import validate_journeys
         prompts = _prompts(6, seed=1)
@@ -284,14 +287,19 @@ class TestFleetIntegration:
                     for p in prompts[1:]]
             router.replicas[1].dead = False
             release.set()
-            assert first.result(timeout=60) == "error"
-            assert "injected decode fault" in first.error
+            # the wedged request REPLAYS on the survivor: same handle,
+            # greedy bit-identical to the uncrashed oracle, no
+            # duplicate tokens
+            assert first.result(timeout=60) == "done"
+            assert np.array_equal(want[0], first.output_ids)
+            assert len(first.tokens) == 6
             for w, h in zip(want[1:], rest):
                 assert h.result(timeout=60) == "done"
                 assert np.array_equal(w, h.output_ids)
             stats = router.stats()
             assert stats["replica_crashes"] == 1
-            assert stats["rerouted"] == len(rest)
+            assert stats["rerouted"] == len(rest) + 1
+            assert stats["replayed"] >= 1
             assert stats["alive"] == 1
             # every handle carries the trace id minted at submit
             for h in [first] + rest:
@@ -303,11 +311,19 @@ class TestFleetIntegration:
             assert pm_path
             with open(pm_path) as f:
                 pm = json.load(f)
-            assert pm["schema"] == "dstpu-postmortem-v1"
+            assert pm["schema"] == "dstpu-postmortem-v2"
             assert pm["reason"] == "driver_crash"
             assert "injected decode fault" in pm["error"]
             assert ({e["uid"] for e in pm["in_flight"]}
                     == {first.uid} | {h.uid for h in rest})
+            # v2: every record is a replay manifest — even the wedged
+            # mid-chunk request is salvageable, and carries the
+            # original prompt/budget
+            by_uid = {e["uid"]: e for e in pm["in_flight"]}
+            assert all(e["disposition"] == "salvageable"
+                       for e in pm["in_flight"])
+            assert by_uid[first.uid]["prompt_len"] == len(prompts[0])
+            assert by_uid[first.uid]["max_new_tokens"] == 6
             # the wedged request was mid-chunk: its slot is mapped
             assert first.uid in pm["slot_uids"].values()
             # crash + reroute journal records carry the postmortem path
@@ -315,10 +331,10 @@ class TestFleetIntegration:
             crash_rec = stats["crashes"][0]
             assert crash_rec["replica"] == 0
             assert crash_rec["postmortem"] == pm_path
-            assert crash_rec["n_salvaged"] == len(rest)
+            assert crash_rec["n_salvaged"] == len(rest) + 1
             journal = router.journey_journal()
             assert ({r["trace_id"] for r in journal["reroutes"]}
-                    == {h.trace_id for h in rest})
+                    == {h.trace_id for h in [first] + rest})
             for r in journal["reroutes"]:
                 assert r["from_replica"] == 0
                 assert r["to_replica"] == 1

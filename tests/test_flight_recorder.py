@@ -87,18 +87,22 @@ class TestPostmortem:
         path = fr.dump(reason="driver_crash", error="boom",
                        in_flight=[{"uid": 7, "trace_id": "abc",
                                    "status": "running", "n_tokens": 3,
-                                   "disposition": "running"}],
+                                   "prompt_len": 5, "max_new_tokens": 8,
+                                   "disposition": "salvageable"}],
                        slot_uids={0: 7}, extra={"n_running": 1})
         assert path.startswith(str(tmp_path))
         with open(path) as f:
             doc = json.load(f)
-        assert doc["schema"] == "dstpu-postmortem-v1"
+        assert doc["schema"] == "dstpu-postmortem-v2"
         assert doc["reason"] == "driver_crash"
         assert doc["replica"] == "r1"
         assert doc["error"] == "boom"
         assert [e["kind"] for e in doc["events"]] == [
             "chunk_launch", "chunk_retire"]
         assert doc["in_flight"][0]["uid"] == 7
+        # v2: the record is a full replay manifest
+        assert doc["in_flight"][0]["prompt_len"] == 5
+        assert doc["in_flight"][0]["max_new_tokens"] == 8
         assert doc["slot_uids"] == {"0": 7}    # JSON keys are strings
         assert doc["extra"] == {"n_running": 1}
         assert doc["watchdog"] is None
@@ -253,20 +257,24 @@ class TestDriverCrashTrigger:
             assert pm_path
             with open(pm_path) as f:
                 pm = json.load(f)
-            assert pm["schema"] == "dstpu-postmortem-v1"
+            assert pm["schema"] == "dstpu-postmortem-v2"
             assert pm["reason"] == "driver_crash"
             assert "injected host fault" in pm["error"]
             # the in-flight set is EXACTLY the handles that resolved
             # error — dumped before _fail_all resolved any of them
+            # (no on_crash hook here, so nothing actually reroutes)
             assert ({e["uid"] for e in pm["in_flight"]}
                     == {h.uid for h in [first] + rest})
             by_uid = {e["uid"]: e for e in pm["in_flight"]}
-            assert by_uid[first.uid]["disposition"] == "running"
+            # v2: even the slot-admitted request is salvageable — the
+            # handle carries everything a survivor's adopt() needs
             assert all(by_uid[h.uid]["disposition"] == "salvageable"
-                       for h in rest)
+                       for h in [first] + rest)
+            assert by_uid[first.uid]["prompt_len"] == 4
+            assert by_uid[first.uid]["max_new_tokens"] == 8
             assert first.uid in pm["slot_uids"].values()
             assert pm["extra"]["n_running"] >= 1
-            assert pm["extra"]["n_salvageable"] == len(rest)
+            assert pm["extra"]["n_salvageable"] == len(rest) + 1
             # the ring captured the submits that preceded the crash
             kinds = [e["kind"] for e in pm["events"]]
             assert kinds.count("submit") == 1 + len(rest)

@@ -344,6 +344,15 @@ class TestHealthMonitor:
         ready, reasons, _ = HealthMonitor(frontend=fe).check()
         assert not ready and reasons == ["admission_saturated"]
 
+    def test_draining_reports_not_ready(self):
+        # FleetRouter.retire_replica sets frontend.draining: /readyz
+        # must mirror the router's placement exclusion
+        fe = _FakeFrontend()
+        fe.draining = True
+        ready, reasons, details = HealthMonitor(frontend=fe).check()
+        assert not ready and reasons == ["draining"]
+        assert details["draining"] is True
+
     def test_watchdog_wired_in(self):
         def bad():
             raise RuntimeError("no device")

@@ -1,0 +1,242 @@
+"""In-flight replay: crash recovery for requests that already streamed.
+
+PR 9's dead-replica drain only salvaged never-prefilled work; the
+elastic-fleet PR extends ``ServingFrontend.adopt``/``_fail_all`` so a
+request that prefilled — even one mid-stream — replays on a survivor:
+the survivor re-prefills the original prompt + the tokens already
+emitted, the token budget shrinks by the emitted count, and the
+delivery cursor dedups so the caller's ONE StreamHandle streams the
+continuation with zero duplicate tokens. Covered here:
+
+* greedy bit-parity: a stream crashed mid-decode (whole chunks already
+  delivered) finishes on the survivor bit-identical to an uncrashed
+  ``ServingEngine.run`` of the same prompt;
+* chunk-boundary dedup: the tokens delivered before the crash are a
+  frozen prefix — the survivor appends, never rewrites or repeats;
+* paged prefix-cache hit: the survivor's re-prefill of the
+  already-streamed portion is an exact-key ``PrefixCache`` hit when
+  that replay prompt is already cached (pre-warmed here; twin crashed
+  streams produce it naturally in ``fleet_bench``);
+* ``request_snapshot``: the locked accessor replay and postmortems
+  share instead of poking ``_handles``.
+
+Single-engine lifecycle/admission coverage lives in
+``test_frontend.py``; the crash observability story in
+``test_fleet.py`` and ``test_flight_recorder.py``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving import PrefixCache
+from deepspeed_tpu.serving.fleet import FleetRouter
+
+
+def _tiny(vocab=64, max_seq=64):
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt import GPT, GPTConfig
+    cfg = GPTConfig(vocab_size=vocab, max_seq_len=max_seq, num_layers=2,
+                    num_heads=2, d_model=32, d_ff=64, dtype=jnp.float32,
+                    param_dtype=jnp.float32, remat=False)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    model, params = _tiny()
+    return ds.init_inference(model, model_parameters=params,
+                             dtype=jnp.float32)
+
+
+def _serving(tiny_engine, **kw):
+    from deepspeed_tpu.serving import ServingEngine
+    kw.setdefault("max_batch", 2)
+    # replay prompts are prompt + emitted prefix: the scheduler's
+    # prompt-length gate must admit them, so size max_prompt_len for
+    # the deepest mid-stream crash this file stages
+    kw.setdefault("max_prompt_len", 32)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("decode_chunk", 4)
+    return ServingEngine(engine=tiny_engine, **kw)
+
+
+def _wedge_on_nth_chunk(engine, n):
+    """Replace the engine's decode-chunk program with one that runs the
+    real program for the first ``n - 1`` calls, then wedges (event-
+    gated) and raises — a crash with whole chunks already streamed."""
+    real = engine._jit_decode_chunk
+    entered, release = threading.Event(), threading.Event()
+    calls = {"n": 0}
+
+    def boom(*a, **k):
+        calls["n"] += 1
+        if calls["n"] < n:
+            return real(*a, **k)
+        entered.set()
+        release.wait(30)
+        raise RuntimeError("injected decode fault")
+
+    engine._jit_decode_chunk = boom
+    return entered, release
+
+
+def _crash_mid_stream(tiny_engine, *, survivor, prompt, max_new_tokens):
+    """Stage one mid-stream crash behind a FleetRouter: submit on the
+    crashy replica, let >=1 whole decode chunk stream, crash, and
+    return (handle, tokens_delivered_before_crash, router_stats,
+    journal) after the survivor finishes the stream."""
+    crashy = _serving(tiny_engine)
+    entered, release = _wedge_on_nth_chunk(crashy, 3)
+    with FleetRouter([crashy, survivor], affinity=False) as router:
+        router.replicas[1].dead = True          # steer traffic to 0
+        handle = router.submit(prompt, max_new_tokens=max_new_tokens)
+        assert entered.wait(30)                 # wedged mid-chunk 3
+        pre = handle.tokens                     # delivered pre-crash
+        assert len(pre) >= 4                    # >=1 whole chunk landed
+        router.replicas[1].dead = False
+        release.set()
+        assert handle.result(timeout=60) == "done"
+        stats = router.stats()
+        journal = router.journey_journal()
+    return handle, pre, stats, journal
+
+
+class TestReplayParity:
+    def test_mid_stream_crash_is_greedy_bit_identical(self, tiny_engine):
+        prompt = np.arange(5, 13, dtype=np.int32)
+        oracle = _serving(tiny_engine)
+        want = oracle.run([prompt], max_new_tokens=12)[0].output_ids
+        handle, pre, stats, journal = _crash_mid_stream(
+            tiny_engine, survivor=_serving(tiny_engine),
+            prompt=prompt, max_new_tokens=12)
+        assert np.array_equal(want, handle.output_ids)
+        assert len(handle.tokens) == 12          # full budget, no extras
+        assert stats["replayed"] == 1
+        assert stats["rerouted"] == 1
+        # the reroute journal records how much of the stream replayed
+        (rec,) = journal["reroutes"]
+        assert rec["replayed_tokens"] == len(pre)
+        # and the survivor's trace segment carries the same count
+        survivor_seg = [t for t in journal["replicas"][1]["requests"]
+                        if t["uid"] == handle.uid]
+        assert survivor_seg and \
+            survivor_seg[-1]["replayed_tokens"] == len(pre)
+
+    def test_chunk_boundary_dedup_freezes_the_prefix(self, tiny_engine):
+        """The pre-crash tokens are a frozen prefix: the survivor
+        appends the continuation and never re-delivers a token the
+        caller already consumed (the dedup is ``handle._pushed`` reset
+        against a re-prefilled request whose budget excludes the
+        emitted count)."""
+        prompt = np.arange(20, 26, dtype=np.int32)
+        oracle = _serving(tiny_engine)
+        want = oracle.run([prompt], max_new_tokens=12)[0].output_ids
+        handle, pre, _, _ = _crash_mid_stream(
+            tiny_engine, survivor=_serving(tiny_engine),
+            prompt=prompt, max_new_tokens=12)
+        got = handle.tokens
+        assert got[:len(pre)] == pre             # prefix untouched
+        assert len(got) == 12                    # no duplicates appended
+        assert np.array_equal(want, handle.output_ids)
+
+    def test_replay_prefill_hits_paged_prefix_cache(self, tiny_engine):
+        """The replay's re-prefill of prompt + already-streamed prefix
+        is an EXACT-key paged PrefixCache hit when the survivor already
+        holds that replay prompt. The emitted-at-crash count is a pump
+        implementation detail (prefill token + retired chunks), so
+        measure it with a rehearsal crash, pre-warm the paged survivor
+        with exactly that replay prompt, and assert the recovery moved
+        the hit counter."""
+        prompt = np.arange(30, 38, dtype=np.int32)
+        oracle = _serving(tiny_engine)
+        want_tokens = [int(t) for t in
+                       oracle.run([prompt], max_new_tokens=12)[0]
+                       .output_ids[len(prompt):]]
+        # rehearsal: same wedge, dense survivor — how deep is the crash?
+        _, pre0, _, _ = _crash_mid_stream(
+            tiny_engine, survivor=_serving(tiny_engine),
+            prompt=prompt, max_new_tokens=12)
+        replay_prompt = np.concatenate(
+            [prompt, np.asarray(pre0, np.int32)])
+        replay_key = PrefixCache.key_for(replay_prompt)
+        survivor = _serving(tiny_engine, paged=True)
+        from deepspeed_tpu.serving.frontend import ServingFrontend
+        fe = ServingFrontend(survivor)
+        h = fe.submit(replay_prompt, max_new_tokens=1)
+        assert h.result(timeout=60) == "done"
+        fe.close(timeout=30)
+        assert replay_key in survivor.kv.prefix_cache
+        hits_before = survivor.kv.prefix_cache.hits
+        handle, pre, _, _ = _crash_mid_stream(
+            tiny_engine, survivor=survivor,
+            prompt=prompt, max_new_tokens=12)
+        assert pre == pre0                       # wedge is deterministic
+        assert [int(t) for t in handle.tokens] == want_tokens
+        assert survivor.kv.prefix_cache.hits > hits_before
+        assert survivor.metrics.n_prefix_hits >= 1
+
+
+class TestRequestSnapshot:
+    def test_snapshot_of_running_and_pending_requests(self):
+        """JAX-free: a wedged fake engine holds one request in a slot
+        and more in admission; ``request_snapshot`` must see both kinds
+        and return the ORIGINAL prompt + emitted tokens + sampling
+        params, without touching driver-owned state."""
+        from tests.test_flight_recorder import _CrashyEngine
+        from deepspeed_tpu.serving.frontend import ServingFrontend
+        eng = _CrashyEngine(max_batch=1)
+        fe = ServingFrontend(eng)
+        try:
+            prompt = np.arange(1, 6, dtype=np.int32)
+            first = fe.submit(prompt, max_new_tokens=8, tenant="acme",
+                              priority=0, slo_ttft_s=0.5)
+            assert eng.entered.wait(30)          # slot assigned, wedged
+            pending = fe.submit(np.arange(9, 12, dtype=np.int32),
+                                max_new_tokens=4)
+            snap = fe.request_snapshot(first.uid)
+            assert snap is not None
+            assert np.array_equal(snap["prompt"], prompt)
+            assert snap["prompt_len"] == 5
+            assert snap["tokens_emitted"] == []
+            assert snap["max_new_tokens"] == 8
+            assert snap["status"] == "pending"
+            assert snap["trace_id"] == first.trace_id
+            assert snap["sampling"]["tenant"] == "acme"
+            assert snap["sampling"]["priority"] == 0
+            assert snap["sampling"]["slo_ttft_s"] == 0.5
+            # admission-pending requests are visible too
+            psnap = fe.request_snapshot(pending.uid)
+            assert psnap is not None and psnap["prompt_len"] == 3
+            # unknown uid -> None, not an exception
+            assert fe.request_snapshot(10**9) is None
+        finally:
+            eng.release.set()
+            fe.close(timeout=5)
+
+    def test_snapshot_reflects_emitted_tokens(self, tiny_engine):
+        """After a real stream finishes chunks, the snapshot's
+        ``tokens_emitted`` matches ``handle.tokens`` — the exact replay
+        manifest ``adopt`` would consume."""
+        from deepspeed_tpu.serving.frontend import ServingFrontend
+        eng = _serving(tiny_engine)
+        entered, release = _wedge_on_nth_chunk(eng, 3)
+        fe = ServingFrontend(eng)
+        try:
+            h = fe.submit(np.arange(2, 9, dtype=np.int32),
+                          max_new_tokens=12)
+            assert entered.wait(30)
+            snap = fe.request_snapshot(h.uid)
+            assert snap is not None
+            assert snap["tokens_emitted"] == h.tokens
+            assert len(snap["tokens_emitted"]) >= 4
+        finally:
+            release.set()
+            fe.close(timeout=30)
