@@ -184,6 +184,16 @@ def _sim_router_pass(n_replicas: int, prompts, max_new_tokens: int,
     return tokens / dt
 
 
+def _warm_widths(eng, prompts, max_new_tokens: int) -> None:
+    """Charge every prefill width this replica can see: batched prefill
+    compiles per (n, bucket) and arrival timing decides n, so a cold
+    width inside a measured window reads as multi-second TTFT burn on a
+    slow-compiling host (same physics as frontend_bench's k-sized warm
+    runs)."""
+    for k in range(1, len(prompts) + 1):
+        eng.run(list(prompts[:k]), max_new_tokens=max_new_tokens)
+
+
 def _round_tree(obj, nd=6):
     if isinstance(obj, dict):
         return {k: _round_tree(v, nd) for k, v in obj.items()}
@@ -255,10 +265,20 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
     for eng in replicas:                 # charge compiles before the
         eng.run(list(prompts),          # frontend takes ownership
                 max_new_tokens=max_new_tokens)
+    # one chunk profiler per replica (the hot-path hooks are
+    # single-writer; sharing one instance across two driver threads
+    # would misattribute launches) — the committed block reports the
+    # busiest replica's attribution
+    from ..telemetry.profiler import ChunkProfiler, validate_report
+    profs = [ChunkProfiler() for _ in replicas]
+    for eng, prof in zip(replicas, profs):
+        eng.profiler = prof
     router = FleetRouter(replicas)
     try:
-        handles = [router.submit(p, max_new_tokens=max_new_tokens)
-                   for p in prompts]
+        handles = [router.submit(p, max_new_tokens=max_new_tokens,
+                                 tenant="tenant-a" if i % 2 == 0
+                                 else "tenant-b")
+                   for i, p in enumerate(prompts)]
         for h in handles:
             h.result(timeout=300)
         parity = all(
@@ -267,8 +287,29 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             for i, h in enumerate(handles))
         shed = sum(1 for h in handles if h.status == "rejected")
         stats = router.stats()
+        tenants = router.tenants_report()
     finally:
         router.close(timeout=60)
+    profile_rep = max((p.profile_report() for p in profs),
+                      key=lambda r: r["n_chunks"])
+    problems = validate_report(profile_rep)
+    if problems:
+        raise RuntimeError(
+            f"fleet profile report failed validation: {problems}")
+    if not profile_rep["attribution_ok"]:
+        raise RuntimeError(
+            "fleet chunk attribution does not sum to wall: "
+            f"{profile_rep['attribution_error_frac']:.3f} error fraction")
+    result["profile"] = profile_rep
+    merged = tenants["tenants"]
+    if not {"tenant-a", "tenant-b"} <= set(merged):
+        raise RuntimeError(
+            f"fleet tenants report is missing tagged tenants: "
+            f"saw {sorted(merged)}")
+    result["tenant_goodput"] = {
+        "n_tenants": tenants["n_tenants"],
+        "tenants": merged,
+    }
     result["router_streaming_parity"] = float(parity)
     result["router"] = {
         "routed": stats["routed"], "shed": shed,
@@ -403,7 +444,7 @@ def _crash_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
     out: dict = {}
     engines = [ServingEngine(engine=inf, **eng_kw) for _ in range(2)]
     for eng in engines:                     # charge compiles up front
-        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+        _warm_widths(eng, prompts, max_new_tokens)
     router = FleetRouter(engines)
     crashy, survivor = router.replicas[0], router.replicas[1]
 
@@ -608,14 +649,14 @@ def _elastic_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
         # charged on the pinned workload before the replica takes
         # traffic (a cold compile inside the recovery window would
         # read as burn)
-        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+        _warm_widths(eng, prompts, max_new_tokens)
         return eng
 
     load_prompts = list(prompts) + list(prompts)        # 2x load
     load_out = list(oracle_out) + list(oracle_out)
     engines = [ServingEngine(engine=inf, **eng_kw) for _ in range(2)]
     for eng in engines:
-        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+        _warm_widths(eng, prompts, max_new_tokens)
     router = FleetRouter(engines, replica_factory=factory)
     ctrl = ElasticController(
         router,

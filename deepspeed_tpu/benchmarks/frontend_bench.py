@@ -61,6 +61,7 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     from .. import telemetry
     from ..telemetry.exposition import MetricsServer, parse_prometheus_text
     from ..telemetry.mfu import mfu_report
+    from ..telemetry.profiler import ChunkProfiler, validate_report
     from ..telemetry.slo import SLOEngine, default_slos
     from ..telemetry.summary import phase_breakdown
     from ..serving import ServingEngine
@@ -87,10 +88,26 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
                               max_queue=max(len(prompts), 8))
     reference.run(list(prompts), max_new_tokens=max_new_tokens)  # warm
     reference.run(list(prompts), max_new_tokens=max_new_tokens)
+    # steady-state decode window: the tight pump loop with no frontend
+    # delivery machinery between chunks — this is where the <15% bubble
+    # budget must hold (the overload window legitimately idles between
+    # open-loop arrivals)
+    steady_prof = ChunkProfiler()
+    reference.profiler = steady_prof
     t0 = time.perf_counter()
     ref_results = reference.run(list(prompts),
                                 max_new_tokens=max_new_tokens)
     cal_dt = time.perf_counter() - t0
+    steady = steady_prof.profile_report()
+    if not steady["attribution_ok"]:
+        raise RuntimeError(
+            "steady-state chunk attribution does not sum to wall: "
+            f"{steady['attribution_error_frac']:.3f} error fraction")
+    steady_bubble = steady["bubble_fraction"]
+    if steady_bubble >= 0.15:
+        raise RuntimeError(
+            f"steady-state decode bubble fraction {steady_bubble:.3f} "
+            ">= 0.15 — the chunked loop is leaving the device idle")
     cal_tokens = sum(len(r.tokens) for r in ref_results)
     capacity_tps = cal_tokens / cal_dt
     capacity_rps = capacity_tps / max_new_tokens
@@ -111,6 +128,11 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     for k in range(1, max_batch + 1):
         fe_engine.run(list(prompts[:k]), max_new_tokens=max_new_tokens)
     fe_engine.run(list(prompts), max_new_tokens=max_new_tokens)
+    # chunk-timeline profiler: attached after warmup so compile time never
+    # pollutes the attribution; cleared at the overload boundary so the
+    # committed profile block covers exactly the overload window
+    profiler = ChunkProfiler()
+    fe_engine.profiler = profiler
     frontend = ServingFrontend(
         fe_engine,
         admission=AdmissionConfig(max_pending=n_requests + 8),
@@ -149,6 +171,11 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     parity = True
     # the parity pass also warmed the frontend's throughput estimator, so
     # the overload phase sheds against a measured rate from step one
+    parity_rep = profiler.profile_report()
+    if parity_rep["n_chunks"] and not parity_rep["attribution_ok"]:
+        raise RuntimeError(
+            "parity-window chunk attribution does not sum to wall: "
+            f"{parity_rep['attribution_error_frac']:.3f} error fraction")
 
     # ---- phase 3: open-loop overload with mixed priorities -------------
     # low-priority deadline: roughly the unloaded service time of a few
@@ -158,6 +185,7 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
     n_high = 0
     load_handles = []
     stats_before = telemetry.get_runtime().span_stats()
+    profiler.clear()        # overload-phase-only attribution from here
     t_start = time.perf_counter()
     for i in range(n_requests):
         # open loop: the i-th arrival is scheduled at t_start + i*interval
@@ -205,6 +233,28 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         readyz_code = resp.status
     if readyz_code != 200:
         raise RuntimeError(f"/readyz answered {readyz_code} while serving")
+    # live /tenants fetch + tenant-labelled series in the same scrape:
+    # parity traffic lands under "default", overload traffic under
+    # "interactive"/"bulk" — all three must round-trip through HTTP
+    with urllib.request.urlopen(f"{metrics_server.url}/tenants",
+                                timeout=10) as resp:
+        tenants_payload = json.loads(resp.read().decode("utf-8"))
+    if tenants_payload.get("schema") != "dstpu-tenants-v1":
+        raise RuntimeError(
+            f"/tenants schema {tenants_payload.get('schema')!r} != "
+            "dstpu-tenants-v1")
+    seen_tenants = set(tenants_payload.get("tenants", {}))
+    if not {"interactive", "bulk", "default"} <= seen_tenants:
+        raise RuntimeError(
+            f"/tenants is missing expected tenants: saw {sorted(seen_tenants)}")
+    goodput_family = "dstpu_frontend_goodput_fraction"
+    labelled = {labels.get("tenant")
+                for labels, _ in parsed["samples"].get(goodput_family, [])
+                if "tenant" in labels}
+    if not {"interactive", "bulk", "default"} <= labelled:
+        raise RuntimeError(
+            f"/metrics carries no per-tenant {goodput_family} series "
+            f"(saw tenant labels {sorted(labelled)})")
     # live /slo fetch: the endpoint evaluates the rolling windows on GET
     # and exports slo/* gauges — verified by a second /metrics scrape
     slo_block = None
@@ -274,6 +324,27 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         mfu["scan_body_counted_once"] = cost["scan_body_counted_once"]
     # HBM accounting: same after-the-audit placement as cost analysis
     hbm = fe_engine.estimate_hbm()
+    # overload-window chunk attribution. The mixed long-prompt arrival
+    # process admits prefills while decode batches are live, so the
+    # decode-behind-prefill stall (ROADMAP item 4) must show up here.
+    profile_rep = profiler.profile_report()
+    problems = validate_report(profile_rep)
+    if problems:
+        raise RuntimeError(f"profile report failed validation: {problems}")
+    if not profile_rep["attribution_ok"]:
+        raise RuntimeError(
+            "overload chunk attribution does not sum to wall: "
+            f"{profile_rep['attribution_error_frac']:.3f} error fraction")
+    if profile_rep["prefill"]["stall_s"] <= 0.0:
+        raise RuntimeError(
+            "no decode-blocking prefill stall was attributed under the "
+            "mixed overload workload — the stall accounting regressed")
+    profile_rep["steady_state"] = {
+        "bubble_fraction": round(steady_bubble, 4),
+        "attribution_ok": steady["attribution_ok"],
+        "n_chunks": steady["n_chunks"],
+    }
+    profile_rep["stalled_prefills_seen"] = 1.0
     if trace_out:
         # one Perfetto file: engine/driver thread lanes + per-request
         # frontend lanes with submit->finish flow arrows
@@ -340,6 +411,15 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         "hbm": _round_tree(hbm) if hbm else None,
         "metrics_scrape": metrics_scrape,
         "slo": slo_block,
+        # chunk-timeline attribution (overload window + steady-state
+        # summary); `bin/tputrace profile` consumes this block directly
+        "profile": _round_tree(profile_rep),
+        "tenant_goodput": {
+            "endpoint_ok": 1.0,
+            "labelled_series_ok": 1.0,
+            "n_tenants": tenants_payload["n_tenants"],
+            "tenants": _round_tree(tenants_payload["tenants"]),
+        },
         "trace_file": trace_out,
     }
 

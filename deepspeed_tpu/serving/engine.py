@@ -126,6 +126,9 @@ class _InflightChunk:
     valid: Any           # [B, K] device (lane was live entering the step)
     state: Tuple         # (tok[B], pos[B], act[B], rem[B], eos[B]) device,
     #                      + hist[B, S] in speculative mode
+    # dispatch-complete stamp (profiler clock); 0.0 when no profiler is
+    # attached — the chunk timeline lane anchors device spans on it
+    launch_t: float = 0.0
 
 
 class ServingEngine:
@@ -334,6 +337,12 @@ class ServingEngine:
         # the owning ServingFrontend; engine-side records are host-only
         # deque appends — no device work, no retrace surface
         self.flight = None
+        # chunk-timeline profiler (telemetry.profiler.ChunkProfiler),
+        # attached externally the same way; every hook site is guarded by
+        # a None check so the detached cost is one attribute load, and
+        # the hooks themselves are perf_counter stamps + deque appends —
+        # no device work, no retrace surface
+        self.profiler = None
 
         mat = engine._materialize
         module = self.module
@@ -813,6 +822,11 @@ class ServingEngine:
         (the dense path verbatim; paged misses ride it too, with the
         block-scatter insert and a prefix-cache commit per request)."""
         import jax.numpy as jnp
+        prof = self.profiler
+        # decode slots live beyond this admission batch: every prefill
+        # below pushes their next chunk launch out — the ROADMAP item-4
+        # stall the profiler accounts as prefill_stall_s
+        n_decoding = len(self.scheduler.running) - len(admitted)
         groups: Dict[int, List[Request]] = {}
         for req in admitted:
             groups.setdefault(self._bucket_for(req.prompt_len),
@@ -833,6 +847,7 @@ class ServingEngine:
             self._prefill_shapes.add((n, bucket))
             # np.asarray(toks) below is the host sync, so the span covers
             # dispatch + device prefill + arena insert honestly
+            pt0 = prof.clock() if prof is not None else 0.0
             with telemetry.span("serve/prefill", n=n, bucket=bucket):
                 toks, cache = self._jit_prefill(
                     self._prefill_params, jnp.asarray(ids),
@@ -865,6 +880,9 @@ class ServingEngine:
                             uids=[r.uid for r in reqs])
                 self.kv.insert_batch(cache, [r.slot for r in reqs], lens)
                 toks_host = np.asarray(toks)
+            if prof is not None:
+                prof.on_prefill(pt0, prof.clock(), n=n, bucket=bucket,
+                                stalled=n_decoding > 0)
             telemetry.count("serve/prefill_tokens", float(lens.sum()))
             self.metrics.on_prefill(n, bucket, int(lens.sum()),
                                     len(self._prefill_shapes))
@@ -1035,6 +1053,8 @@ class ServingEngine:
         """Enqueue one K-step decode chunk (returns immediately — JAX
         async dispatch; nothing here blocks on device results)."""
         import jax.numpy as jnp
+        prof = self.profiler
+        t0 = prof.clock() if prof is not None else 0.0
         # dispatch-only span BY DESIGN (no sync=): the chunk is meant to
         # run asynchronously; the honest device wait is measured at
         # consume time as serve/chunk_host_wait
@@ -1060,6 +1080,10 @@ class ServingEngine:
         inflight = _InflightChunk(
             slot_uids={s: r.uid for s, r in self.scheduler.running.items()},
             tokens=toks, valid=valid, state=carry)
+        if prof is not None:
+            t1 = prof.clock()
+            inflight.launch_t = t1
+            prof.on_launch(t0, t1, n_slots=len(inflight.slot_uids))
         if self.flight is not None:
             self.flight.record("chunk_launch", k=self.decode_chunk,
                                slot_uids=dict(inflight.slot_uids))
@@ -1068,9 +1092,12 @@ class ServingEngine:
     def _consume_chunk(self, chunk: _InflightChunk) -> List[Request]:
         """Block on the chunk's token buffer (the ONE host sync per K
         steps) and feed it through the scheduler."""
+        prof = self.profiler
+        hw0 = prof.clock() if prof is not None else 0.0
         with telemetry.span("serve/chunk_host_wait"):
             toks = np.asarray(chunk.tokens)
             valid = np.asarray(chunk.valid)
+        rt0 = prof.clock() if prof is not None else 0.0
         with telemetry.span("serve/chunk_retire"):
             per_slot: Dict[int, List[int]] = {}
             for slot, uid in chunk.slot_uids.items():
@@ -1083,7 +1110,9 @@ class ServingEngine:
                     per_slot[slot] = seq
                     self._last_token[slot] = seq[-1]
             finished = self.scheduler.step_tokens_chunk(per_slot)
+        rt1 = prof.clock() if prof is not None else 0.0
         n_tokens = sum(len(v) for v in per_slot.values())
+        proposed = accepted = 0
         if self.flight is not None:
             self.flight.record("chunk_retire", n_tokens=n_tokens,
                                finished=[r.uid for r in finished],
@@ -1117,6 +1146,12 @@ class ServingEngine:
             telemetry.gauge("serve/arena_headroom_bytes",
                             float(self.kv.allocator.n_free
                                   * self._arena_bytes_per_slot))
+        if prof is not None:
+            prof.on_chunk(launch_t=chunk.launch_t, hw0=hw0,
+                          hw1=rt0, rt0=rt0, rt1=rt1,
+                          n_tokens=n_tokens,
+                          occupancy=float(self.kv.occupancy),
+                          proposed=proposed, accepted=accepted)
         self.metrics.on_tokens(n_tokens)
         self.metrics.on_decode_step()
         self.metrics.on_finished(finished)

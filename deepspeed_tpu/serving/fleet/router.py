@@ -493,6 +493,32 @@ class FleetRouter:
             for r in self.replicas}
         return out
 
+    def tenants_report(self) -> Dict[str, Any]:
+        """Fleet-wide per-tenant goodput: every replica's ``TraceLog``
+        tenant aggregates plus merged token/goodput totals (reservoir
+        percentiles don't merge — read them per replica)."""
+        per_replica = {r.rid: r.frontend.tracing.tenants_report()
+                       for r in self.replicas}
+        merged: Dict[str, Dict[str, Any]] = {}
+        for rep in per_replica.values():
+            for tenant, t in rep.get("tenants", {}).items():
+                m = merged.setdefault(tenant, {
+                    "n_requests": 0, "total_tokens": 0,
+                    "goodput_tokens": 0})
+                m["n_requests"] += t.get("n_requests", 0)
+                m["total_tokens"] += t.get("total_tokens", 0)
+                m["goodput_tokens"] += t.get("goodput_tokens", 0)
+        for m in merged.values():
+            m["goodput_fraction"] = (
+                m["goodput_tokens"] / m["total_tokens"]
+                if m["total_tokens"] else 1.0)
+        return {
+            "schema": "dstpu-fleet-tenants-v1",
+            "n_tenants": len(merged),
+            "tenants": merged,
+            "per_replica": per_replica,
+        }
+
     # ----------------------------------------------------------- journeys
     def journey_journal(self) -> Dict[str, Any]:
         """The router's journey input for ``telemetry.journey``:

@@ -302,7 +302,7 @@ class ServingFrontend:
                       eos_token_id=eos_token_id,
                       deadline_s=(now + deadline_s)
                       if deadline_s is not None else None,
-                      trace_id=trace_id)
+                      trace_id=trace_id, tenant=tenant)
         handle = StreamHandle(req, self, tenant=tenant, priority=priority,
                               slo_ttft_s=slo_ttft_s, submit_t=now,
                               trace_id=trace_id)
@@ -555,6 +555,7 @@ class ServingFrontend:
         req.submit_t = None
         req.first_token_t = None
         req.finish_t = None
+        req.tenant = handle.tenant
         handle._pushed = 0
         handle._prefill_marked = False
         handle._frontend = self

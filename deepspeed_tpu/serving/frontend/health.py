@@ -219,6 +219,10 @@ class HealthMonitor:
       .SLOEngine`'s fastest-window burn rate exceeds the threshold.
       Burning the error budget that fast means the replica is degraded
       even if every liveness probe still answers;
+    * ``anomaly`` — opt-in: an :class:`~deepspeed_tpu.telemetry.anomaly
+      .AnomalyDetector` whose tripped state degrades the replica
+      (reason ``anomaly``, the tripped metrics in the details) until
+      the detector re-arms;
     * ``checks`` — extra ``name -> callable() -> bool`` probes.
     """
 
@@ -227,7 +231,8 @@ class HealthMonitor:
                  checks: Optional[Dict[str, Callable[[], bool]]] = None,
                  queue_saturation: float = 0.95,
                  slo=None,
-                 slo_fast_burn_threshold: Optional[float] = None):
+                 slo_fast_burn_threshold: Optional[float] = None,
+                 anomaly=None):
         self.frontend = frontend
         self.watchdog = watchdog
         self.checks = dict(checks or {})
@@ -236,6 +241,7 @@ class HealthMonitor:
         self.slo_fast_burn_threshold = (
             None if slo_fast_burn_threshold is None
             else float(slo_fast_burn_threshold))
+        self.anomaly = anomaly
 
     def check(self) -> Tuple[bool, List[str], Dict[str, Any]]:
         reasons: List[str] = []
@@ -274,6 +280,15 @@ class HealthMonitor:
             details["slo_fast_burn_threshold"] = self.slo_fast_burn_threshold
             if fast > self.slo_fast_burn_threshold:
                 reasons.append("slo_fast_burn")
+        if self.anomaly is not None:
+            try:
+                tripped = bool(self.anomaly.tripped)
+                details["anomaly"] = self.anomaly.trip_reasons()
+            except Exception as e:  # noqa: BLE001 — a probe never raises
+                tripped = False
+                details["anomaly_error"] = f"{type(e).__name__}: {e}"
+            if tripped:
+                reasons.append("anomaly")
         for name, probe in self.checks.items():
             try:
                 ok = bool(probe())

@@ -37,9 +37,72 @@ from collections import OrderedDict, deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
 from ..metrics import Reservoir
+from ...telemetry.core import count as _telemetry_count
+from ...telemetry.core import gauge as _telemetry_gauge
 
 #: canonical span event names, in lifecycle order
 EVENTS = ("submitted", "admitted", "prefill", "first_token", "finish")
+
+#: /tenants payload schema
+TENANTS_SCHEMA = "dstpu-tenants-v1"
+
+
+class _TenantStats:
+    """Per-tenant terminal aggregates. Goodput counts the tokens of
+    requests that finished ``done`` without missing their TTFT SLO —
+    requests with no SLO set count as good (delivered tokens with no
+    target are not a miss), so untargeted traffic never reads as zero
+    goodput."""
+
+    __slots__ = ("counts", "total_tokens", "goodput_tokens",
+                 "n_slo_scored", "n_slo_met", "ttft", "tpot")
+
+    def __init__(self, reservoir_capacity: int):
+        self.counts: Dict[str, int] = {}
+        self.total_tokens = 0
+        self.goodput_tokens = 0
+        self.n_slo_scored = 0
+        self.n_slo_met = 0
+        self.ttft = Reservoir(reservoir_capacity)
+        self.tpot = Reservoir(reservoir_capacity)
+
+    def fold(self, trace: "RequestTrace") -> None:
+        status = trace.status or "unknown"
+        self.counts[status] = self.counts.get(status, 0) + 1
+        self.total_tokens += trace.n_tokens
+        met = trace.slo_ttft_met
+        if met is not None:
+            self.n_slo_scored += 1
+            self.n_slo_met += int(met)
+        if status == "done" and met is not False:
+            self.goodput_tokens += trace.n_tokens
+        if trace.ttft_s is not None:
+            self.ttft.add(trace.ttft_s)
+        if trace.tpot_s is not None:
+            self.tpot.add(trace.tpot_s)
+
+    @property
+    def goodput_fraction(self) -> float:
+        if self.total_tokens <= 0:
+            return 1.0
+        return self.goodput_tokens / self.total_tokens
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "requests": dict(self.counts),
+            "n_requests": sum(self.counts.values()),
+            "total_tokens": self.total_tokens,
+            "goodput_tokens": self.goodput_tokens,
+            "goodput_fraction": self.goodput_fraction,
+            "slo": {"scored": self.n_slo_scored,
+                    "met": self.n_slo_met},
+            "ttft_s": {"p50": self.ttft.percentile(50),
+                       "p95": self.ttft.percentile(95),
+                       "n": self.ttft.n_seen},
+            "tpot_s": {"p50": self.tpot.percentile(50),
+                       "p95": self.tpot.percentile(95),
+                       "n": self.tpot.n_seen},
+        }
 
 
 class RequestTrace:
@@ -165,6 +228,11 @@ class TraceLog:
             name: Reservoir(reservoir_capacity)
             for name in self._HISTOGRAMS}
         self.counters: Dict[str, int] = {}
+        # per-tenant goodput/latency aggregates, keyed by the tenant
+        # label each trace carries (untagged records fold under
+        # "default" — aggregation never silently drops them)
+        self._reservoir_capacity = int(reservoir_capacity)
+        self._tenants: Dict[str, _TenantStats] = {}
         self._emit_seq = 0
         # terminal-record fan-out (SLO engine): called OUTSIDE the lock
         self._listeners: List[Callable[[RequestTrace], None]] = []
@@ -234,7 +302,22 @@ class TraceLog:
                 v = getattr(trace, name)
                 if v is not None:
                     self.histograms[name].add(v)
+            tenant = getattr(trace, "tenant", None) or "default"
+            stats = self._tenants.get(tenant)
+            if stats is None:
+                stats = self._tenants[tenant] = _TenantStats(
+                    self._reservoir_capacity)
+            stats.fold(trace)
+            goodput = stats.goodput_fraction
             self._done.append(trace)
+        # tenant-labelled series on /metrics: the embedded-label names
+        # ride the same split_embedded_labels mechanism replica labels
+        # use (and compose with them — name|tenant=a|replica=0)
+        _telemetry_gauge(f"frontend/goodput_fraction|tenant={tenant}",
+                         float(goodput))
+        if trace.n_tokens:
+            _telemetry_count(f"frontend/tenant_tokens|tenant={tenant}",
+                             float(trace.n_tokens))
         for fn in self._listeners:
             try:
                 fn(trace)
@@ -280,6 +363,19 @@ class TraceLog:
         """Locked copy of the terminal-status counters."""
         with self._lock:
             return dict(self.counters)
+
+    def tenants_report(self) -> Dict[str, Any]:
+        """Per-tenant goodput accounting (the ``/tenants`` endpoint
+        payload): terminal counts, tokens delivered within SLO vs
+        total, and TTFT/TPOT reservoir percentiles per tenant."""
+        with self._lock:
+            tenants = {t: s.to_dict()
+                       for t, s in sorted(self._tenants.items())}
+        return {
+            "schema": TENANTS_SCHEMA,
+            "n_tenants": len(tenants),
+            "tenants": tenants,
+        }
 
     def emit(self, sample: Optional[int] = None) -> Dict[str, float]:
         """Write the snapshot through the monitor fan-out (no-op without

@@ -51,6 +51,11 @@ class Request:
     # distributed trace id (fleet journeys): minted at submit by the
     # frontend/router, preserved across a crash-reroute
     trace_id: Optional[str] = None
+    # billing/accounting identity — admission rate-limits per tenant and
+    # TraceLog aggregates per-tenant goodput under this label; direct
+    # engine callers that never set one land in the "default" bucket so
+    # aggregation never silently drops untagged requests
+    tenant: str = "default"
 
     # ---- filled in by the scheduler ----
     status: str = "new"   # new|queued|running|done|expired|rejected|cancelled

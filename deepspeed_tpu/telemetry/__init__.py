@@ -54,6 +54,10 @@ from .journey import (PID_JOURNEYS, assemble_journeys,  # noqa: F401
 from .slo import SLOEngine, SLOSpec, default_slos  # noqa: F401
 from .flight_recorder import (FlightRecorder, dump_all,  # noqa: F401
                               install_sigterm_handler)
+from .profiler import (PID_DEVICE, ChunkProfiler,  # noqa: F401
+                       validate_report)
+from .anomaly import (AnomalyDetector, AnomalySpec,  # noqa: F401
+                      default_specs)
 
 __all__ = [
     "TelemetryRuntime", "get_runtime", "configure", "enable", "disable",
@@ -69,4 +73,6 @@ __all__ = [
     "journey_trace_events", "validate_journeys", "summarize_journeys",
     "SLOSpec", "SLOEngine", "default_slos",
     "FlightRecorder", "install_sigterm_handler", "dump_all",
+    "PID_DEVICE", "ChunkProfiler", "validate_report",
+    "AnomalySpec", "AnomalyDetector", "default_specs",
 ]

@@ -1,5 +1,5 @@
 """Prometheus text exposition + the /metrics · /healthz · /readyz ·
-/slo server.
+/slo · /tenants server.
 
 Everything observable in-process — :class:`TelemetryRuntime`
 counters/gauges/span reservoirs, the serving frontend's ``TraceLog``
@@ -260,6 +260,14 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(report),
                                "application/json")
+            elif path == "/tenants":
+                report = ms.tenants_report()
+                if report is None:
+                    self._send(404, "no tracelog wired\n",
+                               "text/plain")
+                else:
+                    self._send(200, json.dumps(report),
+                               "application/json")
             else:
                 self._send(404, "not found\n", "text/plain")
         except BrokenPipeError:
@@ -281,8 +289,10 @@ class MetricsServer:
     anything with that signature) and answers 503 with machine-readable
     reasons when not ready. ``GET /slo`` serves the wired
     :class:`~deepspeed_tpu.telemetry.slo.SLOEngine` report as JSON
-    (404 when none is wired). ``port=0`` binds an ephemeral port (read
-    it back from ``.port`` — the test/bench pattern)."""
+    (404 when none is wired), and ``GET /tenants`` serves the wired
+    TraceLog's per-tenant goodput accounting (404 without a tracelog).
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` —
+    the test/bench pattern)."""
 
     def __init__(self, *, runtime=None, tracelog=None,
                  gauges_fn: Optional[Callable[[], Mapping[str, float]]] = None,
@@ -326,6 +336,15 @@ class MetricsServer:
         if self.slo is None:
             return None
         return self.slo.report()
+
+    def tenants_report(self):
+        """The ``/tenants`` payload: the wired TraceLog's per-tenant
+        goodput accounting; None when no tracelog is wired (or it
+        predates tenant accounting)."""
+        if self.tracelog is None \
+                or not hasattr(self.tracelog, "tenants_report"):
+            return None
+        return self.tracelog.tenants_report()
 
     def stop(self) -> None:
         self._httpd.shutdown()

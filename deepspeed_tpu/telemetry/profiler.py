@@ -1,0 +1,424 @@
+"""Continuous chunk-timeline profiler for the chunked serving engine.
+
+The serving stack is observable at the request level (journeys, SLO
+burn rates, postmortems) but blind at the engine level: nobody can say
+what fraction of a decode chunk's wall time is device compute vs host
+wait vs double-buffer bubble, and the ``serve/prefill`` stall (ROADMAP
+item 4) has never been measured as *decode time lost to prefill
+preemption*. :class:`ChunkProfiler` closes that gap. It is a host-only
+accumulator the engine feeds with ``time.perf_counter`` stamps taken at
+the exact points the existing ``serve/chunk_launch`` /
+``serve/chunk_host_wait`` / ``serve/chunk_retire`` / ``serve/prefill``
+spans already bracket — no extra device work, no retrace surface, and
+the hooks are cheap enough to leave on in production (<1% of a
+dispatch-bound chunk iteration; gated in CI).
+
+Attribution model — every chunk iteration (the interval between
+consecutive chunk retirements on the engine thread) is partitioned into
+four *disjoint* host-timeline components, so they sum to the measured
+iteration wall time by construction:
+
+* ``device_compute_s`` — the ``chunk_host_wait`` sync window: with the
+  double-buffered launch, all remaining device compute for the chunk
+  materializes here as host blocking on the D2H sync.
+* ``host_wait_s`` — host-side blocking *outside* the decode chunk:
+  bucketed prefill windows (jit prefill + KV insert + sync), which are
+  serialized on the engine thread.
+* ``scheduler_s`` — chunk dispatch + retire bookkeeping (the launch
+  and retire spans).
+* ``bubble_s`` — the unaccounted remainder: double-buffer gaps where
+  neither the device sync nor scheduler work occupies the host
+  timeline (pump-loop overhead, idle waits).
+
+A prefill window is additionally counted as a *stall*
+(``prefill_stall_s``) when decode slots beyond the batch being
+prefilled were running — i.e. the next decode launch was pushed out by
+prefill. That is the ROADMAP item-4 number, finally quantified.
+
+The profiler also tracks rolling occupancy and speculative-acceptance
+goodput per chunk, exports ``serve/bubble_fraction`` and
+``serve/prefill_stall_s`` gauges through the telemetry runtime, renders
+a ``profile_report()`` JSON (consumed by ``bin/tputrace profile`` and
+the bench ``profile`` blocks), and emits a pid-``4`` "device timeline"
+lane for the Chrome/Perfetto export via :meth:`trace_events`.
+
+Stdlib-only; safe to import without JAX.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .core import gauge as _telemetry_gauge
+
+SCHEMA = "dstpu-profile-v1"
+
+#: chrome/perfetto pid for the device-timeline lane (1 = runtime spans,
+#: 2 = request lanes, 3 = journeys)
+PID_DEVICE = 4
+
+#: attribution components, in report order
+COMPONENTS = ("device_compute_s", "host_wait_s", "scheduler_s",
+              "bubble_s")
+
+# per-chunk record tuple layout (tuples, not dicts: the record append is
+# on the hot path and must stay inside the <1% overhead gate)
+_R_ITER_START, _R_LAUNCH_T, _R_HW0, _R_HW1, _R_RT0, _R_RT1, \
+    _R_LAUNCHES, _R_WALL, _R_DEVICE, _R_HOSTW, _R_SCHED, _R_BUBBLE, \
+    _R_NTOK, _R_OCC, _R_PROPOSED, _R_ACCEPTED = range(16)
+
+_REC_KEYS = ("iter_start", "launch_t", "hw0", "hw1", "rt0", "rt1",
+             "launches", "wall_s", "device_compute_s", "host_wait_s",
+             "scheduler_s", "bubble_s", "n_tokens", "occupancy",
+             "proposed", "accepted")
+
+
+class ChunkProfiler:
+    """Host-only chunk-iteration profiler.
+
+    Attach with ``engine.profiler = ChunkProfiler()`` — the engine
+    guards every hook with ``if self.profiler is not None`` so the
+    default (detached) cost is one attribute load per site.
+
+    ``window`` bounds the rolling statistics (bubble fraction,
+    occupancy); ``keep_last`` bounds the retained per-chunk records
+    that feed the Perfetto lane; ``gauge_every`` throttles gauge
+    exports to one per N chunks so the hot path stays lock-light."""
+
+    def __init__(self, *, window: int = 256, keep_last: int = 512,
+                 gauge_every: int = 32,
+                 clock: Callable[[], float] = time.perf_counter,
+                 gauge_fn: Optional[Callable[[str, float], None]] = None):
+        self.clock = clock
+        self._gauge = gauge_fn if gauge_fn is not None \
+            else _telemetry_gauge
+        self.gauge_every = max(1, int(gauge_every))
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=int(keep_last))
+        self._prefill_records: deque = deque(maxlen=int(keep_last))
+        self._rolling: deque = deque(maxlen=int(window))
+        # scratch windows folded into the next chunk record
+        self._pending_launches: List[Any] = []
+        self._pending_prefills: List[Any] = []
+        self._iter_end: Optional[float] = None
+        # cumulative totals
+        self.n_chunks = 0
+        self.wall_s = 0.0
+        self.device_compute_s = 0.0
+        self.host_wait_s = 0.0
+        self.scheduler_s = 0.0
+        self.bubble_s = 0.0
+        self.n_tokens = 0
+        self.n_prefills = 0
+        self.prefill_s = 0.0
+        self.prefill_stall_s = 0.0
+        self.n_stalled_prefills = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
+    # ------------------------------------------------------------ hooks
+    #
+    # The hooks run on the engine driver thread only (single writer);
+    # the lock exists so report/trace readers see consistent snapshots.
+    # ``on_launch`` skips it entirely: under the GIL ``list.append`` is
+    # atomic and ``_pending_launches`` is never read outside the
+    # on_chunk swap on the same thread.
+    def on_launch(self, t0: float, t1: float, n_slots: int = 0) -> None:
+        """One chunk dispatch window (the ``serve/chunk_launch``
+        span). Folded into the iteration that retires next."""
+        self._pending_launches.append((t0, t1, n_slots))
+
+    def on_prefill(self, t0: float, t1: float, *, n: int = 0,
+                   bucket: int = 0, stalled: bool = False) -> None:
+        """One bucketed prefill window (the ``serve/prefill`` span).
+        ``stalled`` marks that decode slots beyond the prefilled batch
+        were running — the window delayed the next decode launch."""
+        rec = (t0, t1, n, bucket, bool(stalled))
+        with self._lock:
+            self._pending_prefills.append(rec)
+            self.n_prefills += 1
+            dur = max(t1 - t0, 0.0)
+            self.prefill_s += dur
+            if stalled:
+                self.prefill_stall_s += dur
+                self.n_stalled_prefills += 1
+            self._prefill_records.append(rec)
+
+    def on_chunk(self, launch_t: float, hw0: float, hw1: float,
+                 rt0: float, rt1: float, n_tokens: int = 0,
+                 occupancy: float = 0.0, proposed: int = 0,
+                 accepted: int = 0) -> None:
+        """One chunk retirement: close out the iteration and attribute
+        its wall time. ``launch_t`` is the dispatch-complete stamp of
+        the chunk being retired; ``hw0..hw1`` the host-wait sync
+        window; ``rt0..rt1`` the retire bookkeeping window."""
+        with self._lock:
+            launches = self._pending_launches
+            if launches:
+                self._pending_launches = []
+            else:
+                launches = ()     # shared immutable — no aliasing risk
+            prefills = self._pending_prefills
+            if prefills:
+                self._pending_prefills = []
+            else:
+                prefills = ()
+            iter_start = self._iter_end
+            if iter_start is None:
+                # first chunk: open the window at the earliest stamp we
+                # saw so warmup launches/prefills attribute cleanly
+                candidates = [hw0]
+                if launch_t:
+                    candidates.append(launch_t)
+                candidates.extend(t0 for t0, _, _ in launches)
+                candidates.extend(p[0] for p in prefills)
+                iter_start = min(candidates)
+            self._iter_end = rt1
+            wall = rt1 - iter_start
+            if wall < 0.0:
+                wall = 0.0
+            device = hw1 - hw0
+            sched = rt1 - rt0
+            for lt0, lt1, _ in launches:
+                sched += lt1 - lt0
+            hostw = 0.0
+            for p in prefills:
+                hostw += p[1] - p[0]
+            bubble = wall - device - sched - hostw
+            if bubble < 0.0:
+                bubble = 0.0
+            self.n_chunks += 1
+            self.wall_s += wall
+            self.device_compute_s += device
+            self.host_wait_s += hostw
+            self.scheduler_s += sched
+            self.bubble_s += bubble
+            self.n_tokens += n_tokens
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            self._rolling.append((wall, bubble, occupancy))
+            self._records.append((iter_start, launch_t, hw0, hw1, rt0,
+                                  rt1, launches, wall, device, hostw,
+                                  sched, bubble, n_tokens, occupancy,
+                                  proposed, accepted))
+            emit = (self.n_chunks % self.gauge_every) == 0
+            if emit:
+                bf = self._bubble_fraction_locked()
+                stall = self.prefill_stall_s
+        if emit:
+            self._gauge("serve/bubble_fraction", float(bf))
+            self._gauge("serve/prefill_stall_s", float(stall))
+
+    # ------------------------------------------------------- derivation
+    def _bubble_fraction_locked(self) -> float:
+        tw = 0.0
+        tb = 0.0
+        for w, b, _ in self._rolling:
+            tw += w
+            tb += b
+        return tb / tw if tw > 0.0 else 0.0
+
+    def bubble_fraction(self) -> float:
+        """Rolling bubble fraction over the last ``window`` chunks."""
+        with self._lock:
+            return self._bubble_fraction_locked()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._prefill_records.clear()
+            self._rolling.clear()
+            self._pending_launches = []
+            self._pending_prefills = []
+            self._iter_end = None
+            self.n_chunks = 0
+            self.wall_s = 0.0
+            self.device_compute_s = 0.0
+            self.host_wait_s = 0.0
+            self.scheduler_s = 0.0
+            self.bubble_s = 0.0
+            self.n_tokens = 0
+            self.n_prefills = 0
+            self.prefill_s = 0.0
+            self.prefill_stall_s = 0.0
+            self.n_stalled_prefills = 0
+            self.spec_proposed = 0
+            self.spec_accepted = 0
+
+    def profile_report(self, *, timeline: int = 0) -> Dict[str, Any]:
+        """The profiler's JSON payload. Components are disjoint
+        host-timeline intervals, so ``attribution_error_frac`` is ~0
+        by construction — ``bin/tputrace profile --validate`` and the
+        bench ``profile`` blocks gate on it staying under 5%.
+        ``timeline`` > 0 inlines the last N chunk records."""
+        with self._lock:
+            comp_sum = (self.device_compute_s + self.host_wait_s
+                        + self.scheduler_s + self.bubble_s)
+            err = abs(self.wall_s - comp_sum) / self.wall_s \
+                if self.wall_s > 0 else 0.0
+            occs = sorted(o for _, _, o in self._rolling)
+            rep: Dict[str, Any] = {
+                "schema": SCHEMA,
+                "n_chunks": self.n_chunks,
+                "n_tokens": self.n_tokens,
+                "wall_s": self.wall_s,
+                "components": {
+                    "device_compute_s": self.device_compute_s,
+                    "host_wait_s": self.host_wait_s,
+                    "scheduler_s": self.scheduler_s,
+                    "bubble_s": self.bubble_s,
+                },
+                "fractions": {
+                    k: (v / self.wall_s if self.wall_s > 0 else 0.0)
+                    for k, v in (
+                        ("device_compute", self.device_compute_s),
+                        ("host_wait", self.host_wait_s),
+                        ("scheduler", self.scheduler_s),
+                        ("bubble", self.bubble_s),
+                    )
+                },
+                "attribution_error_frac": err,
+                "attribution_ok": bool(err <= 0.05),
+                "bubble_fraction": self._bubble_fraction_locked(),
+                "prefill": {
+                    "n": self.n_prefills,
+                    "total_s": self.prefill_s,
+                    "stall_s": self.prefill_stall_s,
+                    "n_stalled": self.n_stalled_prefills,
+                },
+                "occupancy": {
+                    "mean": (sum(occs) / len(occs)) if occs else 0.0,
+                    "p50": _pct(occs, 0.50),
+                    "p95": _pct(occs, 0.95),
+                },
+                "goodput": {
+                    "spec_proposed": self.spec_proposed,
+                    "spec_accepted": self.spec_accepted,
+                    "spec_acceptance": (
+                        self.spec_accepted / self.spec_proposed
+                        if self.spec_proposed else None),
+                    "tokens_per_chunk": (
+                        self.n_tokens / self.n_chunks
+                        if self.n_chunks else 0.0),
+                },
+            }
+            if timeline > 0:
+                rep["timeline"] = [
+                    dict(zip(_REC_KEYS, r))
+                    for r in list(self._records)[-timeline:]]
+            return rep
+
+    def report(self) -> Dict[str, Any]:
+        """Alias of :meth:`profile_report` (endpoint convention)."""
+        return self.profile_report()
+
+    # ---------------------------------------------------- chrome export
+    def trace_events(self, *, pid: int = PID_DEVICE,
+                     clock_offset_s: float = 0.0) -> List[Dict[str, Any]]:
+        """Chrome-trace events for the pid-``pid`` "device timeline"
+        process: tid 0 device chunks (launch→sync-done), tid 1 host
+        sync windows, tid 2 prefill windows, tid 3 scheduler
+        (dispatch + retire). Merge via
+        ``write_chrome_trace(..., extra_events=prof.trace_events())``."""
+
+        def us(t: float) -> int:
+            return int(round((t + clock_offset_s) * 1e6))
+
+        events: List[Dict[str, Any]] = [
+            {"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+             "args": {"name": "device timeline"}},
+            {"ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
+             "args": {"name": "decode chunk"}},
+            {"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+             "args": {"name": "host sync"}},
+            {"ph": "M", "pid": pid, "tid": 2, "name": "thread_name",
+             "args": {"name": "prefill"}},
+            {"ph": "M", "pid": pid, "tid": 3, "name": "thread_name",
+             "args": {"name": "scheduler"}},
+        ]
+        with self._lock:
+            recs = list(self._records)
+            pfs = list(self._prefill_records)
+        for r in recs:
+            launch_t = r[_R_LAUNCH_T]
+            hw0, hw1 = r[_R_HW0], r[_R_HW1]
+            n_tok = r[_R_NTOK]
+            dev0 = launch_t if launch_t else hw0
+            events.append({
+                "ph": "X", "pid": pid, "tid": 0, "cat": "device",
+                "name": "chunk", "ts": us(dev0),
+                "dur": max(us(hw1) - us(dev0), 0),
+                "args": {"n_tokens": n_tok,
+                         "occupancy": r[_R_OCC],
+                         "bubble_s": r[_R_BUBBLE]},
+            })
+            events.append({
+                "ph": "X", "pid": pid, "tid": 1, "cat": "device",
+                "name": "host_wait", "ts": us(hw0),
+                "dur": max(us(hw1) - us(hw0), 0),
+                "args": {},
+            })
+            for t0, t1, n_slots in r[_R_LAUNCHES]:
+                events.append({
+                    "ph": "X", "pid": pid, "tid": 3, "cat": "device",
+                    "name": "launch", "ts": us(t0),
+                    "dur": max(us(t1) - us(t0), 0),
+                    "args": {"n_slots": n_slots},
+                })
+            events.append({
+                "ph": "X", "pid": pid, "tid": 3, "cat": "device",
+                "name": "retire", "ts": us(r[_R_RT0]),
+                "dur": max(us(r[_R_RT1]) - us(r[_R_RT0]), 0),
+                "args": {"n_tokens": n_tok},
+            })
+        for t0, t1, n, bucket, stalled in pfs:
+            events.append({
+                "ph": "X", "pid": pid, "tid": 2, "cat": "device",
+                "name": "prefill", "ts": us(t0),
+                "dur": max(us(t1) - us(t0), 0),
+                "args": {"n": n, "bucket": bucket,
+                         "stalled": stalled},
+            })
+        return events
+
+
+def _pct(sorted_xs: List[float], q: float) -> float:
+    """Linear-interpolated quantile over a sorted list (matches
+    ``serving.metrics.Reservoir.percentile``)."""
+    if not sorted_xs:
+        return 0.0
+    pos = q * (len(sorted_xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_xs) - 1)
+    return sorted_xs[lo] + (pos - lo) * (sorted_xs[hi] - sorted_xs[lo])
+
+
+def validate_report(report: Dict[str, Any], *,
+                    tolerance: float = 0.05) -> List[str]:
+    """Attribution-conservation check used by ``tputrace profile
+    --validate``: the four components must sum to the measured wall
+    time within ``tolerance`` and no component may be negative.
+    Returns a list of human-readable problems (empty = valid)."""
+    problems: List[str] = []
+    comps = report.get("components", {})
+    for k in COMPONENTS:
+        v = comps.get(k)
+        if not isinstance(v, (int, float)):
+            problems.append(f"missing component {k}")
+        elif v < 0:
+            problems.append(f"negative component {k}: {v}")
+    wall = report.get("wall_s")
+    if not isinstance(wall, (int, float)):
+        problems.append("missing wall_s")
+    elif wall > 0:
+        total = sum(v for v in (comps.get(k) for k in COMPONENTS)
+                    if isinstance(v, (int, float)))
+        err = abs(wall - total) / wall
+        if err > tolerance:
+            problems.append(
+                f"components sum to {total:.6f}s but wall is "
+                f"{wall:.6f}s (error {err:.1%} > {tolerance:.0%})")
+    return problems

@@ -137,6 +137,32 @@ FRONTEND_SPECS: List[MetricSpec] = [
                note="the bench GETs /slo live and checks its schema"),
     MetricSpec(("slo", "n_slos"), SHIFT, abs_tol=0.0,
                note="stock objective count is deterministic"),
+    # ---- chunk-timeline profiler (overload window + steady-state) ----
+    MetricSpec(("profile", "attribution_ok"), SHIFT, abs_tol=0.0,
+               note="components must sum to wall within 5%, binary"),
+    MetricSpec(("profile", "steady_state", "attribution_ok"), SHIFT,
+               abs_tol=0.0),
+    MetricSpec(("profile", "steady_state", "bubble_fraction"), LOWER,
+               0.50, abs_tol=0.08,
+               note="steady-state decode idle share; the <0.15 ceiling "
+                    "is asserted inside the bench"),
+    MetricSpec(("profile", "stalled_prefills_seen"), SHIFT, abs_tol=0.0,
+               note="the mixed overload workload must exhibit the "
+                    "decode-behind-prefill stall (ROADMAP item 4)"),
+    # ---- per-tenant goodput accounting (live /tenants self-fetch) ----
+    MetricSpec(("tenant_goodput", "endpoint_ok"), SHIFT, abs_tol=0.0,
+               note="the bench GETs /tenants live and checks its schema"),
+    MetricSpec(("tenant_goodput", "labelled_series_ok"), SHIFT,
+               abs_tol=0.0,
+               note="tenant-labelled goodput gauges round-trip through "
+                    "the /metrics scrape"),
+    MetricSpec(("tenant_goodput", "n_tenants"), SHIFT, abs_tol=0.0,
+               note="default + interactive + bulk on the pinned "
+                    "workload"),
+    MetricSpec(("tenant_goodput", "tenants", "default",
+                "goodput_fraction"), SHIFT, abs_tol=0.0,
+               note="parity traffic has no SLO and all finishes done — "
+                    "goodput is exactly 1.0"),
 ]
 
 FLEET_SPECS: List[MetricSpec] = [
@@ -224,6 +250,17 @@ FLEET_SPECS: List[MetricSpec] = [
                abs_tol=2.0,
                note="recovery-window TTFT stays bounded (wedge hold + "
                     "survivor backlog; CPU timing is noisy)"),
+    # ---- chunk-timeline profiler (busiest parity replica) ----
+    MetricSpec(("profile", "attribution_ok"), SHIFT, abs_tol=0.0,
+               note="components must sum to wall within 5%, binary"),
+    # ---- fleet-wide per-tenant goodput (router merge) ----
+    MetricSpec(("tenant_goodput", "n_tenants"), SHIFT, abs_tol=0.0,
+               note="tenant-a + tenant-b on the pinned parity workload"),
+    MetricSpec(("tenant_goodput", "tenants", "tenant-a",
+                "goodput_fraction"), SHIFT, abs_tol=0.0,
+               note="no SLO, all done — exactly 1.0"),
+    MetricSpec(("tenant_goodput", "tenants", "tenant-b",
+                "goodput_fraction"), SHIFT, abs_tol=0.0),
 ]
 
 SPEC_SETS: Dict[str, List[MetricSpec]] = {
