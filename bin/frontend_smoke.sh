@@ -4,8 +4,11 @@
 # capacity with mixed priorities and ASSERTS: streamed greedy outputs
 # bit-identical to ServingEngine.run, every admitted high-priority
 # request finishes with bounded p99 TTFT, and low-priority work sheds
-# with machine-readable reasons. Writes BENCH_frontend.json at the repo
-# root and exits nonzero on any violated bound or crash.
+# with machine-readable reasons. The default-on fused_mixed case then
+# A/Bs fused chunked prefill against bucketed under mixed long-prompt
+# bursts: bit-identical greedy, p99 TPOT >= 2x better, zero fused
+# prefill stall, short-class TTFT held. Writes BENCH_frontend.json at
+# the repo root and exits nonzero on any violated bound or crash.
 #
 # Usage: bin/frontend_smoke.sh        (from the repo root, or anywhere)
 

@@ -80,11 +80,20 @@ assert slo["n_slos"] >= 4 and slo["n_samples"] > 0, slo
 tg = d["tenant_goodput"]
 assert tg["endpoint_ok"] == 1.0 and tg["labelled_series_ok"] == 1.0, tg
 assert {"interactive", "bulk", "default"} <= set(tg["tenants"]), tg
+# fused chunked prefill under the mixed long-prompt workload: parity,
+# >= 2x p99 TPOT, and ZERO attributed prefill stall (the in-bench
+# gates raise on violation; these asserts pin the committed shape)
+fm = d["fused_mixed"]
+assert fm["greedy_parity"] is True, fm
+assert fm["tpot_p99_improvement"] >= 2.0, fm
+assert fm["profile"]["prefill"]["stall_s"] == 0.0, fm
+assert fm["bucketed_stall_s"] > 0.0, fm
 print("obs_smoke: live /metrics scrape ok "
       f"({s['n_families']} families, ttft p99="
       f"{s['ttft_quantiles_s'].get('0.99')}s, /slo "
       f"{slo['n_slos']} objectives over {slo['n_samples']} samples, "
-      f"{tg['n_tenants']} tenants)")
+      f"{tg['n_tenants']} tenants, fused p99 TPOT "
+      f"{fm['tpot_p99_improvement']}x)")
 EOF
     [ $? -ne 0 ] && fail=1
     # chunk-timeline attribution gate: the bench's profile block must

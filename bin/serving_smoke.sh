@@ -10,9 +10,12 @@
 # dense-int8 vs paged-int8 bit-identical), and the COMBINED
 # --speculative case over the int8 arena (self-drafted greedy outputs
 # bit-identical to the sequential loops, dense AND paged; >= 1.3x
-# tokens/s on the repetitive workload; acceptance rate reported).
+# tokens/s on the repetitive workload; acceptance rate reported), and
+# the default-on fused chunked-prefill A/B (prompts consumed in-scan:
+# bit-identical greedy dense AND paged, pinned fused retrace budgets,
+# zero attributed prefill stall).
 # Writes BENCH_serving.json (tokens/s for both loops, chunk_speedup,
-# prefill padding waste, the paged/speculative/int8_kv blocks) at the
+# prefill padding waste, the paged/speculative/int8_kv/fused blocks) at the
 # repo root and exits nonzero on parity failure or any crash — fast
 # enough for tier-1.
 #

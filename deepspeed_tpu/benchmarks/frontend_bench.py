@@ -47,13 +47,198 @@ def _percentile(xs, q):
         else None
 
 
+def _fused_mixed_case(tpot_gate: float = 2.0, ttft_hold_s: float = 0.25,
+                      seed: int = 0) -> dict:
+    """Mixed long-prompt/short-decode A/B: bucketed prefill vs fused.
+
+    The ROADMAP item-4 acceptance workload. A handful of interactive
+    short-prompt requests decode steadily while bursts of long prompts
+    (prompt >> prefill chunk) arrive mid-stream. With bucketed prefill
+    every long-prompt admission launches a separate wide prefill program
+    that preempts the next decode chunk — the in-flight decoders' inter-
+    token gaps spike (``prefill.stall_s`` > 0, p99 TPOT blows up). With
+    ``fused_prefill=True`` the same prompts are consumed as in-scan
+    chunks under the chunk token budget, so decode lanes keep emitting
+    every scan step and the stall never exists.
+
+    Gates (the bench FAILS, not just reports):
+      * greedy token streams bit-identical between the two modes;
+      * fused p99 TPOT over the short (interactive) class is at least
+        ``tpot_gate``x better than bucketed;
+      * the fused profile attributes zero ``prefill.stall_s`` while the
+        bucketed reference attributes a strictly positive stall (the
+        contrast the regression specs pin);
+      * fused short-class TTFT p99 stays under ``ttft_hold_s`` — the
+        chunked prompt path must not starve time-to-first-token.
+    """
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from ..serving import ServingEngine
+    from ..serving.scheduler import Request
+    from ..telemetry.profiler import ChunkProfiler
+
+    # Geometry locked by CPU A/B prototyping: the fused chunk cost is
+    # invariant to prefill load while the bucketed stall scales with the
+    # burst size, so long prompts must dominate (448 tokens vs chunk 8)
+    # and the decode cadence must be tight (decode_chunk 1) for the p99
+    # gap to be attributable to prefill preemption rather than noise.
+    short_len, long_len = 8, 448
+    n_short, n_long = 2, 8
+    burst, inject_every = 4, 2
+    max_new_short, max_new_long = 64, 2
+    max_batch, decode_chunk, prefill_chunk = 6, 1, 8
+
+    model, params = _tiny_model(max_seq_len=512)
+    vocab = model.cfg.vocab_size
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(seed)
+    short_prompts = [rng.integers(0, vocab, (short_len,)).astype(np.int32)
+                     for _ in range(n_short)]
+    long_prompts = [rng.integers(0, vocab, (long_len,)).astype(np.int32)
+                    for _ in range(n_long)]
+
+    def drive(serving):
+        # shorts at t0 (the interactive class under observation), longs
+        # injected in bursts while the shorts are mid-decode
+        reqs = []
+        for p in short_prompts:
+            r = Request(prompt=p.copy(), max_new_tokens=max_new_short)
+            serving.submit(r)
+            reqs.append((r, "short"))
+        pending = [p.copy() for p in long_prompts]
+        deliveries = {}
+        pumps = 0
+        while serving.scheduler.has_work() or serving.chunk_in_flight \
+                or pending:
+            if pending and pumps % inject_every == 0:
+                for _ in range(min(burst, len(pending))):
+                    r = Request(prompt=pending.pop(0),
+                                max_new_tokens=max_new_long)
+                    serving.submit(r)
+                    reqs.append((r, "long"))
+            serving.pump()
+            t = time.perf_counter()
+            for r, _kind in reqs:
+                dl = deliveries.setdefault(r.uid, [])
+                n = len(r.tokens)
+                if not dl or n > dl[-1][1]:
+                    dl.append((t, n))
+            pumps += 1
+        return reqs, deliveries
+
+    def run_side(fused: bool):
+        kw = dict(fused_prefill=True, prefill_chunk=prefill_chunk) \
+            if fused else {}
+        serving = ServingEngine(engine=engine, max_batch=max_batch,
+                                max_prompt_len=long_len, max_queue=32,
+                                decode_chunk=decode_chunk, **kw)
+        # warm every (n, bucket) prefill width the drive loop can hit —
+        # a cold wide-prompt compile mid-drive would masquerade as a
+        # multi-second stall
+        for k in range(1, max_batch + 1):
+            serving.run([short_prompts[i % n_short].copy()
+                         for i in range(k)], max_new_tokens=4)
+            serving.run([long_prompts[i % n_long].copy()
+                         for i in range(k)], max_new_tokens=4)
+            serving.run([short_prompts[0].copy()]
+                        + [long_prompts[i % n_long].copy()
+                           for i in range(k - 1)], max_new_tokens=4)
+        warm = [p.copy() for p in short_prompts] \
+            + [p.copy() for p in long_prompts]
+        serving.run(warm, max_new_tokens=4)
+        serving.run(warm, max_new_tokens=4)
+        drive(serving)        # absorb the drive-pattern arena retraces
+        prof = ChunkProfiler()
+        serving.profiler = prof
+        reqs, deliveries = drive(serving)
+        # TPOT over the interactive class: gaps between consecutive
+        # token deliveries of each short request
+        gaps = []
+        for r, kind in reqs:
+            if kind != "short":
+                continue
+            dl = deliveries[r.uid]
+            for (t0, n0), (t1, n1) in zip(dl, dl[1:]):
+                gaps.append((t1 - t0) / max(1, n1 - n0))
+        rep = prof.profile_report()
+        ttft = {kind: [r.ttft_s for r, k in reqs if k == kind]
+                for kind in ("short", "long")}
+        return reqs, gaps, rep, ttft
+
+    b_reqs, b_gaps, b_rep, b_ttft = run_side(fused=False)
+    f_reqs, f_gaps, f_rep, f_ttft = run_side(fused=True)
+
+    for (rb, _), (rf, _) in zip(b_reqs, f_reqs):
+        if not np.array_equal(rb.output_ids, rf.output_ids):
+            raise RuntimeError(
+                "fused greedy output diverged from bucketed under the "
+                f"mixed workload (uids {rb.uid}/{rf.uid})")
+    p99_b, p99_f = _percentile(b_gaps, 99), _percentile(f_gaps, 99)
+    improvement = p99_b / p99_f
+    if improvement < tpot_gate:
+        raise RuntimeError(
+            f"fused p99 TPOT improvement {improvement:.2f}x under the "
+            f"mixed long-prompt workload is below the {tpot_gate}x gate "
+            f"(bucketed {p99_b * 1e3:.2f}ms, fused {p99_f * 1e3:.2f}ms)")
+    fused_stall = f_rep["prefill"]["stall_s"]
+    bucketed_stall = b_rep["prefill"]["stall_s"]
+    if fused_stall > 1e-6:
+        raise RuntimeError(
+            f"fused profile attributed prefill stall {fused_stall:.4f}s "
+            "— in-scan prompt chunks must never preempt decode launches")
+    if bucketed_stall <= 0.0:
+        raise RuntimeError(
+            "bucketed reference attributed no prefill stall — the mixed "
+            "workload lost the contrast this case exists to measure")
+    if f_rep["prefill"]["inline_tokens"] <= 0:
+        raise RuntimeError("fused run consumed no in-scan prompt tokens")
+    f_short_ttft = _percentile(f_ttft["short"], 99)
+    b_short_ttft = _percentile(b_ttft["short"], 99)
+    if f_short_ttft > ttft_hold_s:
+        raise RuntimeError(
+            f"fused short-class TTFT p99 {f_short_ttft:.3f}s exceeds the "
+            f"{ttft_hold_s}s hold")
+    return {
+        "geometry": {
+            "short_len": short_len, "long_len": long_len,
+            "n_short": n_short, "n_long": n_long,
+            "long_burst": burst, "inject_every_pumps": inject_every,
+            "max_new_short": max_new_short, "max_new_long": max_new_long,
+            "max_batch": max_batch, "decode_chunk": decode_chunk,
+            "prefill_chunk": prefill_chunk,
+        },
+        "greedy_parity": True,
+        "tpot_gate": tpot_gate,
+        "tpot_p99_improvement": round(improvement, 3),
+        "tpot_p50_ms": {
+            "bucketed": round(_percentile(b_gaps, 50) * 1e3, 3),
+            "fused": round(_percentile(f_gaps, 50) * 1e3, 3)},
+        "tpot_p99_ms": {"bucketed": round(p99_b * 1e3, 3),
+                        "fused": round(p99_f * 1e3, 3)},
+        "short_ttft_p99_s": {"bucketed": round(b_short_ttft, 4),
+                             "fused": round(f_short_ttft, 4)},
+        "long_ttft_p99_s": {
+            "bucketed": round(_percentile(b_ttft["long"], 99), 4),
+            "fused": round(_percentile(f_ttft["long"], 99), 4)},
+        "ttft_p99_ratio": round(f_short_ttft / b_short_ttft, 3),
+        "ttft_hold_s": ttft_hold_s,
+        "inline_prefill_tokens": int(f_rep["prefill"]["inline_tokens"]),
+        "bucketed_stall_s": round(bucketed_stall, 4),
+        # the fused profiler report — regression specs pin
+        # profile.prefill.stall_s ~ 0 here
+        "profile": _round_tree(f_rep),
+    }
+
+
 def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
               max_new_tokens: int = 16, max_batch: int = 4,
               prompt_len: int = 16, decode_chunk: int = 4,
               high_fraction: float = 0.25, ttft_bound_s: float = 10.0,
               seed: int = 0, model=None, params=None,
               timeout_s: float = 300.0, trace_out: str = None,
-              metrics_port: int = 0, slo: bool = True) -> dict:
+              metrics_port: int = 0, slo: bool = True,
+              fused_mixed: bool = True) -> dict:
     import urllib.request
 
     import jax.numpy as jnp
@@ -350,6 +535,11 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         # frontend lanes with submit->finish flow arrows
         frontend.tracing.export_chrome(trace_out)
 
+    # ---- fused chunked-prefill A/B under the mixed long-prompt
+    # workload (own tiny model with a 512-token context; independent of
+    # the overload phase above)
+    fused_block = _fused_mixed_case(seed=seed) if fused_mixed else None
+
     traces = {t["uid"]: t
               for t in frontend.tracing.to_json()["requests"]}
     high_statuses = [h.status for h, hi in load_handles if hi]
@@ -414,6 +604,9 @@ def run_bench(n_requests: int = 48, overload_factor: float = 4.0,
         # chunk-timeline attribution (overload window + steady-state
         # summary); `bin/tputrace profile` consumes this block directly
         "profile": _round_tree(profile_rep),
+        # fused chunked prefill vs bucketed under mixed long prompts
+        # (ROADMAP item 4 acceptance: p99 TPOT >= 2x, stall ~ 0)
+        "fused_mixed": fused_block,
         "tenant_goodput": {
             "endpoint_ok": 1.0,
             "labelled_series_ok": 1.0,
@@ -434,6 +627,11 @@ def main(argv=None):
     ap.add_argument("--decode-chunk", type=int, default=4)
     ap.add_argument("--high-fraction", type=float, default=0.25)
     ap.add_argument("--ttft-bound-s", type=float, default=10.0)
+    ap.add_argument("--fused-mixed", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run the fused-vs-bucketed chunked-prefill A/B "
+                    "under the mixed long-prompt workload "
+                    "(--no-fused-mixed skips)")
     ap.add_argument("--slo", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="wire an SLO burn-rate engine to the frontend "
@@ -459,7 +657,8 @@ def main(argv=None):
                        high_fraction=args.high_fraction,
                        ttft_bound_s=args.ttft_bound_s,
                        seed=args.seed, trace_out=args.trace_out,
-                       metrics_port=args.metrics_port, slo=args.slo)
+                       metrics_port=args.metrics_port, slo=args.slo,
+                       fused_mixed=args.fused_mixed)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

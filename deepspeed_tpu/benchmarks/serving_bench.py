@@ -65,6 +65,17 @@ SPEC_PAGED_DECODE_PROGRAM_BUDGET = 2
 INT8_DECODE_PROGRAM_BUDGET = 3
 INT8_PAGED_DECODE_PROGRAM_BUDGET = 2
 
+#: the FUSED chunked-prefill scan program (prompt chunks consumed by the
+#: same scan body as decode steps behind a per-lane mode mask). The
+#: dense variant inherits the dense retrace physics (3: initial trace +
+#: two arena-metadata retraces across the double-warm). The paged fused
+#: variant pays TWO extra compiles over the paged chunk's budget (4 vs
+#: 2): the prompt-chunk buffer rides in the scan carry, and the paged
+#: pool's donated-output metadata shifts twice more before the carry
+#: reaches steady state (measured; tests/test_tracelint.py pins both).
+FUSED_DECODE_PROGRAM_BUDGET = 3
+FUSED_PAGED_DECODE_PROGRAM_BUDGET = 4
+
 
 def _tiny_model(vocab_size=512, max_seq_len=64):
     """Small enough that per-step host overhead (dispatch + sync + python
@@ -377,6 +388,105 @@ def _int8_case(engine, prompts, max_new_tokens: int, max_batch: int,
     }
 
 
+def _fused_case(engine, prompts, max_new_tokens: int, max_batch: int,
+                prompt_len: int, decode_chunk: int, ck_results,
+                ck_tps: float, with_paged: bool,
+                prefill_chunk: int = 8) -> dict:
+    """Fused chunked prefill vs the bucketed reference, same workload.
+
+    The fused engine consumes prompts as in-scan chunks through the same
+    scan body that decodes — no separate prefill program between chunk
+    launches. Asserted here:
+
+      * greedy outputs bit-identical to the bucketed chunked engine;
+      * the fused scan program's compile count matches its pinned budget
+        (dense and, with ``--paged``, the paged fused variant);
+      * the profiled run attributes ZERO ``prefill.stall_s`` (there is
+        no prefill program to preempt decode) while consuming every
+        prompt token in-scan (``inline_tokens`` == sum of prompt lens).
+    """
+    from ..analysis import TraceAuditor
+    from ..serving import ServingEngine
+    from ..telemetry.profiler import ChunkProfiler
+
+    inline_expected = sum(len(p) for p in prompts)
+
+    def one_side(paged: bool):
+        variant = "decode_chunk_fused_paged_fn" if paged \
+            else "decode_chunk_fused_fn"
+        budget = FUSED_PAGED_DECODE_PROGRAM_BUDGET if paged \
+            else FUSED_DECODE_PROGRAM_BUDGET
+        kw = dict(paged=True, prefix_cache=False) if paged else {}
+        auditor = TraceAuditor(budgets={variant: budget},
+                               audit_jaxprs=False)
+        with auditor:
+            fused = ServingEngine(engine=engine, max_batch=max_batch,
+                                  max_prompt_len=prompt_len,
+                                  decode_chunk=decode_chunk,
+                                  max_queue=max(len(prompts), 8),
+                                  fused_prefill=True,
+                                  prefill_chunk=prefill_chunk, **kw)
+            fz_results, fz_dt, fz_tokens, _ = _timed_serving_run(
+                fused, prompts, max_new_tokens)
+            # profiled pass INSIDE the audited region: attaching the
+            # profiler is host-side bookkeeping and must not retrace
+            prof = ChunkProfiler()
+            fused.profiler = prof
+            prof_results = fused.run(list(prompts),
+                                     max_new_tokens=max_new_tokens)
+        compiles = auditor.compiles(variant)
+        if compiles != budget:
+            raise RuntimeError(
+                f"{variant} compiled {compiles}x, expected exactly "
+                f"{budget} — prompt-chunk state is leaking shape/type "
+                "variation into the fused scan program")
+        for res in (fz_results, prof_results):
+            if not all(np.array_equal(a.output_ids, b.output_ids)
+                       for a, b in zip(ck_results, res)):
+                raise RuntimeError(
+                    "greedy outputs diverged between bucketed prefill "
+                    f"and fused chunked prefill (paged={paged}) — the "
+                    "fused path must be bit-identical")
+        rep = prof.profile_report()
+        if rep["prefill"]["stall_s"] > 1e-6:
+            raise RuntimeError(
+                f"fused profile attributed {rep['prefill']['stall_s']}s "
+                "of prefill stall — fused mode has no prefill program "
+                "to preempt decode launches")
+        if rep["prefill"]["inline_tokens"] != inline_expected:
+            raise RuntimeError(
+                f"fused run consumed {rep['prefill']['inline_tokens']} "
+                f"prompt tokens in-scan, expected {inline_expected}")
+        return fz_dt, fz_tokens / fz_dt, compiles, budget, rep
+
+    fz_dt, fz_tps, compiles, budget, rep = one_side(paged=False)
+    paged_block = None
+    if with_paged:
+        pg_dt, pg_tps, pg_compiles, pg_budget, pg_rep = one_side(
+            paged=True)
+        paged_block = {
+            "greedy_parity": True,
+            "fused_paged_s": round(pg_dt, 4),
+            "fused_paged_tokens_per_s": round(pg_tps, 2),
+            "decode_chunk_compiles": pg_compiles,
+            "decode_chunk_budget": pg_budget,
+            "prefill_stall_s": round(pg_rep["prefill"]["stall_s"], 6),
+        }
+    return {
+        "greedy_parity": True,
+        "fused_s": round(fz_dt, 4),
+        "fused_tokens_per_s": round(fz_tps, 2),
+        "fused_vs_chunked": round(fz_tps / ck_tps, 3),
+        "prefill_chunk": prefill_chunk,
+        "decode_chunk_compiles": compiles,
+        "decode_chunk_budget": budget,
+        "inline_prefill_tokens": int(rep["prefill"]["inline_tokens"]),
+        "prefill_stall_s": round(rep["prefill"]["stall_s"], 6),
+        "prefill_inline_s": round(rep["prefill"]["inline_s"], 6),
+        "paged": paged_block,
+    }
+
+
 def _round_tree(obj, nd=6):
     if isinstance(obj, dict):
         return {k: _round_tree(v, nd) for k, v in obj.items()}
@@ -393,6 +503,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               with_sequential: bool = True,
               with_paged: bool = False,
               with_speculative: bool = False,
+              with_fused: bool = True,
               spec_k: int = 4,
               kv_dtype: str = "auto",
               trace_out: str = None) -> dict:
@@ -596,6 +707,15 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             engine, prompts, max_new_tokens, max_batch, prompt_len,
             decode_chunk, fp_arena_report=chunked.kv.arena_report())
 
+    # ---- fused chunked prefill A/B (default-on) ------------------------
+    # Same prompts and chunk config as the bucketed engines above; own
+    # audited region, strictly after theirs.
+    fused_out = None
+    if with_fused:
+        fused_out = _fused_case(
+            engine, prompts, max_new_tokens, max_batch, prompt_len,
+            decode_chunk, ck_results, ck_tps, with_paged=with_paged)
+
     ttfts = [r.ttft_s for r in ck_results if r.ttft_s is not None]
     csv_dir = os.path.join(out_dir, "serving_bench")
     out = {
@@ -630,6 +750,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         "paged": paged_out,
         "speculative": speculative_out,
         "int8_kv": int8_out,
+        "fused": fused_out,
         "trace_file": trace_out,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],
@@ -657,6 +778,13 @@ def main(argv=None):
                     "a repetitive-text workload (greedy parity vs the "
                     "sequential loops asserted, dense AND paged; >= 1.3x "
                     "tokens/s asserted; acceptance rate reported)")
+    ap.add_argument("--fused", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="A/B fused chunked prefill (prompt chunks "
+                    "consumed by the decode scan) against the bucketed "
+                    "reference — bit-identical greedy, pinned compile "
+                    "budget, and zero prefill stall asserted "
+                    "(--no-fused skips)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per speculative step")
     ap.add_argument("--kv-dtype", type=str, default="auto",
@@ -682,6 +810,7 @@ def main(argv=None):
                        with_sequential=not args.skip_sequential,
                        with_paged=args.paged,
                        with_speculative=args.speculative,
+                       with_fused=args.fused,
                        spec_k=args.spec_k,
                        kv_dtype=args.kv_dtype,
                        trace_out=args.trace_out)

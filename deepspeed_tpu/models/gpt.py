@@ -445,6 +445,13 @@ class SelfAttention(nn.Module):
         fuses the scale-multiply into the attention contractions."""
         cfg = self.cfg
         b, s, h, d = q.shape
+        if cfg.sequence_parallel and s > 1:
+            # Ulysses over the chunk-width cache path (the sp long-prompt
+            # prefill, serving/engine.py): heads shard over sp with the
+            # full sequence per chip — the all-to-all happens in the
+            # constraint; exact identity when the mesh's sp axis is 1
+            q, k, v = (sp_shard_heads(q), sp_shard_heads(k),
+                       sp_shard_heads(v))
         if self.has_variable("cache", "block_tables"):
             # paged block-pool cache (serving/paged_kv.py): the engine
             # injected per-slot block tables, so reads and writes route
@@ -587,8 +594,13 @@ class SelfAttention(nn.Module):
 
     def _cache_einsum(self, q, ck, cv, cur, s, scale):
         from ..ops.pallas.decode_attention import masked_cache_attention
-        return masked_cache_attention(q, ck, cv, cur, scale,
-                                      window=self.window)
+        out = masked_cache_attention(q, ck, cv, cur, scale,
+                                     window=self.window)
+        if self.cfg.sequence_parallel and s > 1:
+            # hand the head-sharded context back sequence-replicated so
+            # the out-projection sees the layout the dense path expects
+            out = sp_shard_heads(out)
+        return out
 
 
 class MLP(nn.Module):

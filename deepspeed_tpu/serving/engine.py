@@ -175,6 +175,10 @@ class ServingEngine:
                  prefix_cache_capacity: int = 64,
                  tp: int = 1,
                  disaggregate_prefill: bool = False,
+                 fused_prefill: bool = False,
+                 prefill_chunk: int = 16,
+                 chunk_token_budget: Optional[int] = None,
+                 sp_prefill_threshold: Optional[int] = None,
                  **inference_kwargs):
         import jax
         import jax.numpy as jnp
@@ -222,6 +226,46 @@ class ServingEngine:
         if self.decode_chunk < 1:
             raise ValueError(
                 f"decode_chunk must be >= 1, got {decode_chunk}")
+        # ---- fused chunked prefill (Sarathi-style, in-scan) ----
+        # Prompts are split into ``prefill_chunk``-token pieces consumed by
+        # the SAME scan body as decode steps under a per-lane mode mask, so
+        # a long prompt can never stall every running stream's next chunk
+        # launch. The bucketed prefill program stays behind
+        # ``fused_prefill=False`` as the bit-parity reference.
+        self.fused_prefill = bool(fused_prefill)
+        self.prefill_chunk = min(int(prefill_chunk), self.max_prompt_len)
+        if self.fused_prefill and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if self.fused_prefill and disaggregate_prefill:
+            raise ValueError(
+                "fused_prefill folds prefill into the decode scan; "
+                "disaggregate_prefill needs a standalone prefill program "
+                "on its own device slice — the two are mutually exclusive")
+        if self.fused_prefill and speculative and float(temperature) != 0.0:
+            raise ValueError(
+                "fused_prefill + speculative supports greedy sampling only "
+                "(temperature=0): the fused scan body verifies drafts with "
+                "the greedy rule")
+        # one token budget per scan iteration shared by prompt chunks and
+        # decode lanes — the scheduler fills admission against it. Default:
+        # room for ~2 concurrent prompt chunks on top of a full decode
+        # batch (prefill keeps flowing without ever monopolizing a step).
+        if chunk_token_budget is not None:
+            self.chunk_token_budget = int(chunk_token_budget)
+        else:
+            self.chunk_token_budget = 2 * self.prefill_chunk + self.max_batch
+        if self.fused_prefill and self.chunk_token_budget < 1:
+            raise ValueError(
+                f"chunk_token_budget must be >= 1, got {chunk_token_budget}")
+        # prompts at/above this length skip inline chunking and run one
+        # sequence-parallel (Ulysses) bucketed prefill instead — sp shards
+        # the long forward over the mesh's sp axis, then hands the finished
+        # KV to decode. None disables the sp leg. At mesh sp=1 (CPU tests)
+        # every sp constraint is the identity, so outputs stay bitwise
+        # equal to the plain bucketed program.
+        self.sp_prefill_threshold = (None if sp_prefill_threshold is None
+                                     else int(sp_prefill_threshold))
         if prefill_buckets is None:
             self._buckets = default_prefill_buckets(self.max_prompt_len)
         else:
@@ -242,8 +286,10 @@ class ServingEngine:
             self.drafter = None
             self.spec_k = 0
         # speculative decode always runs the chunked scan program (the
-        # verify forward is a multi-token apply; K=1 is a length-1 scan)
-        self._chunked = self.decode_chunk > 1 or self.speculative
+        # verify forward is a multi-token apply; K=1 is a length-1 scan);
+        # fused prefill lives inside that scan, so it forces it too
+        self._chunked = (self.decode_chunk > 1 or self.speculative
+                         or self.fused_prefill)
 
         self.paged = bool(paged)
         if self.paged:
@@ -323,13 +369,35 @@ class ServingEngine:
                                       emit_every_steps=emit_every_steps)
         self._rng = jax.random.PRNGKey(seed)
         self._last_token = np.zeros(self.max_batch, np.int32)
-        # distinct (batch, bucket) prefill shapes seen so far — the
-        # compile count ServingMetrics reports
-        self._prefill_shapes: Set[Tuple[int, int]] = set()
+        # distinct (batch, bucket[, "sp"]) prefill shapes seen so far —
+        # the compile count ServingMetrics reports
+        self._prefill_shapes: Set[Tuple] = set()
         # host corrections to device-carried chunk state, applied at the
         # NEXT chunk launch (see _device_state)
         self._deact_slots: Set[int] = set()
-        self._admit_patches: Dict[int, Tuple[int, int, int, int]] = {}
+        self._admit_patches: Dict[int, Tuple] = {}
+        # fused-prefill host mirrors (slot-keyed, fused mode only).
+        # Prompt-chunk consumption is DETERMINISTIC (a prefilling lane
+        # can't EOS or exhaust its budget), so the host tracks it with two
+        # cursors instead of syncing device state: _pf_consumed advances
+        # at chunk CONSUME (authoritative — scheduler-facing state),
+        # _pf_launched advances at chunk LAUNCH (the speculative horizon
+        # the next prompt_buf is built from, one chunk ahead under the
+        # double-buffered loop).
+        self._pf_consumed: Dict[int, int] = {}
+        self._pf_launched: Dict[int, int] = {}
+        # slots whose token #1 has not been emitted yet: the first valid
+        # token routes through scheduler.record_first_token (TTFT stamp,
+        # no allocator advance), the rest through step_tokens_chunk
+        self._pf_first_pending: Set[int] = set()
+        # paged MISS admission plans deferred to first-token time: the
+        # prefix-cache commit needs the sampled token #1, which the fused
+        # path only learns when the completing chunk retires
+        self._pf_plans: Dict[int, Any] = {}
+        # prompt tokens consumed inside the decode scan (the fused
+        # analogue of serve/prefill_tokens) — the frontend throughput
+        # estimator folds this into its one-EWMA budget rate
+        self.inline_prefill_tokens = 0
         # the at-most-one in-flight chunk of the double-buffered loop
         # (run()'s pipelined drain and external pump() drivers share it)
         self._pending: Optional[_InflightChunk] = None
@@ -353,6 +421,7 @@ class ServingEngine:
         spec_k_ = self.spec_k
         drafter_ = self.drafter
         K = self.decode_chunk
+        C_ = self.prefill_chunk
 
         def prefill(params, ids, true_lens, rng):
             pm = mat(params)
@@ -363,6 +432,35 @@ class ServingEngine:
                 logits = logits[0]
             last = jnp.take_along_axis(
                 logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [n,V]
+            tok = sample_tokens(last, rng, temperature_, top_k_, top_p_)
+            return tok, vc["cache"]
+
+        # sequence-parallel (Ulysses) prefill for very long prompts: the
+        # same bucketed program shape, but the module constrains q/k/v
+        # head-sharded over the mesh's sp axis so the one long forward
+        # spreads across chips before its KV is handed to decode. The
+        # einsum paths are forced (the pallas custom calls don't
+        # auto-partition under GSPMD); at sp=1 every constraint is the
+        # identity, so outputs are bitwise equal to ``prefill``.
+        sp_module = None
+        if self.sp_prefill_threshold is not None:
+            sp_cfg = dataclasses.replace(
+                self.module.cfg, sequence_parallel=True,
+                cp_impl="ulysses", attention_impl="xla",
+                decode_impl="xla")
+            sp_module = type(self.module)(sp_cfg)
+        self._sp_module = sp_module
+
+        def prefill_sp(params, ids, true_lens, rng):
+            pm = mat(params)
+            positions = jnp.arange(ids.shape[1])[None, :]
+            logits, vc = sp_module.apply({"params": pm}, ids,
+                                         positions=positions,
+                                         mutable=["cache"])
+            if isinstance(logits, tuple):
+                logits = logits[0]
+            last = jnp.take_along_axis(
+                logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
             tok = sample_tokens(last, rng, temperature_, top_k_, top_p_)
             return tok, vc["cache"]
 
@@ -505,16 +603,205 @@ class ServingEngine:
             valid = jnp.moveaxis(valid, 0, 1).reshape(B_, K * kp1)
             return (toks, valid, c, tok_f, pos_f, act_f, rem_f, hist_f)
 
+        def decode_chunk_fused_fn(params, cache, tokens, positions, active,
+                                  eos, remaining, pf_rem, prompt_buf, rng):
+            """Fused chunked-prefill decode scan (the Sarathi-Serve /
+            vLLM chunked-prefill idea, in-scan): each scan step a live
+            lane either consumes its next <= C prompt tokens (prefill
+            mode — incremental KV append, nothing emitted until the
+            completing chunk samples token #1) or emits one decode token.
+            ONE C-wide forward serves both modes under the per-lane mode
+            mask ``pf_rem > 0``; decode lanes broadcast their last token
+            across the C columns and sample at column 0. ``prompt_buf``
+            [K, B, C] carries each prefilling lane's next K*C prompt
+            tokens (zeros elsewhere — the host builds it per launch).
+
+            Write-cursor discipline is unchanged: pad columns write KV
+            ABOVE the lane's logical fill (or through the paged table's
+            sentinel rows), where every causal read masks them until a
+            later step legitimately overwrites — the same argument that
+            covers the speculative verify's rejected-draft rows. Greedy
+            outputs are bitwise identical to bucketed prefill + decode
+            because both run the same masked cache attention per
+            position (tests/test_fused_prefill.py)."""
+            pm = mat(params)
+            cspan = jnp.arange(C_, dtype=jnp.int32)[None, :]
+
+            def body(carry, pchunk):
+                c, tok, pos, act, rem, pf, key = carry
+                is_pf = jnp.logical_and(act, pf > 0)
+                n_cons = jnp.where(is_pf, jnp.minimum(pf, C_), 0)
+                completing = jnp.logical_and(is_pf, pf <= C_)
+                inputs = jnp.where(is_pf[:, None], pchunk, tok[:, None])
+                qpos = pos[:, None] + cspan
+                write_pos = jnp.where(act, pos, jnp.int32(max_seq_))
+                c = _with_write_index(c, write_pos)
+                logits, vc = module.apply(
+                    {"params": pm, "cache": c}, inputs,
+                    positions=qpos, mutable=["cache"])
+                if isinstance(logits, tuple):
+                    logits = logits[0]                      # [B, C, V]
+                key, sub = jax.random.split(key)
+                # sample at the lane's LAST real column: n_cons-1 for a
+                # completing prefill lane (token #1), 0 for decode lanes
+                sel = jnp.where(is_pf, jnp.maximum(n_cons - 1, 0), 0)
+                last = jnp.take_along_axis(
+                    logits, sel[:, None, None], axis=1)[:, 0]   # [B, V]
+                nxt = sample_tokens(last, sub, temperature_, top_k_,
+                                    top_p_)
+                emits = jnp.logical_and(
+                    act, jnp.logical_or(completing,
+                                        jnp.logical_not(is_pf)))
+                nxt = jnp.where(emits, nxt, tok)
+                rem = jnp.where(emits, rem - 1, rem)
+                hit_eos = (eos >= 0) & (nxt == eos) & emits
+                act = jnp.logical_and(
+                    act, jnp.where(emits,
+                                   (rem > 0) & jnp.logical_not(hit_eos),
+                                   True))
+                pos = pos + jnp.where(is_pf, n_cons,
+                                      jnp.where(emits, 1, 0))
+                pf = pf - n_cons
+                return ((vc["cache"], nxt, pos, act, rem, pf, key),
+                        (nxt, emits))
+
+            (c, tok_f, pos_f, act_f, rem_f, pf_f, _), (toks, valid) = \
+                jax.lax.scan(
+                    body,
+                    (cache, tokens, positions, active, remaining, pf_rem,
+                     rng),
+                    prompt_buf)
+            return (jnp.moveaxis(toks, 0, 1), jnp.moveaxis(valid, 0, 1),
+                    c, tok_f, pos_f, act_f, rem_f, pf_f)
+
+        def decode_chunk_fused_spec_fn(params, cache, tokens, positions,
+                                       active, eos, remaining, pf_rem,
+                                       prompt_buf, hist, rng):
+            """Fused chunked prefill + speculative decode (greedy only —
+            enforced at construction). Step width is W = max(C, k+1):
+            prefill-mode lanes consume their next prompt chunk through
+            the first C columns; decode-mode lanes verify k drafts
+            through the first k+1. A completing prefill lane emits token
+            #1 at ys column 0; the host excludes prefill-mode steps from
+            acceptance accounting via its own deterministic replay of
+            the pf cursor (engine._sim_chunk_prefill)."""
+            from .speculative import verify_greedy
+            pm = mat(params)
+            kp1 = spec_k_ + 1
+            W = max(C_, kp1)
+            rows = jnp.arange(B_, dtype=jnp.int32)
+            j = jnp.arange(kp1, dtype=jnp.int32)[None, :]
+            wspan = jnp.arange(W, dtype=jnp.int32)[None, :]
+
+            def body(carry, pchunk):
+                c, tok, pos, act, rem, pf, key, h = carry
+                is_pf = jnp.logical_and(act, pf > 0)
+                n_cons = jnp.where(is_pf, jnp.minimum(pf, C_), 0)
+                completing = jnp.logical_and(is_pf, pf <= C_)
+                is_dec = jnp.logical_and(act, jnp.logical_not(is_pf))
+                # hist invariant h[b, pos] == tok for DECODE lanes only —
+                # a prefilling lane's row already holds its prompt at
+                # [0, L), and pos points inside it
+                h = h.at[rows, jnp.where(is_dec, pos, jnp.int32(max_seq_))
+                         ].set(tok, mode="drop")
+                drafts = drafter_.propose(h, tok, pos)          # [B, k]
+                dec_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+                if W > kp1:
+                    dec_in = jnp.pad(dec_in, ((0, 0), (0, W - kp1)))
+                pf_in = pchunk
+                if W > C_:
+                    pf_in = jnp.pad(pf_in, ((0, 0), (0, W - C_)))
+                inputs = jnp.where(is_pf[:, None], pf_in, dec_in)
+                write_pos = jnp.where(act, pos, jnp.int32(max_seq_))
+                c = _with_write_index(c, write_pos)
+                qpos = pos[:, None] + wspan
+                logits, vc = module.apply(
+                    {"params": pm, "cache": c}, inputs,
+                    positions=qpos, mutable=["cache"])
+                if isinstance(logits, tuple):
+                    logits = logits[0]                      # [B, W, V]
+                # ---- decode lanes: greedy verify over the first k+1 ----
+                emitted, acc = verify_greedy(logits[:, :kp1], drafts)
+                cand = is_dec[:, None] & (j <= acc[:, None]) & \
+                    (j < rem[:, None])
+                hitv = (eos[:, None] >= 0) & (emitted == eos[:, None])
+                cut = (cand & hitv).astype(jnp.int32)
+                prior_hits = jnp.cumsum(cut, axis=1) - cut
+                dvalid = cand & (prior_hits == 0)
+                n = jnp.sum(dvalid.astype(jnp.int32), axis=1)   # [B]
+                last = jnp.take_along_axis(
+                    emitted, jnp.clip(n - 1, 0, spec_k_)[:, None],
+                    axis=1)[:, 0]
+                # ---- prefill lanes: token #1 at column n_cons - 1 ----
+                sel = jnp.maximum(n_cons - 1, 0)
+                t1 = jnp.argmax(jnp.take_along_axis(
+                    logits, sel[:, None, None], axis=1)[:, 0],
+                    axis=-1).astype(jnp.int32)
+                pf_emit = jnp.logical_and(act, completing)
+                t1_eos = (eos >= 0) & (t1 == eos) & pf_emit
+                # ---- merge the two modes' carries ----
+                tok_n = jnp.where(is_pf, jnp.where(pf_emit, t1, tok),
+                                  jnp.where(n > 0, last, tok))
+                stopped = jnp.any(dvalid & hitv, axis=1) | t1_eos
+                n_all = jnp.where(is_pf, pf_emit.astype(jnp.int32), n)
+                rem_n = rem - n_all
+                act_n = act & jnp.where(
+                    jnp.logical_and(is_pf, jnp.logical_not(pf_emit)),
+                    True, (rem_n > 0) & jnp.logical_not(stopped))
+                # ys fixed at width W: decode lanes at columns 0..k, a
+                # completing prefill lane's token #1 at column 0
+                ys_tok = jnp.where(is_pf[:, None],
+                                   jnp.broadcast_to(t1[:, None],
+                                                    (B_, kp1)), emitted)
+                ys_val = jnp.where(is_pf[:, None],
+                                   pf_emit[:, None] & (j == 0), dvalid)
+                if W > kp1:
+                    ys_tok = jnp.pad(ys_tok, ((0, 0), (0, W - kp1)))
+                    ys_val = jnp.pad(ys_val, ((0, 0), (0, W - kp1)))
+                # history: decode-lane token j landed at pos + 1 + j;
+                # a completing lane's token #1 at index prompt_len
+                widx = jnp.where(dvalid, pos[:, None] + 1 + j,
+                                 jnp.int32(max_seq_))
+                h = h.at[rows[:, None], widx].set(emitted, mode="drop")
+                h = h.at[rows, jnp.where(pf_emit, pos + n_cons,
+                                         jnp.int32(max_seq_))
+                         ].set(t1, mode="drop")
+                pos_n = pos + jnp.where(is_pf, n_cons, n)
+                pf_n = pf - n_cons
+                return ((vc["cache"], tok_n, pos_n, act_n, rem_n, pf_n,
+                         key, h), (ys_tok, ys_val))
+
+            (c, tok_f, pos_f, act_f, rem_f, pf_f, _, hist_f), \
+                (toks, valid) = jax.lax.scan(
+                    body,
+                    (cache, tokens, positions, active, remaining, pf_rem,
+                     rng, hist),
+                    prompt_buf)
+            toks = jnp.moveaxis(toks, 0, 1).reshape(B_, K * W)
+            valid = jnp.moveaxis(valid, 0, 1).reshape(B_, K * W)
+            return (toks, valid, c, tok_f, pos_f, act_f, rem_f, pf_f,
+                    hist_f)
+
         # prefill retraces lazily per (n, bucket) shape — the jit cache IS
         # the bucket program table
         self._jit_prefill = jax.jit(prefill)
+        # the sp prefill is its own program family ("prefill_sp_fn"),
+        # bucket-lazy exactly like the plain prefill
+        if sp_module is not None:
+            prefill_sp.__name__ = "prefill_sp_fn"
+            self._jit_prefill_sp = jax.jit(prefill_sp)
+        else:
+            self._jit_prefill_sp = None
         # donate the arena: XLA updates every slot's KV rows in place
         self._jit_decode = jax.jit(decode, donate_argnums=(1,))
         # distinct function name => distinct TraceAuditor budget: every
-        # spec / int8 / paged combination is a different compiled program
-        # family whose retrace count is pinned separately ("decode_chunk"
-        # + "_spec"? + "_int8"? + "_paged"? + "_fn")
+        # fused / spec / int8 / paged combination is a different compiled
+        # program family whose retrace count is pinned separately
+        # ("decode_chunk" + "_fused"? + "_spec"? + "_int8"? + "_paged"?
+        # + "_fn")
         variant = "decode_chunk"
+        if self.fused_prefill:
+            variant += "_fused"
         if self.speculative:
             variant += "_spec"
         if self.kv_dtype == "int8":
@@ -529,8 +816,12 @@ class ServingEngine:
         if self.disaggregated:
             variant += "_disagg"
         variant += "_fn"
-        chunk_fn = (decode_chunk_spec_fn if self.speculative
-                    else decode_chunk_fn)
+        if self.fused_prefill:
+            chunk_fn = (decode_chunk_fused_spec_fn if self.speculative
+                        else decode_chunk_fused_fn)
+        else:
+            chunk_fn = (decode_chunk_spec_fn if self.speculative
+                        else decode_chunk_fn)
         chunk_fn.__name__ = variant
         self._jit_decode_chunk = jax.jit(chunk_fn, donate_argnums=(1,))
         # arena-size gauges at init: the KV footprint is fixed for the
@@ -585,7 +876,20 @@ class ServingEngine:
         if cancelled and slot is not None:
             self._deact_slots.add(slot)
             self._admit_patches.pop(slot, None)
+            self._clear_pf_slot(slot)
         return cancelled
+
+    def _clear_pf_slot(self, slot: int) -> None:
+        """Drop a slot's fused-prefill mirrors (lane retired or admitted
+        through a non-inline path). An uncommitted paged MISS plan also
+        releases its duplicate-prompt hold so an identical prompt can
+        admit again."""
+        self._pf_consumed.pop(slot, None)
+        self._pf_launched.pop(slot, None)
+        self._pf_first_pending.discard(slot)
+        plan = self._pf_plans.pop(slot, None)
+        if plan is not None:
+            self.kv.abandon_plan(plan)
 
     def pump(self) -> List[Request]:
         """One iteration of the double-buffered serve loop for EXTERNAL
@@ -680,6 +984,10 @@ class ServingEngine:
             jax.tree.map(abst, self.engine.params),
             jax.tree.map(abst, self.kv.cache),
             i32, i32, jax.ShapeDtypeStruct((B,), bool), i32, i32]
+        if self.fused_prefill:
+            chunk_args.append(i32)    # pf_rem
+            chunk_args.append(jax.ShapeDtypeStruct(
+                (self.decode_chunk, B, self.prefill_chunk), np.int32))
         if self.speculative:
             chunk_args.append(
                 jax.ShapeDtypeStruct((B, self.max_seq_len), np.int32))
@@ -729,6 +1037,11 @@ class ServingEngine:
         if self._chunked:
             chunk_args = [params, cache, i32, i32,
                           jax.ShapeDtypeStruct((B,), bool), i32, i32]
+            if self.fused_prefill:
+                chunk_args.append(i32)    # pf_rem
+                chunk_args.append(jax.ShapeDtypeStruct(
+                    (self.decode_chunk, B, self.prefill_chunk),
+                    np.int32))
             if self.speculative:
                 chunk_args.append(
                     jax.ShapeDtypeStruct((B, self.max_seq_len), np.int32))
@@ -774,8 +1087,24 @@ class ServingEngine:
         BEFORE miss inserts — dispatch order is the device write order,
         so a fork's COW source is copied before anything could recycle
         its block."""
-        admitted = self.scheduler.admit()
+        if self.fused_prefill:
+            # chunk-budget fill policy: running lanes drain the per-step
+            # token budget (a prompt chunk for prefilling lanes, one
+            # decode token — k+1 speculative — for the rest); admission
+            # fills what's left. The scheduler still admits one request
+            # into an otherwise-idle engine so the budget can't wedge.
+            admitted = self.scheduler.admit(
+                token_budget=max(0, self.chunk_token_budget
+                                 - self._budget_drain()),
+                lane_cost=self._lane_cost)
+        else:
+            admitted = self.scheduler.admit()
         if not admitted:
+            return
+        if self.fused_prefill:
+            self._fused_admit(admitted)
+            if self.paged:
+                self._gauge_block_pool()
             return
         if not self.paged:
             self._prefill_admit(admitted)
@@ -811,6 +1140,91 @@ class ServingEngine:
         if self._chunked:
             self._record_admit_patch(req)
 
+    def _budget_drain(self) -> int:
+        """Tokens the RUNNING lanes consume per fused scan step: one
+        prompt chunk (<= C) while a lane is prefilling, one decode token
+        (k+1 speculative) after."""
+        C = self.prefill_chunk
+        base = (1 + self.spec_k) if self.speculative else 1
+        drain = 0
+        for slot, req in self.scheduler.running.items():
+            done = self._pf_consumed.get(slot, req.prompt_len)
+            if done < req.prompt_len:
+                drain += min(C, req.prompt_len - done)
+            else:
+                drain += base
+        return drain
+
+    def _lane_cost(self, req: Request) -> int:
+        """Per-step budget cost of ADMITTING ``req`` now: its first
+        prompt chunk for an inline lane; one decode token when the
+        prompt takes the out-of-scan sp prefill leg instead (it joins
+        the scan already in decode mode). Prefix-cache hits are priced
+        as inline lanes (the hit is only known after the lease) —
+        conservatively high, never starving."""
+        if (self.sp_prefill_threshold is not None
+                and req.prompt_len >= self.sp_prefill_threshold):
+            return (1 + self.spec_k) if self.speculative else 1
+        return min(self.prefill_chunk, req.prompt_len)
+
+    def _fused_admit(self, admitted: List[Request]) -> None:
+        """Fused-mode admission: no bucketed prefill program. Inline
+        lanes enter the scan in prefill mode (the scan body appends
+        their KV chunk by chunk); paged MISSES only install their block
+        table now (the prefix commit waits for token #1); prefix HITS
+        short-circuit every prompt chunk exactly like the bucketed path
+        (fork + replayed first token -> straight to decode mode); and
+        prompts at/above sp_prefill_threshold run the one
+        sequence-parallel bucketed prefill before joining as decode
+        lanes."""
+        sp_reqs: List[Request] = []
+        sp_plans: Dict[int, Any] = {}
+        for req in admitted:
+            plan = self.kv.take_plan(req.slot) if self.paged else None
+            if plan is not None and plan.hit:
+                self._clear_pf_slot(req.slot)
+                self._admit_prefix_hit(req, plan)
+                continue
+            if (self.sp_prefill_threshold is not None
+                    and req.prompt_len >= self.sp_prefill_threshold):
+                self._clear_pf_slot(req.slot)
+                sp_reqs.append(req)
+                if plan is not None:
+                    sp_plans[req.slot] = plan
+                continue
+            if plan is not None:
+                # wire up the lane's block table without a KV insert —
+                # the scan's chunk writes scatter through it from pos 0
+                self.kv.install_table(req.slot)
+                self._pf_plans[req.slot] = plan
+            self._pf_consumed[req.slot] = 0
+            self._pf_launched[req.slot] = 0
+            self._pf_first_pending.add(req.slot)
+            self._record_fused_admit_patch(req)
+            telemetry.instant("serve/prefill_inline_admit",
+                              slot=req.slot, prompt_len=req.prompt_len)
+            if self.flight is not None:
+                self.flight.record("prefill_inline_admit", uid=req.uid,
+                                   slot=req.slot,
+                                   prompt_len=req.prompt_len)
+        if sp_reqs:
+            self._prefill_admit(sp_reqs, plans=sp_plans or None)
+
+    def _record_fused_admit_patch(self, req: Request) -> None:
+        """Lane state for a freshly admitted INLINE prefill lane: pos 0,
+        the full prompt outstanding (pf = prompt_len), nothing emitted.
+        The carried token is a don't-care until the completing chunk
+        samples token #1."""
+        slot = req.slot
+        rem = min(req.max_new_tokens,
+                  self.kv.allocator.remaining(slot))
+        eos = -1 if req.eos_token_id is None else int(req.eos_token_id)
+        patch = (0, 0, rem, eos, req.prompt_len)
+        if self.speculative:
+            patch = patch + (self._history_row(req),)
+        self._admit_patches[slot] = patch
+        self._deact_slots.discard(slot)
+
     def _gauge_block_pool(self) -> None:
         blocks = self.kv.allocator.blocks
         telemetry.gauge("serve/block_pool_used", float(blocks.n_used))
@@ -827,29 +1241,36 @@ class ServingEngine:
         # below pushes their next chunk launch out — the ROADMAP item-4
         # stall the profiler accounts as prefill_stall_s
         n_decoding = len(self.scheduler.running) - len(admitted)
-        groups: Dict[int, List[Request]] = {}
+        groups: Dict[Tuple[int, bool], List[Request]] = {}
         for req in admitted:
-            groups.setdefault(self._bucket_for(req.prompt_len),
+            use_sp = (self._jit_prefill_sp is not None
+                      and self.sp_prefill_threshold is not None
+                      and req.prompt_len >= self.sp_prefill_threshold)
+            groups.setdefault((self._bucket_for(req.prompt_len), use_sp),
                               []).append(req)
-        for bucket, reqs in sorted(groups.items()):
+        for (bucket, use_sp), reqs in sorted(groups.items()):
             n = len(reqs)
+            prefill_fn = (self._jit_prefill_sp if use_sp
+                          else self._jit_prefill)
             ids = np.zeros((n, bucket), np.int32)
             lens = np.empty(n, np.int32)
             for i, r in enumerate(reqs):
                 ids[i, :r.prompt_len] = r.prompt
                 lens[i] = r.prompt_len
-            if (n, bucket) not in self._prefill_shapes:
+            shape_key = (n, bucket) if not use_sp else (n, bucket, "sp")
+            if shape_key not in self._prefill_shapes:
                 # first sighting of this (batch, bucket) shape = the call
                 # below compiles a fresh prefill program — mark it on the
                 # timeline so a long prefill span is explainable
                 telemetry.instant("serve/prefill_compile", n=n,
-                                  bucket=bucket)
-            self._prefill_shapes.add((n, bucket))
+                                  bucket=bucket, sp=use_sp)
+            self._prefill_shapes.add(shape_key)
             # np.asarray(toks) below is the host sync, so the span covers
             # dispatch + device prefill + arena insert honestly
             pt0 = prof.clock() if prof is not None else 0.0
-            with telemetry.span("serve/prefill", n=n, bucket=bucket):
-                toks, cache = self._jit_prefill(
+            with telemetry.span("serve/prefill", n=n, bucket=bucket,
+                                sp=use_sp):
+                toks, cache = prefill_fn(
                     self._prefill_params, jnp.asarray(ids),
                     jnp.asarray(lens), self._next_rng())
                 if self._handoff_sharding is not None:
@@ -884,6 +1305,10 @@ class ServingEngine:
                 prof.on_prefill(pt0, prof.clock(), n=n, bucket=bucket,
                                 stalled=n_decoding > 0)
             telemetry.count("serve/prefill_tokens", float(lens.sum()))
+            if use_sp:
+                # long prompts routed over the sp mesh axis (Ulysses)
+                telemetry.count("serve/sp_prefill_tokens",
+                                float(lens.sum()))
             self.metrics.on_prefill(n, bucket, int(lens.sum()),
                                     len(self._prefill_shapes))
             self.metrics.on_tokens(n)
@@ -912,11 +1337,19 @@ class ServingEngine:
 
     def _record_admit_patch(self, req: Request) -> None:
         slot = req.slot
+        if self.fused_prefill:
+            # this lane was admitted through a NON-inline path (prefix
+            # hit / sp prefill): it joins the scan in pure decode mode —
+            # stale inline mirrors from the slot's previous occupant
+            # must not shadow it
+            self._clear_pf_slot(slot)
         if req.status == "running":
             rem = min(req.max_new_tokens - len(req.tokens),
                       self.kv.allocator.remaining(slot))
             eos = -1 if req.eos_token_id is None else int(req.eos_token_id)
             patch = (int(req.tokens[-1]), req.prompt_len, rem, eos)
+            if self.fused_prefill:
+                patch = patch + (0,)        # pf_rem: already prefilled
             if self.speculative:
                 # the drafter mines the lane's full history: patch in the
                 # prompt + first token so n-gram lookup sees the prompt
@@ -977,21 +1410,43 @@ class ServingEngine:
         eos = np.full(B, -1, np.int32)
         hist = (np.zeros((B, self.max_seq_len), np.int32)
                 if self.speculative else None)
+        pf = np.zeros(B, np.int32) if self.fused_prefill else None
         for slot, req in self.scheduler.running.items():
-            tokens[slot] = self._last_token[slot]
-            positions[slot] = self.kv.fill[slot]
+            done = (self._pf_consumed.get(slot, req.prompt_len)
+                    if self.fused_prefill else req.prompt_len)
+            if pf is not None and done < req.prompt_len:
+                # mid-prompt lane: resumes in prefill mode; tokens come
+                # from the prompt buffer, not the carried last token
+                tokens[slot] = 0
+                positions[slot] = done
+                pf[slot] = req.prompt_len - done
+                remaining[slot] = min(
+                    req.max_new_tokens - len(req.tokens),
+                    self.kv.allocator.remaining(slot))
+            else:
+                tokens[slot] = self._last_token[slot]
+                positions[slot] = self.kv.fill[slot]
+                remaining[slot] = min(
+                    req.max_new_tokens - len(req.tokens),
+                    self.kv.allocator.remaining(slot))
             active[slot] = True
-            remaining[slot] = min(req.max_new_tokens - len(req.tokens),
-                                  self.kv.allocator.remaining(slot))
             if req.eos_token_id is not None:
                 eos[slot] = int(req.eos_token_id)
             if hist is not None:
                 hist[slot] = self._history_row(req)
         self._deact_slots.clear()
         self._admit_patches.clear()
+        if self.fused_prefill:
+            # a host rebuild collapses the launch horizon back onto the
+            # consumed cursor (any launched-but-unconsumed chunk is gone
+            # with the discarded in-flight chunk)
+            self._pf_launched = dict(self._pf_consumed)
+        out = (tokens, positions, active, remaining, eos)
+        if pf is not None:
+            out = out + (pf,)
         if hist is not None:
-            return tokens, positions, active, remaining, eos, hist
-        return tokens, positions, active, remaining, eos
+            out = out + (hist,)
+        return out
 
     def _history_row(self, req: Request) -> np.ndarray:
         """One lane's token history (prompt + emitted) padded to
@@ -1010,11 +1465,13 @@ class ServingEngine:
         in: lanes the scheduler finished for its own reasons (deadline)
         go inactive; freshly admitted requests get their full lane
         state."""
-        if self.speculative:
-            tok, pos, act, rem, eos, hist = chunk.state
-        else:
-            tok, pos, act, rem, eos = chunk.state
-            hist = None
+        tok, pos, act, rem, eos = chunk.state[:5]
+        i = 5
+        pf = None
+        if self.fused_prefill:
+            pf = chunk.state[i]
+            i += 1
+        hist = chunk.state[i] if self.speculative else None
         if self._deact_slots:
             telemetry.instant("serve/deact_patch",
                               n=len(self._deact_slots))
@@ -1040,14 +1497,22 @@ class ServingEngine:
             eos = eos.at[slots].set(
                 np.array([v[3] for v in vals], np.int32))
             act = act.at[slots].set(True)
+            vi = 4
+            if pf is not None:
+                pf = pf.at[slots].set(
+                    np.array([v[vi] for v in vals], np.int32))
+                vi += 1
             if hist is not None:
                 hist = hist.at[slots].set(
-                    np.stack([v[4] for v in vals]))
+                    np.stack([v[vi] for v in vals]))
         self._deact_slots.clear()
         self._admit_patches.clear()
+        out = (tok, pos, act, rem, eos)
+        if pf is not None:
+            out = out + (pf,)
         if hist is not None:
-            return tok, pos, act, rem, eos, hist
-        return tok, pos, act, rem, eos
+            out = out + (hist,)
+        return out
 
     def _launch_chunk(self, state: Tuple) -> _InflightChunk:
         """Enqueue one K-step decode chunk (returns immediately — JAX
@@ -1059,7 +1524,29 @@ class ServingEngine:
         # run asynchronously; the honest device wait is measured at
         # consume time as serve/chunk_host_wait
         with telemetry.span("serve/chunk_launch", k=self.decode_chunk):
-            if self.speculative:
+            if self.fused_prefill:
+                state = tuple(jnp.asarray(a) for a in state)
+                tokens, positions, active, remaining, eos, pf = (
+                    state[0], state[1], state[2], state[3], state[4],
+                    state[5])
+                pbuf = jnp.asarray(self._build_prompt_buf())
+                if self.speculative:
+                    hist = state[6]
+                    (toks, valid, new_cache, tok_f, pos_f, act_f, rem_f,
+                     pf_f, hist_f) = self._jit_decode_chunk(
+                        self._decode_params, self.kv.cache, tokens,
+                        positions, active, eos, remaining, pf, pbuf,
+                        hist, self._next_rng())
+                    carry = (tok_f, pos_f, act_f, rem_f, eos, pf_f,
+                             hist_f)
+                else:
+                    (toks, valid, new_cache, tok_f, pos_f, act_f, rem_f,
+                     pf_f) = self._jit_decode_chunk(
+                        self._decode_params, self.kv.cache, tokens,
+                        positions, active, eos, remaining, pf, pbuf,
+                        self._next_rng())
+                    carry = (tok_f, pos_f, act_f, rem_f, eos, pf_f)
+            elif self.speculative:
                 (tokens, positions, active, remaining, eos, hist) = (
                     jnp.asarray(a) for a in state)
                 (toks, valid, new_cache, tok_f, pos_f, act_f, rem_f,
@@ -1098,7 +1585,20 @@ class ServingEngine:
             toks = np.asarray(chunk.tokens)
             valid = np.asarray(chunk.valid)
         rt0 = prof.clock() if prof is not None else 0.0
+        inline_tokens = 0
+        n_first = 0
+        pf_steps = None
         with telemetry.span("serve/chunk_retire"):
+            if self.fused_prefill:
+                # deterministic host replay of the chunk's prefill-mode
+                # evolution: advances the consumed cursors and yields the
+                # per-lane pf-step mask for accounting
+                consumed, pf_steps = self._sim_chunk_prefill(chunk)
+                for slot, done in consumed.items():
+                    prev = self._pf_consumed.get(slot, done)
+                    inline_tokens += max(done - prev, 0)
+                    self._pf_consumed[slot] = done
+            fin_before = len(self.scheduler.finished)
             per_slot: Dict[int, List[int]] = {}
             for slot, uid in chunk.slot_uids.items():
                 req = self.scheduler.running.get(slot)
@@ -1106,10 +1606,34 @@ class ServingEngine:
                     continue        # slot retired/re-leased since launch
                 seq = [int(t) for t, v in
                        zip(toks[slot], valid[slot]) if v]
+                if (self.fused_prefill and seq
+                        and slot in self._pf_first_pending):
+                    # the lane completed its prompt inside this chunk:
+                    # token #1 routes through record_first_token (TTFT
+                    # stamp, NO allocator advance — its KV row is written
+                    # by the next decode step), and a deferred paged
+                    # admit plan publishes the prompt blocks now
+                    self._pf_first_pending.discard(slot)
+                    first = seq.pop(0)
+                    n_first += 1
+                    plan = self._pf_plans.pop(slot, None)
+                    if plan is not None:
+                        cow = self.kv.commit_prefix(plan, first)
+                        if self.kv.prefix_enabled:
+                            telemetry.count("serve/prefix_cache_miss")
+                            self.metrics.on_prefix(False)
+                        if cow is not None:
+                            telemetry.instant("serve/cow_fork", slot=slot)
+                            self.metrics.on_cow()
+                    self._last_token[slot] = first
+                    self.scheduler.record_first_token(req, first)
+                    if req.status != "running":
+                        seq = []    # retired on token #1: drop the rest
                 if seq:
                     per_slot[slot] = seq
                     self._last_token[slot] = seq[-1]
-            finished = self.scheduler.step_tokens_chunk(per_slot)
+            self.scheduler.step_tokens_chunk(per_slot)
+            finished = self.scheduler.finished[fin_before:]
         rt1 = prof.clock() if prof is not None else 0.0
         n_tokens = sum(len(v) for v in per_slot.values())
         proposed = accepted = 0
@@ -1119,17 +1643,33 @@ class ServingEngine:
                                queue_depth=self.scheduler.queue_depth,
                                occupancy=float(self.kv.occupancy))
         telemetry.count("serve/decode_tokens", float(n_tokens))
+        decode_iters = n_tokens      # 1 token per live decode step
+        if inline_tokens:
+            telemetry.count("serve/prefill_inline_tokens",
+                            float(inline_tokens))
+            self.inline_prefill_tokens += inline_tokens
+        if n_first:
+            self.metrics.on_tokens(n_first)
         if self.speculative:
             # acceptance accounting from the validity mask itself: a
             # step is live iff its base position (j == 0, the correction
             # /bonus slot always valid on live lanes) is valid; accepted
-            # drafts = valid tokens beyond that guaranteed one
+            # drafts = valid tokens beyond that guaranteed one. In fused
+            # mode a prefill-mode step also has column 0 valid on its
+            # completing iteration (token #1) but verified no drafts —
+            # the host-replayed pf mask excludes those steps
             kp1 = self.spec_k + 1
-            v3 = valid.reshape(self.max_batch, -1, kp1)
+            W = max(self.prefill_chunk, kp1) if self.fused_prefill \
+                else kp1
+            v3 = valid.reshape(self.max_batch, -1, W)
             live_steps = v3[:, :, 0]
-            proposed = int(live_steps.sum()) * self.spec_k
+            if pf_steps is not None:
+                live_steps = live_steps & ~pf_steps
+            decode_iters = int(live_steps.sum())
+            proposed = decode_iters * self.spec_k
             accepted = int(np.maximum(
-                v3.sum(axis=2) - live_steps, 0).sum())
+                np.where(live_steps, v3.sum(axis=2), 0) - live_steps,
+                0).sum())
             if proposed:
                 telemetry.count("serve/spec_proposed", float(proposed))
                 telemetry.count("serve/spec_accepted", float(accepted))
@@ -1147,20 +1687,89 @@ class ServingEngine:
                             float(self.kv.allocator.n_free
                                   * self._arena_bytes_per_slot))
         if prof is not None:
-            prof.on_chunk(launch_t=chunk.launch_t, hw0=hw0,
-                          hw1=rt0, rt0=rt0, rt1=rt1,
-                          n_tokens=n_tokens,
-                          occupancy=float(self.kv.occupancy),
-                          proposed=proposed, accepted=accepted)
+            if self.fused_prefill:
+                pf_total = int(pf_steps.sum()) if pf_steps is not None \
+                    else 0
+                prof.on_chunk(
+                    launch_t=chunk.launch_t, hw0=hw0,
+                    hw1=rt0, rt0=rt0, rt1=rt1,
+                    n_tokens=n_tokens,
+                    occupancy=float(self.kv.occupancy),
+                    proposed=proposed, accepted=accepted,
+                    inline_pf_tokens=inline_tokens,
+                    # every fused scan iteration is the same C-wide
+                    # compute: split the device span by step count
+                    inline_pf_frac=pf_total / max(
+                        pf_total + decode_iters, 1))
+            else:
+                prof.on_chunk(launch_t=chunk.launch_t, hw0=hw0,
+                              hw1=rt0, rt0=rt0, rt1=rt1,
+                              n_tokens=n_tokens,
+                              occupancy=float(self.kv.occupancy),
+                              proposed=proposed, accepted=accepted)
         self.metrics.on_tokens(n_tokens)
         self.metrics.on_decode_step()
         self.metrics.on_finished(finished)
         for req in finished:
             if req.slot is not None:
                 self._deact_slots.add(req.slot)
+                if self.fused_prefill:
+                    self._clear_pf_slot(req.slot)
         self.metrics.maybe_emit(self.scheduler.queue_depth,
                                 self.kv.occupancy)
         return finished
+
+    def _build_prompt_buf(self) -> np.ndarray:
+        """Per-scan-step prompt chunks [K, B, C] for lanes still in
+        prefill mode, advancing the LAUNCH cursor (it runs one chunk
+        horizon ahead of the consumed cursor under double-buffering).
+        Prefill-mode evolution on device is deterministic — a lane mid-
+        prompt cannot EOS or exhaust its budget — so this host mirror
+        stays exact without a device sync."""
+        K, B, C = self.decode_chunk, self.max_batch, self.prefill_chunk
+        buf = np.zeros((K, B, C), np.int32)
+        for slot, req in self.scheduler.running.items():
+            done = self._pf_launched.get(slot)
+            if done is None:
+                continue
+            prompt = np.asarray(req.prompt, np.int32)
+            L = req.prompt_len
+            for k in range(K):
+                if done >= L:
+                    break
+                n = min(C, L - done)
+                buf[k, slot, :n] = prompt[done:done + n]
+                done += n
+            self._pf_launched[slot] = done
+        return buf
+
+    def _sim_chunk_prefill(
+            self, chunk: _InflightChunk
+    ) -> Tuple[Dict[int, int], np.ndarray]:
+        """Deterministic host replay of the consumed chunk's prefill-
+        mode evolution (mirrors the device mask exactly: each step a
+        mid-prompt lane consumes ``min(pf, C)`` tokens). Returns the
+        advanced consumed cursors and the [B, K] mask of steps each
+        lane spent in prefill mode (its completing step — the one that
+        emits token #1 — included)."""
+        K, C = self.decode_chunk, self.prefill_chunk
+        pf_steps = np.zeros((self.max_batch, K), bool)
+        consumed: Dict[int, int] = {}
+        for slot, uid in chunk.slot_uids.items():
+            req = self.scheduler.running.get(slot)
+            if req is None or req.uid != uid:
+                continue
+            done = self._pf_consumed.get(slot)
+            if done is None or done >= req.prompt_len:
+                continue
+            L = req.prompt_len
+            for k in range(K):
+                if done >= L:
+                    break
+                pf_steps[slot, k] = True
+                done += min(C, L - done)
+            consumed[slot] = done
+        return consumed, pf_steps
 
     def _may_outlive_chunk(self) -> bool:
         """Could any lane still be live AFTER the in-flight chunk? (Host
@@ -1169,6 +1778,10 @@ class ServingEngine:
         launch so the drain tail doesn't pay a fully-dead chunk."""
         K = self.decode_chunk
         for slot, req in self.scheduler.running.items():
+            if (self.fused_prefill
+                    and self._pf_consumed.get(slot, req.prompt_len)
+                    < req.prompt_len):
+                return True      # still mid-prompt: more chunks coming
             rem = min(req.max_new_tokens - len(req.tokens),
                       self.kv.allocator.remaining(slot))
             if rem > K:

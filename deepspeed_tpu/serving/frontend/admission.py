@@ -151,7 +151,16 @@ class AdmissionConfig:
     frontend when left None) is rejected at offer time with
     ``memory_infeasible`` instead of being admitted and silently
     truncated at the arena edge. OFF by default — truncation is the
-    historical behavior."""
+    historical behavior.
+
+    ``fused_prefill_chunk`` switches the cost model for fused
+    chunked-prefill engines (wired from ``engine.prefill_chunk`` by the
+    frontend): prompt tokens no longer ride a separate bucketed prefill
+    program whose relative cost ``prefill_token_weight`` approximates —
+    they flow through the SAME decode scan, one C-token chunk per scan
+    step, so a prompt's decode-token-equivalent cost is exactly
+    ``ceil(prompt_len / C)`` scan steps. None keeps the bucket-weight
+    estimate."""
     max_pending: int = 256
     prefill_token_weight: float = 0.15
     feasibility_slack_s: float = 0.0
@@ -161,6 +170,17 @@ class AdmissionConfig:
         dataclasses.field(default_factory=dict)
     shed_memory_infeasible: bool = False
     slot_tokens: Optional[int] = None
+    fused_prefill_chunk: Optional[int] = None
+
+    def cost_tokens(self, ticket: "Ticket") -> float:
+        """Decode-token-equivalent cost of serving ``ticket`` under the
+        active cost model: scan steps (``ceil(prompt_len / chunk) +
+        max_new_tokens``) when the engine inlines prefill chunks into
+        the decode scan, weighted prompt tokens otherwise."""
+        if self.fused_prefill_chunk:
+            chunks = -(-ticket.prompt_len // self.fused_prefill_chunk)
+            return float(chunks + ticket.max_new_tokens)
+        return ticket.cost_tokens(self.prefill_token_weight)
 
 
 @dataclasses.dataclass
@@ -296,15 +316,14 @@ class AdmissionController:
                     sheds.append((ticket, REJECT_DEADLINE_EXPIRED))
                     continue
                 if ticket.deadline_s is not None and rate:
-                    cost = ticket.cost_tokens(cfg.prefill_token_weight)
+                    cost = cfg.cost_tokens(ticket)
                     eta = now + (backlog_tokens + cost) / rate
                     if eta > ticket.deadline_s + cfg.feasibility_slack_s:
                         self.n_shed += 1
                         sheds.append((ticket, REJECT_DEADLINE_INFEASIBLE))
                         continue
                 admits.append(ticket)
-                backlog_tokens += ticket.cost_tokens(
-                    cfg.prefill_token_weight)
+                backlog_tokens += cfg.cost_tokens(ticket)
             pending = self._pending
         for _, reason in sheds:
             telemetry.count(f"frontend/shed/{reason}", 1.0)

@@ -236,6 +236,12 @@ class ServingFrontend:
             # memory-aware shedding sized from the engine's own arena:
             # one slot row holds at most max_seq_len KV positions
             cfg.slot_tokens = engine.max_seq_len
+        if cfg.fused_prefill_chunk is None and \
+                getattr(engine, "fused_prefill", False):
+            # fused chunked prefill: prompts ride the decode scan at
+            # prefill_chunk tokens per step, so the admission cost model
+            # counts scan steps, not bucket-weighted prompt tokens
+            cfg.fused_prefill_chunk = int(engine.prefill_chunk)
         self._estimator = ChunkThroughputEstimator()
         self.tracing = TraceLog(monitor, keep_last=trace_keep_last,
                                 clock=clock)
@@ -621,11 +627,23 @@ class ServingFrontend:
         self._feed()
         if eng.scheduler.has_work() or eng.chunk_in_flight:
             tokens_before = eng.metrics.tokens_out
+            inline_before = getattr(eng, "inline_prefill_tokens", 0)
             t0 = time.perf_counter()
             finished = eng.pump()
             dt = time.perf_counter() - t0
-            self._estimator.record(eng.metrics.tokens_out - tokens_before,
-                                   dt)
+            produced = eng.metrics.tokens_out - tokens_before
+            chunk = self._controller.config.fused_prefill_chunk
+            if chunk:
+                # inline prompt chunks consume scan steps exactly like
+                # decode tokens do: fold them into the throughput EWMA
+                # in the same decode-token-equivalent unit the cost
+                # model bills, or a prefill-heavy chunk would read as a
+                # throughput collapse and shed feasible deadlines
+                inline = getattr(eng, "inline_prefill_tokens", 0) \
+                    - inline_before
+                if inline > 0:
+                    produced += -(-inline // chunk)
+            self._estimator.record(produced, dt)
             rate = self._estimator.rate()
             if rate is not None:
                 telemetry.gauge("admission/ewma_tokens_per_s", float(rate))
@@ -653,11 +671,24 @@ class ServingFrontend:
         room = self._feed_depth - len(sched.queue)
         if room <= 0 or self._controller.pending == 0:
             return
-        w = self._controller.config.prefill_token_weight
+        cfg = self._controller.config
         backlog = sum(r.max_new_tokens - len(r.tokens)
                       for r in sched.running.values())
-        backlog += sum(q.max_new_tokens + q.prompt_len * w
-                       for q in sched.queue)
+        chunk = cfg.fused_prefill_chunk
+        if chunk:
+            backlog += sum(
+                q.max_new_tokens + -(-q.prompt_len // chunk)
+                for q in sched.queue)
+            # mid-prompt lanes still owe their remaining inline chunks
+            # before they emit a single decode token
+            for slot, done in getattr(eng, "_pf_consumed", {}).items():
+                req = sched.running.get(slot)
+                if req is not None and done < req.prompt_len:
+                    backlog += -(-(req.prompt_len - done) // chunk)
+        else:
+            w = cfg.prefill_token_weight
+            backlog += sum(q.max_new_tokens + q.prompt_len * w
+                           for q in sched.queue)
         admits, sheds = self._controller.pop(
             room=room, rate=self._estimator.rate(), backlog_tokens=backlog)
         for ticket, reason in sheds:

@@ -631,6 +631,30 @@ class PagedKVCacheManager:
     def take_plan(self, slot: int) -> PagedAdmitPlan:
         return self.allocator.plans.pop(slot)
 
+    def install_table(self, slot: int) -> None:
+        """Install a MISS lane's block table + fill on device WITHOUT a
+        prefill insert — the fused-prefill admission path: the decode
+        scan itself writes the prompt's KV block-granularly, it only
+        needs the lane's table row and write index live first. Reuses
+        the hit-fork program with a self-copy (src == dst, a no-op
+        block write)."""
+        import jax.numpy as jnp
+        t0 = int(self.allocator.tables[slot][0])
+        self.cache = self._fork(
+            self.cache, jnp.int32(slot),
+            jnp.asarray(self.allocator.padded_table(slot)),
+            jnp.int32(int(self.allocator.fill[slot])),
+            jnp.int32(t0), jnp.int32(t0))
+
+    def abandon_plan(self, plan: PagedAdmitPlan) -> None:
+        """Walk back a MISS plan whose lane retired before its first
+        token (fused-prefill cancel / expiry mid-prompt): drop the
+        pending-prompt key so duplicate prompts stop deferring on a
+        commit that will never come. The lane's blocks free through the
+        normal slot release."""
+        if plan.key is not None:
+            self.allocator._pending.discard(plan.key)
+
     def update(self, new_cache: Any) -> None:
         self.cache = new_cache
 

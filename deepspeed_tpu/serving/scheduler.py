@@ -149,12 +149,22 @@ class ContinuousBatchScheduler:
         return False
 
     # ---------------------------------------------------------- admission
-    def admit(self) -> List[Request]:
+    def admit(self, token_budget: Optional[int] = None,
+              lane_cost=None) -> List[Request]:
         """FIFO admission while slots are free. Deadline-expired queued
         requests are shed here (never prefilled). Returned requests have
         ``.slot`` leased; the caller prefills, inserts into the arena, and
-        reports the prefill's sampled token via ``record_first_token``."""
+        reports the prefill's sampled token via ``record_first_token``.
+
+        Fused chunked-prefill engines pass a ``token_budget`` (the chunk
+        token budget's free headroom) and a ``lane_cost(req)`` callable
+        (the per-scan-step cost the new lane adds — its first prompt
+        chunk, or one decode token): admission stops at the first request
+        that would overflow the budget, EXCEPT that an otherwise-idle
+        engine always admits one (a budget must never starve an empty
+        scan). Both default to None — plain slot-bound FIFO admission."""
         admitted: List[Request] = []
+        budget = token_budget
         while self.queue:
             req = self.queue[0]
             if (req.deadline_s is not None
@@ -162,6 +172,11 @@ class ContinuousBatchScheduler:
                 self.queue.popleft()
                 self._finish(req, "expired")
                 continue
+            if budget is not None and lane_cost is not None:
+                cost = lane_cost(req)
+                if cost > budget and (self.running or admitted):
+                    break
+                budget -= cost
             slot = self._lease(req)
             if slot is None:
                 break

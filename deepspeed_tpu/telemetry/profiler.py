@@ -116,6 +116,13 @@ class ChunkProfiler:
         self.prefill_s = 0.0
         self.prefill_stall_s = 0.0
         self.n_stalled_prefills = 0
+        # fused chunked prefill (serving/engine.py fused_prefill=True):
+        # prompt chunks ride the decode scan, so their cost is a SHARE
+        # of device_compute_s, not a separate host window — tracked as
+        # a sub-attribution that never double-counts against the four
+        # disjoint components
+        self.prefill_inline_s = 0.0
+        self.prefill_inline_tokens = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
 
@@ -150,11 +157,20 @@ class ChunkProfiler:
     def on_chunk(self, launch_t: float, hw0: float, hw1: float,
                  rt0: float, rt1: float, n_tokens: int = 0,
                  occupancy: float = 0.0, proposed: int = 0,
-                 accepted: int = 0) -> None:
+                 accepted: int = 0, inline_pf_tokens: int = 0,
+                 inline_pf_frac: float = 0.0) -> None:
         """One chunk retirement: close out the iteration and attribute
         its wall time. ``launch_t`` is the dispatch-complete stamp of
         the chunk being retired; ``hw0..hw1`` the host-wait sync
-        window; ``rt0..rt1`` the retire bookkeeping window."""
+        window; ``rt0..rt1`` the retire bookkeeping window.
+
+        ``inline_pf_tokens`` / ``inline_pf_frac`` come from the fused
+        chunked-prefill engine: the prompt tokens this chunk appended
+        in-scan and the fraction of the chunk's scan iterations spent
+        in prefill mode. ``inline_pf_frac × device_compute`` accrues to
+        ``prefill_inline_s`` — a sub-attribution WITHIN the device
+        component (the four components still sum to wall; inline
+        prefill is device work, not a stall)."""
         with self._lock:
             launches = self._pending_launches
             if launches:
@@ -197,6 +213,9 @@ class ChunkProfiler:
             self.scheduler_s += sched
             self.bubble_s += bubble
             self.n_tokens += n_tokens
+            if inline_pf_frac > 0.0:
+                self.prefill_inline_s += inline_pf_frac * device
+            self.prefill_inline_tokens += inline_pf_tokens
             self.spec_proposed += proposed
             self.spec_accepted += accepted
             self._rolling.append((wall, bubble, occupancy))
@@ -208,9 +227,11 @@ class ChunkProfiler:
             if emit:
                 bf = self._bubble_fraction_locked()
                 stall = self.prefill_stall_s
+                inline = self.prefill_inline_s
         if emit:
             self._gauge("serve/bubble_fraction", float(bf))
             self._gauge("serve/prefill_stall_s", float(stall))
+            self._gauge("serve/prefill_inline_s", float(inline))
 
     # ------------------------------------------------------- derivation
     def _bubble_fraction_locked(self) -> float:
@@ -245,6 +266,8 @@ class ChunkProfiler:
             self.prefill_s = 0.0
             self.prefill_stall_s = 0.0
             self.n_stalled_prefills = 0
+            self.prefill_inline_s = 0.0
+            self.prefill_inline_tokens = 0
             self.spec_proposed = 0
             self.spec_accepted = 0
 
@@ -288,6 +311,11 @@ class ChunkProfiler:
                     "total_s": self.prefill_s,
                     "stall_s": self.prefill_stall_s,
                     "n_stalled": self.n_stalled_prefills,
+                    # fused chunked prefill: prompt tokens appended
+                    # inside the decode scan (device-side work, part of
+                    # device_compute_s — never a stall)
+                    "inline_s": self.prefill_inline_s,
+                    "inline_tokens": self.prefill_inline_tokens,
                 },
                 "occupancy": {
                     "mean": (sum(occs) / len(occs)) if occs else 0.0,

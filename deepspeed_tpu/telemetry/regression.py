@@ -119,6 +119,18 @@ SERVING_SPECS: List[MetricSpec] = [
     MetricSpec(("int8_kv", "kv_bytes_saved"), SHIFT, abs_tol=0.0),
     MetricSpec(("int8_kv", "decode_chunk_compiles"), SHIFT, abs_tol=0.0,
                note="pinned int8 retrace budget"),
+    # ---- fused chunked prefill (--fused A/B vs the bucketed reference) ----
+    MetricSpec(("fused", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="fused chunked prefill vs bucketed bit-exactness "
+                    "is binary"),
+    MetricSpec(("fused", "decode_chunk_compiles"), SHIFT, abs_tol=0.0,
+               note="pinned fused retrace budget"),
+    MetricSpec(("fused", "inline_prefill_tokens"), SHIFT, abs_tol=0.0,
+               note="every prompt token of the pinned workload appends "
+                    "in-scan — deterministic count"),
+    MetricSpec(("fused", "prefill_stall_s"), LOWER, 0.50, abs_tol=0.05,
+               note="fused mode must keep decode launches free of "
+                    "prefill preemption (ROADMAP item 4: ~0)"),
 ]
 
 FRONTEND_SPECS: List[MetricSpec] = [
@@ -163,6 +175,23 @@ FRONTEND_SPECS: List[MetricSpec] = [
                 "goodput_fraction"), SHIFT, abs_tol=0.0,
                note="parity traffic has no SLO and all finishes done — "
                     "goodput is exactly 1.0"),
+    # ---- fused chunked prefill under the mixed long-prompt/short-decode
+    # overload (the ROADMAP item-4 gate) ----
+    MetricSpec(("fused_mixed", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="fused vs bucketed token streams under the mixed "
+                    "workload, binary"),
+    MetricSpec(("fused_mixed", "tpot_p99_improvement"), HIGHER, 0.40,
+               abs_tol=2.0,
+               note="fused p99 TPOT speedup over bucketed; the >= 2x "
+                    "acceptance floor is asserted inside the bench"),
+    MetricSpec(("fused_mixed", "ttft_p99_ratio"), LOWER, 0.60,
+               abs_tol=0.5,
+               note="fused TTFT p99 / bucketed TTFT p99 — chunking the "
+                    "prompt must not blow up time-to-first-token"),
+    MetricSpec(("fused_mixed", "profile", "prefill", "stall_s"), LOWER,
+               0.50, abs_tol=0.05,
+               note="in-scan prompt chunks cannot preempt decode "
+                    "launches: stall stays ~0 in fused profiles"),
 ]
 
 FLEET_SPECS: List[MetricSpec] = [

@@ -446,6 +446,12 @@ def _serving_doc(**over):
             "kv_bytes_saved": 385024,
             "decode_chunk_compiles": 3,
         },
+        "fused": {
+            "greedy_parity": True,
+            "decode_chunk_compiles": 3,
+            "inline_prefill_tokens": 65,
+            "prefill_stall_s": 0.0,
+        },
     }
     doc.update(over)
     return doc

@@ -553,6 +553,98 @@ def test_int8_decode_paths_at_declared_budgets():
             == INT8_PAGED_DECODE_PROGRAM_BUDGET)
 
 
+def test_fused_decode_paths_at_declared_budgets():
+    """The FUSED chunked-prefill scan programs (decode_chunk_fused_fn /
+    decode_chunk_fused_paged_fn) — prompt chunks consumed by the decode
+    scan body behind a per-lane mode mask. The dense variant inherits
+    the dense retrace physics (3); the paged variant pays two extra
+    carry retraces over the paged chunk's budget (4, see
+    benchmarks/serving_bench.FUSED_*_PROGRAM_BUDGET). The per-lane
+    prompt cursors, chunk buffers and mode masks ride as jit arguments
+    and carry leaves, so admission churn and prompt-length variation
+    must never leak shape or dtype variation into the scan program."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import (
+        FUSED_DECODE_PROGRAM_BUDGET, FUSED_PAGED_DECODE_PROGRAM_BUDGET,
+        _tiny_model)
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 7, 12, 4)]
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_fused_fn": FUSED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4, fused_prefill=True,
+                                prefill_chunk=8)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert (aud.compiles("decode_chunk_fused_fn")
+            == FUSED_DECODE_PROGRAM_BUDGET)
+    # every prompt token was consumed in-scan — the bucketed prefill
+    # program family never traced (its record exists from the jit wrap,
+    # with zero compiles and zero calls)
+    assert serving.inline_prefill_tokens == 3 * sum(
+        len(p) for p in prompts)
+    assert aud.compiles("prefill") == 0
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_fused_paged_fn":
+                 FUSED_PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4, fused_prefill=True,
+                                prefill_chunk=8, paged=True,
+                                prefix_cache=False)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert (aud.compiles("decode_chunk_fused_paged_fn")
+            == FUSED_PAGED_DECODE_PROGRAM_BUDGET)
+
+
+def test_sp_prefill_path_at_declared_budget():
+    """The sequence-parallel prefill program (prefill_sp_fn) compiles
+    ONCE per prefill bucket: the Ulysses-sharded forward takes the
+    padded (n, bucket) batch exactly like the bucketed program, so
+    prompt-length variation above the threshold lands in the same
+    program and only a new bucket may trace."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import _tiny_model
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    # every prompt >= the threshold -> all admissions route through the
+    # sp program, all inside the one 16-token bucket
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 12, 16, 14)]
+
+    aud = TraceAuditor(budgets={"prefill_sp_fn": 1}, audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4, sp_prefill_threshold=12)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert aud.compiles("prefill_sp_fn") == 1
+    assert aud.records["prefill_sp_fn"].calls >= 3
+
+
 def test_train_step_path_at_declared_budget():
     """The fused train step compiles exactly twice — the initial trace
     (freshly initialized state) plus one retrace when call 2 feeds back
