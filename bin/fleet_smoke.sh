@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Fleet smoke: the fleet serving benchmark on CPU. Six asserted cases:
+# Fleet smoke: the fleet serving benchmark on CPU. Seven asserted cases:
 # 2-replica FleetRouter >= 1.6x a 1-replica router over
 # simulated-compute replicas (real scheduler/admission/stream stack,
 # sleep-for-device — one XLA CPU engine already saturates every host
@@ -9,7 +9,13 @@
 # the 8-virtual-device mesh bit-identical to tp=1 under the pinned
 # decode_chunk_tp2_fn budget; disaggregated prefill bit-identical to
 # co-located paged with exactly one D2D handoff per prefill under the
-# pinned decode_chunk_paged_disagg_fn budget; an injected mid-stream
+# pinned decode_chunk_paged_disagg_fn budget; the cross-host transport
+# case (--transport) — an all-remote fleet over loopback dstpu-fleet-v1
+# HTTP streams bit-identical to the in-process paged engine, one
+# running request live-migrates its KV blocks + cursor mid-decode and
+# finishes bit-identical, and a skewed 3-replica simulated fleet's
+# rebalance passes keep the post-rebalance occupancy spread under the
+# unbalanced control's with zero lost/duplicated tokens; an injected mid-stream
 # replica crash loses NOTHING (the wedged request replays its prompt +
 # emitted prefix on the survivor, bit-identical) while producing a
 # fully-connected journey trace (one trace id per request incl.
@@ -28,8 +34,8 @@
 
 cd "$(dirname "$0")/.." || exit 1
 
-exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+exec timeout -k 10 780 env JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m deepspeed_tpu.benchmarks.fleet_bench \
     --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
-    --decode-chunk 8 --json-out BENCH_fleet.json
+    --decode-chunk 8 --transport --json-out BENCH_fleet.json

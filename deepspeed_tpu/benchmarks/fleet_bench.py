@@ -1,7 +1,8 @@
 """Fleet serving benchmark: replica routing, tp=2, disaggregation,
-crash observability, and elastic recovery.
+cross-host transport + live migration, crash observability, and
+elastic recovery.
 
-Six cases over one tiny model (CPU-runnable, smoke-sized):
+Seven cases over one tiny model (CPU-runnable, smoke-sized):
 
   * router scaling — a 2-replica :class:`FleetRouter` against a
     1-replica router on SIMULATED-compute replicas: engines that honor
@@ -33,6 +34,20 @@ Six cases over one tiny model (CPU-runnable, smoke-sized):
   * disaggregated prefill — paged prefill slice + decode slice:
     greedy parity against the co-located paged engine, pinned compile
     count, and exactly one D2D handoff per prefilled request.
+
+  * cross-host transport + live migration — the same fleet surface over
+    the ``dstpu-fleet-v1`` streaming HTTP transport: two REAL paged
+    engines behind :class:`ReplicaServer`/:class:`RemoteReplica`
+    loopback pairs, routed streams greedy bit-identical to the
+    in-process paged engine; one running request is then live-migrated
+    mid-decode (KV blocks + block table + cursor over the wire) and
+    must finish bit-identical with zero lost or duplicated tokens.
+    A second leg runs a 3-replica SIMULATED fleet under a skewed
+    arrival (everything lands on one replica), with periodic
+    ``FleetRouter.rebalance`` passes: the post-rebalance occupancy
+    spread must stay below the unbalanced control run's, again with
+    zero lost/duplicated tokens, and the merged journey export must
+    validate with its migration hops connected.
 
   * crash observability — an injected mid-decode-chunk replica crash
     over a 2-replica fleet: ZERO requests resolve error (the wedged
@@ -154,6 +169,76 @@ class SimulatedEngine:
             self.metrics.tokens_out += n
         return self.scheduler.finished[before:]
 
+    # ---- live-migration surface (the ServingEngine contract with the
+    # device state reduced to the decode cursor: a simulated request's
+    # "KV" is fully determined by prompt + emitted tokens, so the
+    # bundle ships an empty leaf dict and the importer just re-seats
+    # the cursor) ----
+    def can_migrate(self, req) -> bool:
+        if req.status != "running" or not req.tokens:
+            return False
+        slot = req.slot
+        return slot is not None and self.scheduler.running.get(slot) is req
+
+    def export_request(self, req):
+        from ..serving.engine import MIGRATE_SCHEMA, MigrationError
+        if not self.can_migrate(req):
+            raise MigrationError(
+                f"request uid={req.uid} is not migratable "
+                f"(status={req.status!r})")
+        fill = req.prompt_len + len(req.tokens) - 1
+        return {
+            "schema": MIGRATE_SCHEMA,
+            "prompt": [int(t) for t in np.asarray(req.prompt)],
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": req.eos_token_id,
+            "deadline_s": req.deadline_s,
+            "tenant": req.tenant,
+            "trace_id": req.trace_id,
+            "fill": int(fill),
+            "block_size": 1,
+            "n_blocks": int(fill),
+            "kv_bytes": 0,
+            "kv": {},
+        }
+
+    def import_request(self, bundle):
+        from ..serving.engine import MIGRATE_SCHEMA, MigrationError
+        from ..serving.scheduler import Request
+        if bundle.get("schema") != MIGRATE_SCHEMA:
+            raise MigrationError(
+                f"unknown migration schema {bundle.get('schema')!r}")
+        prompt = np.asarray(bundle["prompt"], np.int32)
+        tokens = [int(t) for t in bundle["tokens"]]
+        fill = int(bundle["fill"])
+        if fill != prompt.shape[0] + len(tokens) - 1:
+            raise MigrationError(
+                f"bundle cursor fill={fill} inconsistent with "
+                f"prompt_len={prompt.shape[0]} + {len(tokens)} tokens")
+        if fill + 1 > self.max_seq_len:
+            raise MigrationError(
+                f"sequence length {fill + 1} exceeds this replica's "
+                f"max_seq_len {self.max_seq_len}")
+        slot = self.scheduler.allocator.alloc(fill)
+        if slot is None:
+            raise MigrationError(
+                "no free slot for the incoming request")
+        req = Request(prompt=prompt,
+                      max_new_tokens=int(bundle["max_new_tokens"]),
+                      eos_token_id=bundle.get("eos_token_id"),
+                      deadline_s=bundle.get("deadline_s"),
+                      trace_id=bundle.get("trace_id"),
+                      tenant=bundle.get("tenant") or "default")
+        now = self.scheduler.clock()
+        req.submit_t = now
+        req.first_token_t = now
+        req.status = "running"
+        req.slot = slot
+        req.tokens = tokens
+        self.scheduler.running[slot] = req
+        return req
+
 
 def _sim_router_pass(n_replicas: int, prompts, max_new_tokens: int,
                      max_batch: int, decode_chunk: int,
@@ -207,7 +292,8 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               decode_chunk: int = 8, seed: int = 0,
               sim_requests: int = 16,
               sim_chunk_time_s: float = 0.005,
-              slo: bool = True, trace_out: Optional[str] = None) -> dict:
+              slo: bool = True, transport: bool = True,
+              trace_out: Optional[str] = None) -> dict:
     import jax.numpy as jnp
     import deepspeed_tpu as ds
     from .. import telemetry
@@ -388,6 +474,13 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
             f"expected {n_requests} D2D handoffs (one per executed "
             f"prefill; prefix cache covers the warm repeats), "
             f"saw {handoffs}")
+
+    # ---- cross-host transport + live KV-block migration ----------------
+    # before the crash cases: this case's parity asserts need a fleet
+    # whose crash/reroute counters stay zero
+    if transport:
+        result.update(_transport_case(
+            inf, eng_kw, prompts, paged_out, max_new_tokens))
 
     # ---- crash journeys + SLO burn + flight recorder -------------------
     # LAST on purpose: these cases inject mid-stream replica crashes,
@@ -806,6 +899,275 @@ def _elastic_case(inf, eng_kw, prompts, oracle_out, max_new_tokens, *,
     }}
 
 
+def _sim_expected(prompt, max_new: int):
+    """The SimulatedEngine's deterministic greedy stream: token #1 is
+    ``prompt[-1]`` (sampled at prefill), token k >= 1 is
+    ``prompt[k % prompt_len]`` — position-keyed, so a migrated
+    continuation is bit-identical iff the cursor moved intact."""
+    plen = len(prompt)
+    return [int(prompt[-1])] + [int(prompt[k % plen])
+                                for k in range(1, max_new)]
+
+
+def _transport_sim_fleet(*, rebalance: bool, n_replicas: int = 3,
+                         n_requests: int = 12, prompt_len: int = 16,
+                         max_new: int = 48, chunk_time_s: float = 0.02,
+                         seed: int = 1) -> dict:
+    """One skewed routed run over REMOTE simulated replicas: every
+    request is aimed at replica 0 (the others are briefly unroutable),
+    then the fleet either rebalances periodically (``rebalance=True``)
+    or serves the skew as-is (the control). Occupancy spread is
+    sampled right after each rebalance pass — the bounded quantity the
+    ISSUE gates — over the window where every pending stream still has
+    at least 16 tokens to go (so a picked candidate can never finish
+    under the migration's feet)."""
+    from ..serving import FleetRouter
+    from ..serving.fleet import RemoteReplica, ReplicaServer
+    from ..serving.frontend.frontend import ServingFrontend
+    from ..telemetry.journey import validate_journeys
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 512, (prompt_len,)).astype(np.int32)
+               for _ in range(n_requests)]
+    engines = [SimulatedEngine(max_batch=4, decode_chunk=4,
+                               chunk_time_s=chunk_time_s)
+               for _ in range(n_replicas)]
+    fronts = [ServingFrontend(eng, telemetry_label=f"sim{i}")
+              for i, eng in enumerate(engines)]
+    servers = [ReplicaServer(fe) for fe in fronts]
+    remotes = [RemoteReplica("127.0.0.1", srv.port, label=f"sim{i}")
+               for i, srv in enumerate(servers)]
+    router = FleetRouter([], remotes=remotes)
+    spreads: list = []
+    n_moves = 0
+    try:
+        for rep in router.replicas[1:]:
+            rep.dead = True        # the skew: everything lands on sim0
+        handles = [router.submit(p, max_new_tokens=max_new)
+                   for p in prompts]
+        # wait for every accepted frame so migrate_out always finds its
+        # client-side handle (otherwise an early rebalance pass reads
+        # as a spurious failure)
+        t_acc = time.monotonic() + 30.0
+        while any(h._remote_uid is None and not h.done for h in handles) \
+                and time.monotonic() < t_acc:
+            time.sleep(0.002)
+        for rep in router.replicas[1:]:
+            rep.dead = False
+        deadline = time.monotonic() + 120.0
+        while not all(h.done for h in handles):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "transport sim fleet wedged: "
+                    f"{[h.status for h in handles]}")
+            pending = [h for h in handles if not h.done]
+            in_window = pending and max(
+                len(h.tokens) for h in pending) <= max_new - 16
+            if in_window:
+                if rebalance:
+                    n_moves += len(router.rebalance(
+                        spread_threshold=2, max_moves=2))
+                occ = [int(r.frontend.load_snapshot()
+                           .get("engine_running", 0))
+                       for r in router.replicas]
+                spreads.append(max(occ) - min(occ))
+            time.sleep(0.01)
+        errors = sum(1 for h in handles if h.status != "done")
+        lost = dup = 0
+        parity = True
+        for h, p in zip(handles, prompts):
+            exp = _sim_expected(p, max_new)
+            got = [int(t) for t in h.tokens]
+            lost += max(0, len(exp) - len(got))
+            dup += max(0, len(got) - len(exp))
+            if got != exp:
+                parity = False
+        stats = router.stats()
+        if rebalance:
+            problems = validate_journeys(router.export_chrome(None))
+            if problems:
+                raise RuntimeError(
+                    "transport journey validation failed: "
+                    + "; ".join(problems[:5]))
+    finally:
+        router.close(timeout=30)
+        for srv in servers:
+            srv.close()
+        for fe in fronts:
+            fe.close(timeout=10)
+    return {
+        "parity": parity, "errors": errors, "lost": lost, "dup": dup,
+        "n_migrated": int(stats["migrated"]),
+        "n_migrate_failed": int(stats["migrate_failed"]),
+        "n_moves": n_moves,
+        "mean_spread": float(np.mean(spreads)) if spreads else 0.0,
+        "n_requests": n_requests,
+    }
+
+
+def _transport_case(inf, eng_kw, prompts, paged_out,
+                    max_new_tokens: int) -> dict:
+    """Cross-host transport + live migration, two legs:
+
+    * REAL engines over loopback HTTP — a fleet built entirely from
+      :class:`RemoteReplica` clients (``engines=[]``) must stream
+      greedy bit-identical to the in-process paged engine, and one
+      running request live-migrates mid-decode (KV blocks + cursor
+      over the wire) finishing bit-identical with zero lost or
+      duplicated tokens;
+    * SIMULATED 3-replica fleet under skew — periodic ``rebalance``
+      passes keep the sampled post-rebalance occupancy spread below
+      the unbalanced control run's mean, with zero lost/duplicated
+      tokens and a validating journey export (migration hops
+      connected).
+
+    The source replica's decode chunk is throttled (a plain sleep
+    wrapper — the driver thread must keep reaching iteration
+    boundaries, where migration verbs execute) so the stream is
+    reliably mid-flight when the migration lands.
+    """
+    from ..serving import FleetRouter, ServingEngine
+    from ..serving.fleet import RemoteReplica, ReplicaServer
+    from ..serving.frontend.frontend import ServingFrontend
+    from ..telemetry.journey import validate_journeys
+
+    engines = [ServingEngine(engine=inf, paged=True, **eng_kw)
+               for _ in range(2)]
+    for eng in engines:                     # charge compiles up front
+        eng.run(list(prompts), max_new_tokens=max_new_tokens)
+    # the migration leg's oracle: computed in-process BEFORE the
+    # frontends take the engines over; sized to fit the tiny model's
+    # max_seq_len with the full prompt
+    mig_prompt = prompts[0]
+    mig_new = int(engines[0].max_seq_len) - len(mig_prompt) - 8
+    if mig_new < 16:
+        raise RuntimeError(
+            f"model too small for the migration leg: mig_new={mig_new}")
+    mig_oracle = engines[0].run(
+        [mig_prompt], max_new_tokens=mig_new)[0].output_ids
+
+    fronts = [ServingFrontend(eng, telemetry_label=str(i))
+              for i, eng in enumerate(engines)]
+    servers = [ReplicaServer(fe) for fe in fronts]
+    remotes = [RemoteReplica("127.0.0.1", srv.port, label=f"loop{i}")
+               for i, srv in enumerate(servers)]
+    router = FleetRouter([], remotes=remotes)
+    real_chunk = engines[0]._jit_decode_chunk
+    try:
+        # ---- leg 1a: loopback streaming parity -------------------------
+        handles = [router.submit(p, max_new_tokens=max_new_tokens)
+                   for p in prompts]
+        statuses = [h.result(timeout=300) for h in handles]
+        real_errors = sum(1 for s in statuses if s != "done")
+        loop_parity = (real_errors == 0 and all(
+            np.array_equal(h.output_ids, paged_out[i])
+            for i, h in enumerate(handles)))
+        if not loop_parity:
+            raise RuntimeError(
+                "loopback-transport routed streams diverged from the "
+                f"in-process paged engine: statuses={statuses}")
+
+        # ---- leg 1b: live KV-block migration mid-decode ----------------
+        def slow_chunk(*a, **k):
+            time.sleep(0.05)                # widen the mid-flight window
+            return real_chunk(*a, **k)
+
+        engines[0]._jit_decode_chunk = slow_chunk
+        rep0, rep1 = router.replicas
+        rep1.dead = True                    # deterministic placement
+        mig_h = router.submit(mig_prompt, max_new_tokens=mig_new)
+        t_mig = time.monotonic() + 60.0
+        while (mig_h._remote_uid is None or len(mig_h.tokens) < 4) \
+                and not mig_h.done and time.monotonic() < t_mig:
+            time.sleep(0.005)
+        rep1.dead = False
+        if mig_h.done or mig_h._remote_uid is None:
+            raise RuntimeError(
+                "migration target stream was not mid-flight: "
+                f"status={mig_h.status} tokens={len(mig_h.tokens)}")
+        if not router.migrate(int(mig_h._remote_uid), rep0, rep1):
+            raise RuntimeError("live migration of the throttled stream "
+                               "failed")
+        engines[0]._jit_decode_chunk = real_chunk
+        if mig_h.result(timeout=120) != "done":
+            raise RuntimeError(
+                f"migrated stream did not finish: {mig_h.status}")
+        mig_parity = bool(np.array_equal(mig_h.output_ids, mig_oracle))
+        if not mig_parity:
+            raise RuntimeError(
+                "migrated stream diverged from the never-moved oracle")
+        if len(mig_h.tokens) != mig_new:
+            raise RuntimeError(
+                f"migrated stream lost or duplicated tokens: "
+                f"{len(mig_h.tokens)} != {mig_new}")
+        real_stats = router.stats()
+        if (real_stats["migrated"] != 1 or real_stats["migrate_failed"]
+                or real_stats["migrate_bytes"] <= 0):
+            raise RuntimeError(
+                f"migration counters off: migrated="
+                f"{real_stats['migrated']} "
+                f"failed={real_stats['migrate_failed']} "
+                f"bytes={real_stats['migrate_bytes']}")
+        problems = validate_journeys(router.export_chrome(None))
+        if problems:
+            raise RuntimeError(
+                "transport journey validation failed: "
+                + "; ".join(problems[:5]))
+        real_lost = max(0, mig_new - len(mig_h.tokens))
+        real_dup = max(0, len(mig_h.tokens) - mig_new)
+    finally:
+        engines[0]._jit_decode_chunk = real_chunk
+        router.close(timeout=60)
+        for srv in servers:
+            srv.close()
+        for fe in fronts:
+            fe.close(timeout=10)
+
+    # ---- leg 2: skewed simulated fleet, rebalance vs control -----------
+    rebal = _transport_sim_fleet(rebalance=True)
+    control = _transport_sim_fleet(rebalance=False)
+    if not (rebal["parity"] and control["parity"]):
+        raise RuntimeError(
+            f"simulated transport streams diverged: rebal={rebal} "
+            f"control={control}")
+    if rebal["n_migrated"] < 1:
+        raise RuntimeError(
+            f"skewed workload triggered no live migrations: {rebal}")
+    if rebal["mean_spread"] >= control["mean_spread"]:
+        raise RuntimeError(
+            f"rebalancing did not bound the occupancy spread: "
+            f"rebalanced {rebal['mean_spread']:.2f} vs control "
+            f"{control['mean_spread']:.2f}")
+
+    total_errors = real_errors + rebal["errors"] + control["errors"]
+    total_lost = real_lost + rebal["lost"] + control["lost"]
+    total_dup = real_dup + rebal["dup"] + control["dup"]
+    n_failed = real_stats["migrate_failed"] + rebal["n_migrate_failed"]
+    return {"transport": {
+        "loopback_parity": float(loop_parity),
+        "migration_parity": float(mig_parity),
+        # binary indicators (the raw counts below are timing-shaped):
+        # at least one live migration on each leg...
+        "migrated": float(real_stats["migrated"] == 1
+                          and rebal["n_migrated"] >= 1),
+        # ...and a failed migration must never lose a stream (failure
+        # degrades to a load-balancing miss by design)
+        "migrate_failed": float(
+            n_failed > 0 and bool(total_errors or total_lost
+                                  or total_dup)),
+        "errors": total_errors,
+        "lost_tokens": total_lost,
+        "duplicate_tokens": total_dup,
+        "occupancy_spread": rebal["mean_spread"],
+        "control_spread": control["mean_spread"],
+        "n_migrated": real_stats["migrated"] + rebal["n_migrated"],
+        "n_migrate_failed": n_failed,
+        "n_moves": rebal["n_moves"],
+        "migrate_bytes": real_stats["migrate_bytes"],
+        "sim_requests": rebal["n_requests"],
+    }}
+
+
 def _ensure_virtual_devices(n: int = 8) -> None:
     """The tp=2 case needs a multi-device mesh; on CPU that is the XLA
     host-platform device-count flag, which must be set before jax
@@ -837,6 +1199,10 @@ def main(argv=None):
                     default=True,
                     help="evaluate SLO burn rates across the crash case "
                          "(--no-slo skips the slo block)")
+    ap.add_argument("--transport", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run the cross-host transport + live-migration "
+                         "case (--no-transport skips it)")
     ap.add_argument("--trace-out", type=str, default=None,
                     help="write the merged fleet journey Perfetto trace "
                          "(validated either way)")
@@ -851,7 +1217,8 @@ def main(argv=None):
                        seed=args.seed,
                        sim_requests=args.sim_requests,
                        sim_chunk_time_s=args.sim_chunk_time_ms / 1e3,
-                       slo=args.slo, trace_out=args.trace_out)
+                       slo=args.slo, transport=args.transport,
+                       trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

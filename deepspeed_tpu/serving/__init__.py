@@ -14,6 +14,7 @@ from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
                         REJECT_PROMPT_TOO_LONG, REJECT_QUEUE_FULL)
 from .metrics import (Reservoir, ServingMetrics,  # noqa: F401
                       csv_monitor_master)
-from .engine import ServingEngine  # noqa: F401
+from .engine import MigrationError, ServingEngine  # noqa: F401
 from .fleet import (ElasticConfig, ElasticController,  # noqa: F401
-                    FleetReplica, FleetRouter)
+                    FleetReplica, FleetRouter, RemoteReplica,
+                    ReplicaServer)

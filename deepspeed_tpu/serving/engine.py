@@ -116,6 +116,17 @@ def default_prefill_buckets(max_prompt_len: int) -> List[int]:
     return out
 
 
+MIGRATE_SCHEMA = "dstpu-migrate-v1"
+
+
+class MigrationError(RuntimeError):
+    """A live KV-block migration could not run — the request is NOT
+    movable right now (mid-prefill, unsupported layout) or the target
+    cannot host it (block-pool OOM, shape mismatch). The request keeps
+    running wherever it already lives; migration failure is a
+    load-balancing miss, never a lost stream."""
+
+
 @dataclasses.dataclass
 class _InflightChunk:
     """One enqueued decode chunk: device handles (nothing synced yet) plus
@@ -890,6 +901,162 @@ class ServingEngine:
         plan = self._pf_plans.pop(slot, None)
         if plan is not None:
             self.kv.abandon_plan(plan)
+
+    # ------------------------------------------------- live migration
+    def can_migrate(self, req: Request) -> bool:
+        """Is ``req`` movable right now? Paged KV only (blocks are the
+        portable unit), tp=1 (a sharded pool's leaves live on a mesh this
+        bundle format doesn't describe), running with at least one
+        emitted token, and fully prefilled — a mid-prompt fused lane's KV
+        is still being written by the scan."""
+        if not self.paged or self.tp > 1 or self.disaggregated:
+            return False
+        if req.status != "running" or not req.tokens:
+            return False
+        slot = req.slot
+        if slot is None or self.scheduler.running.get(slot) is not req:
+            return False
+        if self.fused_prefill and self._pf_consumed.get(
+                slot, req.prompt_len) < req.prompt_len:
+            return False
+        return True
+
+    def export_request(self, req: Request) -> Dict[str, Any]:
+        """Serialize a RUNNING request's full decode state: KV blocks
+        (in table order, written blocks only), the decode cursor, and
+        the request identity — the bundle ``import_request`` re-homes on
+        another engine. Consistency argument: at a chunk boundary
+        ``fill == prompt_len + len(tokens) - 1`` and the last token's KV
+        row is NOT yet written (it is written when the token is fed), so
+        rows ``[0, fill)`` are final even with the next chunk in flight —
+        that chunk only writes at/above ``fill``, and gathering the
+        post-chunk pool syncs after those writes land harmlessly in rows
+        the importer masks (its write cursor starts at ``fill``). Does
+        NOT cancel ``req`` — the caller re-homes first, then cancels."""
+        if not self.can_migrate(req):
+            raise MigrationError(
+                f"request uid={req.uid} is not migratable "
+                f"(status={req.status!r}, paged={self.paged}, "
+                f"tp={self.tp})")
+        slot = req.slot
+        fill = req.prompt_len + len(req.tokens) - 1
+        have = int(self.kv.fill[slot])
+        if have != fill:
+            raise MigrationError(
+                f"slot {slot} fill {have} != expected {fill} "
+                f"(chunk boundary invariant violated)")
+        bs = self.kv.allocator.block_size
+        n_blocks = max(1, -(-fill // bs))
+        leaves = self.kv.export_blocks(slot, n_blocks)
+        kv_bytes = sum(int(a.nbytes) for a in leaves.values())
+        telemetry.instant("serve/migrate_export", uid=req.uid,
+                          slot=slot, n_blocks=n_blocks, bytes=kv_bytes)
+        if self.flight is not None:
+            self.flight.record("migrate_export", uid=req.uid, slot=slot,
+                               n_blocks=n_blocks, bytes=kv_bytes)
+        return {
+            "schema": MIGRATE_SCHEMA,
+            "prompt": [int(t) for t in np.asarray(req.prompt)],
+            "tokens": [int(t) for t in req.tokens],
+            "max_new_tokens": int(req.max_new_tokens),
+            "eos_token_id": (None if req.eos_token_id is None
+                             else int(req.eos_token_id)),
+            "deadline_s": (None if req.deadline_s is None
+                           else float(req.deadline_s)),
+            "tenant": req.tenant,
+            "trace_id": req.trace_id,
+            "fill": int(fill),
+            "block_size": int(bs),
+            "n_blocks": int(n_blocks),
+            "kv_bytes": int(kv_bytes),
+            "kv": leaves,
+        }
+
+    def import_request(self, bundle: Dict[str, Any]) -> Request:
+        """Re-home an exported request: lease a slot + its full block
+        reservation (``alloc_span``), scatter the shipped blocks, and
+        join the running set mid-decode — the next chunk feeds the
+        carried last token at position ``fill``, exactly as the source
+        engine would have. Raises :class:`MigrationError` when this
+        engine cannot host it (layout mismatch, pool OOM); the caller
+        re-imports at the source or fails the stream structurally."""
+        if not self.paged or self.tp > 1 or self.disaggregated:
+            raise MigrationError(
+                "import_request needs a paged, unsharded engine")
+        if bundle.get("schema") != MIGRATE_SCHEMA:
+            raise MigrationError(
+                f"unknown migration schema {bundle.get('schema')!r}")
+        bs = self.kv.allocator.block_size
+        if int(bundle["block_size"]) != bs:
+            raise MigrationError(
+                f"block_size mismatch: bundle {bundle['block_size']} "
+                f"vs engine {bs}")
+        prompt = np.asarray(bundle["prompt"], np.int32)
+        tokens = [int(t) for t in bundle["tokens"]]
+        fill = int(bundle["fill"])
+        max_new = int(bundle["max_new_tokens"])
+        if fill != prompt.shape[0] + len(tokens) - 1:
+            raise MigrationError(
+                f"bundle cursor fill={fill} inconsistent with "
+                f"prompt_len={prompt.shape[0]} + {len(tokens)} tokens")
+        if fill + 1 > self.max_seq_len:
+            raise MigrationError(
+                f"sequence length {fill + 1} exceeds this engine's "
+                f"max_seq_len {self.max_seq_len}")
+        n_lease = min(-(-(prompt.shape[0] + max_new) // bs),
+                      self.kv.allocator.blocks_per_seq)
+        if n_lease < int(bundle["n_blocks"]):
+            raise MigrationError(
+                f"lease of {n_lease} blocks cannot hold the bundle's "
+                f"{bundle['n_blocks']} written blocks")
+        slot = self.kv.allocator.alloc_span(fill, n_lease)
+        if slot is None:
+            raise MigrationError(
+                "kv_blocks_exhausted: no slot/blocks for the incoming "
+                "request")
+        try:
+            self.kv.import_blocks(slot, bundle["kv"])
+        except Exception:
+            self.kv.allocator.free(slot)
+            raise
+        req = Request(
+            prompt=prompt, max_new_tokens=max_new,
+            eos_token_id=bundle.get("eos_token_id"),
+            deadline_s=bundle.get("deadline_s"),
+            trace_id=bundle.get("trace_id"),
+            tenant=bundle.get("tenant") or "default")
+        now = self.scheduler.clock()
+        req.submit_t = now
+        req.first_token_t = now
+        req.status = "running"
+        req.slot = slot
+        req.tokens = tokens
+        self.scheduler.running[slot] = req
+        self._last_token[slot] = tokens[-1]
+        if self._chunked:
+            if self.fused_prefill:
+                self._clear_pf_slot(slot)
+            rem = min(max_new - len(tokens),
+                      self.kv.allocator.remaining(slot))
+            eos = -1 if req.eos_token_id is None else int(req.eos_token_id)
+            # admit-style patch, but the lane resumes at the migrated
+            # cursor (pos = fill, not prompt_len): the carried last
+            # token's KV row is written by the lane's first step here
+            patch = (tokens[-1], fill, rem, eos)
+            if self.fused_prefill:
+                patch = patch + (0,)        # pf_rem: fully prefilled
+            if self.speculative:
+                patch = patch + (self._history_row(req),)
+            self._admit_patches[slot] = patch
+            self._deact_slots.discard(slot)
+        telemetry.instant("serve/migrate_import", uid=req.uid,
+                          slot=slot, fill=fill,
+                          n_blocks=int(bundle["n_blocks"]))
+        if self.flight is not None:
+            self.flight.record("migrate_import", uid=req.uid, slot=slot,
+                               fill=fill, tenant=req.tenant)
+        self._gauge_block_pool()
+        return req
 
     def pump(self) -> List[Request]:
         """One iteration of the double-buffered serve loop for EXTERNAL

@@ -5,11 +5,22 @@ One ``ServingEngine`` is one replica; a deployment runs N of them
 prefill/decode-disaggregated via ``disaggregate_prefill=True``) behind
 one :class:`FleetRouter` — least-loaded placement, prefix-affinity
 routing, dead-replica drain with in-flight replay, and SLO-driven
-elastic sizing via :class:`ElasticController`. See docs/serving.md.
+elastic sizing via :class:`ElasticController`. Replicas need not share
+the process: :class:`ReplicaServer` exposes one frontend over the
+``dstpu-fleet-v1`` streaming HTTP transport and :class:`RemoteReplica`
+drives it from the router's side (``FleetRouter.add_remote``), with
+live KV-block migration (``FleetRouter.migrate`` / ``rebalance``)
+re-homing running requests across the wire mid-decode. See
+docs/serving.md.
 """
 
 from .elastic import ElasticConfig, ElasticController  # noqa: F401
 from .router import FleetReplica, FleetRouter  # noqa: F401
+from .transport import (FLEET_SCHEMA, ReplicaServer,  # noqa: F401
+                        decode_bundle, encode_bundle)
+from .remote import RemoteReplica  # noqa: F401
 
 __all__ = ["FleetRouter", "FleetReplica",
-           "ElasticController", "ElasticConfig"]
+           "ElasticController", "ElasticConfig",
+           "ReplicaServer", "RemoteReplica", "FLEET_SCHEMA",
+           "encode_bundle", "decode_bundle"]

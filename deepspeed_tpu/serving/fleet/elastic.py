@@ -68,6 +68,14 @@ class ElasticConfig:
     scale_up_drain_s: Optional[float] = None
     cooldown_s: float = 5.0
     poll_every_s: float = 0.25
+    # live KV-block migration between ticks' scale actions: when on, a
+    # tick that takes no scale action instead asks the router to
+    # rebalance one running request from the busiest to the idlest
+    # replica once their running-count spread reaches
+    # ``rebalance_spread`` (off by default: migration moves device
+    # state — deployments opt in)
+    rebalance: bool = False
+    rebalance_spread: int = 2
 
 
 class ElasticController:
@@ -107,9 +115,14 @@ class ElasticController:
         next tick."""
         for rep in list(self.router.replicas):
             if rep.rid not in self._sensors:
+                tracing = rep.frontend.tracing
+                if not hasattr(tracing, "add_listener"):
+                    # remote replica: its TraceLog lives server-side —
+                    # its own controller senses it there
+                    continue
                 eng = SLOEngine(self._slos, windows_s=self._windows_s,
                                 clock=self._clock)
-                eng.attach(rep.frontend.tracing)
+                eng.attach(tracing)
                 self._sensors[rep.rid] = eng
 
     def burn_rates(self) -> Dict[int, float]:
@@ -168,6 +181,11 @@ class ElasticController:
                     min_routable=max(cfg.min_replicas, self.target))
                 if rep is not None:
                     action, reason = "scale_down", "above_target_calm"
+            if action == "none" and cfg.rebalance:
+                moves = self.router.rebalance(
+                    spread_threshold=cfg.rebalance_spread)
+                if moves:
+                    action, reason = "rebalance", "occupancy_spread"
             if action != "none":
                 self._last_action_t = now
             self.n_steps += 1

@@ -1,0 +1,593 @@
+"""RemoteReplica: a fleet replica on the far side of the wire.
+
+The client half of the ``dstpu-fleet-v1`` transport
+(:mod:`.transport`): one :class:`RemoteReplica` speaks to one
+:class:`~.transport.ReplicaServer` and satisfies the exact surface
+:class:`~.router.FleetRouter` drives on an in-process
+:class:`~..frontend.frontend.ServingFrontend` — ``submit`` returning a
+live :class:`~..frontend.frontend.StreamHandle`, ``cancel``, ``adopt``,
+``load_snapshot``, ``holds_prefix``, ``stats``, the tracing read
+surface, ``driver_alive``, and the migration verbs. Placement logic
+(health → prefix affinity → least-loaded) therefore does not know or
+care which replicas are loopback and which are remote.
+
+Each submit spawns one reader thread that pumps the server's NDJSON
+frames into the caller's handle. Dedup is positional: every ``tokens``
+frame carries the ABSOLUTE index of its first token, the reader skips
+whatever prefix the handle already holds, and a frame that would leave
+a gap resolves the handle to a structured ``error`` — duplicated or
+lost tokens cannot happen silently.
+
+Failure semantics mirror the in-process fleet: a single broken stream
+resolves just that handle (``error``) — unless the replica's
+``/healthz`` has also gone dark, in which case the replica is marked
+dead and EVERY live handle is salvaged through the same ``on_crash``
+hook a crashing in-process driver fires, so the router's existing
+re-home/replay path (``adopt`` + emitted-token dedup) covers dead
+remotes with zero duplicate tokens.
+
+Host-side only — never imports JAX.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ...utils.logging import logger
+from ..engine import MigrationError
+from ..frontend.admission import PRIORITY_NORMAL, REJECT_FRONTEND_CLOSED
+from ..frontend.frontend import (LOAD_SCHEMA, ServingFrontend,
+                                 StreamHandle)
+from ..scheduler import Request
+from .transport import decode_bundle, encode_bundle
+
+
+class _RemoteTracing:
+    """Read-only tracing shim: the router's journey/tenant exports pull
+    ``to_json()``/``tenants_report()`` from every replica; for a remote
+    one they are HTTP reads of the server's own TraceLog."""
+
+    def __init__(self, remote: "RemoteReplica"):
+        self._remote = remote
+
+    def to_json(self) -> Dict[str, Any]:
+        return self._remote._get_json(
+            "/v1/trace",
+            default={"histograms": {}, "counters": {},
+                     "requests": [], "live": []})
+
+    def tenants_report(self) -> Dict[str, Any]:
+        return self._remote._get_json(
+            "/v1/tenants",
+            default={"schema": "dstpu-tenants-v1", "n_tenants": 0,
+                     "tenants": {}})
+
+
+class RemoteReplica:
+    """Client handle for one remote serving replica.
+
+    Constructed from the server's address; plugs into
+    ``FleetRouter.add_remote()``, which installs the router's crash
+    hook on ``on_crash`` and wraps it in a ``FleetReplica`` with
+    ``engine=None`` (every engine-shaped probe goes over the wire
+    instead)."""
+
+    def __init__(self, host: str, port: int, *,
+                 label: Optional[str] = None,
+                 timeout_s: float = 30.0,
+                 health_ttl_s: float = 0.5,
+                 clock=time.monotonic):
+        self.host = host
+        self.port = int(port)
+        self.label = label or f"{host}:{port}"
+        self.timeout_s = float(timeout_s)
+        self.health_ttl_s = float(health_ttl_s)
+        self._clock = clock
+        # router-facing lifecycle attrs (FleetReplica/retire contract)
+        self.draining = False
+        self.postmortem_path: Optional[str] = None
+        self.on_crash = None
+        self.tracing = _RemoteTracing(self)
+        self.n_submitted = 0
+        self._lock = threading.Lock()
+        self._handles: Dict[int, StreamHandle] = {}  # remote uid -> handle
+        self._readers: List[threading.Thread] = []
+        self._closed = False
+        self._dead = False
+        # handles the crash hook took ownership of: their re-homed
+        # streams are still pending, so the reader threads that saw the
+        # disconnect must NOT error-resolve them (id(handle) members)
+        self._salvaged: set = set()
+        self._health_ok: Optional[bool] = None
+        self._health_t = 0.0
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------ HTTP plumbing
+    def _conn(self,
+              timeout: Optional[float] = None) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self.timeout_s if timeout is None else timeout)
+
+    _RAISE = object()
+
+    def _get_json(self, path: str, default: Any = _RAISE) -> Any:
+        try:
+            conn = self._conn()
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status != 200:
+                    raise ConnectionError(
+                        f"GET {path} -> {resp.status}")
+                return json.loads(data.decode("utf-8"))
+            finally:
+                conn.close()
+        except Exception as e:  # noqa: BLE001 — degrade per `default`
+            if default is self._RAISE:
+                raise
+            logger.debug(f"remote replica {self.label}: GET {path} "
+                         f"failed ({e}); using default")
+            return default
+
+    def _post_json(self, path: str, body: Dict[str, Any]) -> Any:
+        conn = self._conn()
+        try:
+            conn.request("POST", path, json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            payload = json.loads(data.decode("utf-8")) if data else {}
+            if resp.status != 200:
+                raise MigrationError(
+                    payload.get("error",
+                                f"POST {path} -> {resp.status}"))
+            return payload
+        finally:
+            conn.close()
+
+    # ---------------------------------------------------------- streaming
+    def _open_stream(self, path: str, body: Dict[str, Any]):
+        """POST and read frames until the first ``accepted``/``end``;
+        returns ``(conn, resp, first_frame)``. Caller owns the
+        connection."""
+        conn = self._conn()
+        try:
+            conn.request("POST", path, json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                data = resp.read()
+                try:
+                    err = json.loads(data.decode("utf-8"))
+                except Exception:  # noqa: BLE001
+                    err = {"error": f"POST {path} -> {resp.status}"}
+                conn.close()
+                return None, None, err
+            line = resp.readline()
+            if not line:
+                conn.close()
+                return None, None, {"error": "stream closed before "
+                                             "first frame"}
+            return conn, resp, json.loads(line.decode("utf-8"))
+        except Exception:
+            conn.close()
+            raise
+
+    def _attach(self, handle: StreamHandle, remote_uid: int) -> None:
+        with self._lock:
+            handle._remote_uid = remote_uid
+            self._handles[remote_uid] = handle
+
+    def _spawn_reader(self, conn, resp, handle: StreamHandle) -> None:
+        t = threading.Thread(
+            target=self._read_stream, args=(conn, resp, handle),
+            name=f"dstpu-remote-{self.label}", daemon=True)
+        with self._lock:
+            self._readers = [r for r in self._readers if r.is_alive()]
+            self._readers.append(t)
+        t.start()
+
+    def _read_stream(self, conn, resp, handle: StreamHandle) -> None:
+        try:
+            ended = self._pump_frames(resp, handle)
+            if not ended and not handle.done:
+                # close-delimited protocol: EOF without an `end` frame
+                # is a mid-stream disconnect, never a clean finish
+                raise ConnectionError("stream closed without end frame")
+        except Exception as e:  # noqa: BLE001 — resolve, never hang
+            self._stream_failed(handle, e)
+        finally:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            with self._lock:
+                uid = getattr(handle, "_remote_uid", None)
+                if uid is not None and handle.done:
+                    self._handles.pop(uid, None)
+
+    def _pump_frames(self, resp, handle: StreamHandle) -> bool:
+        """Apply frames to the handle; True once an ``end`` frame
+        terminates the stream (including the ``migrated`` pseudo-end,
+        which leaves the handle pending for the destination replica's
+        continuation)."""
+        for raw in iter(resp.readline, b""):
+            raw = raw.strip()
+            if not raw:
+                continue
+            frame = json.loads(raw.decode("utf-8"))
+            ev = frame.get("event")
+            if ev == "tokens":
+                start = int(frame["start"])
+                toks = [int(t) for t in frame["tokens"]]
+                have = len(handle.tokens)
+                skip = have - start
+                if skip < 0:
+                    handle._resolve(
+                        "error",
+                        error=f"transport token gap: frame starts at "
+                              f"{start}, handle holds {have}")
+                    return True
+                if skip < len(toks):
+                    handle._push(toks[skip:])
+            elif ev == "accepted":
+                self._attach(handle, int(frame["uid"]))
+                if getattr(handle, "_cancel_requested", False):
+                    self._post_cancel(int(frame["uid"]))
+            elif ev == "end":
+                status = frame.get("status")
+                if status == "migrated":
+                    # detached, not finished: the router re-homes this
+                    # handle via migrate_in on another replica
+                    return True
+                if status == "rejected":
+                    handle._resolve(
+                        "rejected",
+                        reject_reason=frame.get("reject_reason"))
+                elif status == "error":
+                    handle._resolve("error", error=frame.get("error"))
+                else:
+                    handle._resolve(status)
+                return True
+            # "hb" frames: liveness only, nothing to apply
+        return False
+
+    def _stream_failed(self, handle: StreamHandle, exc: Exception) -> None:
+        """One broken stream: structured error for that handle — unless
+        the whole replica is gone, in which case the crash-salvage path
+        re-homes every live handle instead."""
+        if self._probe_health(force=True):
+            if not handle.done:
+                handle._resolve(
+                    "error",
+                    error=f"transport stream failed: "
+                          f"{type(exc).__name__}: {exc}")
+            return
+        self._mark_dead(exc)
+        with self._lock:
+            salvaged = id(handle) in self._salvaged
+        if not salvaged and not handle.done:
+            # no hook took it (or the hook declined): never hang
+            handle._resolve(
+                "error",
+                error=f"remote replica {self.label} died: "
+                      f"{type(exc).__name__}: {exc}")
+
+    # ------------------------------------------------------- health/crash
+    def _probe_health(self, force: bool = False) -> bool:
+        now = self._clock()
+        with self._lock:
+            if self._closed or self._dead:
+                return False
+            if not force and self._health_ok is not None \
+                    and now - self._health_t < self.health_ttl_s:
+                return self._health_ok
+        ok = False
+        try:
+            payload = self._get_json("/healthz")
+            ok = bool(payload.get("driver_alive", False))
+        except Exception:  # noqa: BLE001 — unreachable == not alive
+            ok = False
+        with self._lock:
+            self._health_ok = ok
+            self._health_t = now
+        return ok
+
+    def _mark_dead(self, exc: Exception) -> None:
+        """Salvage every live handle through ``on_crash`` — the same
+        hook a crashing in-process driver fires, so the router's
+        re-home/replay path covers dead remotes unchanged."""
+        with self._lock:
+            if self._dead or self._closed:
+                return
+            self._dead = True
+            handles = [h for h in self._handles.values() if not h.done]
+            self._handles.clear()
+            if self.on_crash is not None:
+                # claimed for the hook atomically with _dead: any other
+                # reader thread that sees the replica dead also sees
+                # these handles as spoken for
+                self._salvaged.update(id(h) for h in handles)
+        logger.error(f"remote replica {self.label} is unreachable "
+                     f"({type(exc).__name__}: {exc}); salvaging "
+                     f"{len(handles)} live streams")
+        if self.on_crash is not None and handles:
+            try:
+                self.on_crash(self, handles, exc)
+                return
+            except Exception as hook_exc:  # noqa: BLE001 — fall through
+                logger.error(f"remote crash hook failed: {hook_exc}")
+                with self._lock:
+                    self._salvaged.difference_update(
+                        id(h) for h in handles)
+        msg = f"{type(exc).__name__}: {exc}"
+        for h in handles:
+            h._resolve("error",
+                       error=f"remote replica died ({msg}) and no "
+                             f"survivor adopted the request")
+
+    # ------------------------------------------------- frontend surface
+    @property
+    def driver_alive(self) -> bool:
+        """Cached ``/healthz`` probe — the same readiness signal the
+        router checks on in-process frontends, at wire latency."""
+        return self._probe_health()
+
+    @property
+    def crashed(self) -> bool:
+        with self._lock:
+            return self._dead
+
+    def submit(self, prompt: Union[Sequence[int], np.ndarray], *,
+               priority: int = PRIORITY_NORMAL,
+               tenant: str = "default",
+               slo_ttft_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               trace_id: Optional[str] = None) -> StreamHandle:
+        """Same contract as ``ServingFrontend.submit``: returns a live
+        StreamHandle immediately; rejections resolve it, never raise.
+        The handle's ``uid`` is local; the server-side uid rides on
+        ``_remote_uid`` once the ``accepted`` frame lands."""
+        prompt = np.asarray(prompt, np.int32)
+        req = Request(prompt=prompt, max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id, deadline_s=None,
+                      trace_id=trace_id, tenant=tenant)
+        handle = StreamHandle(req, self, tenant=tenant,
+                              priority=priority, slo_ttft_s=slo_ttft_s,
+                              submit_t=self._clock(), trace_id=trace_id)
+        handle._remote_uid = None
+        handle._cancel_requested = False
+        self.n_submitted += 1
+        with self._lock:
+            dead = self._closed or self._dead
+        if dead:
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_CLOSED)
+            return handle
+        body = {"prompt": [int(t) for t in prompt],
+                "priority": int(priority), "tenant": tenant,
+                "slo_ttft_s": slo_ttft_s, "deadline_s": deadline_s,
+                "max_new_tokens": int(max_new_tokens),
+                "eos_token_id": eos_token_id, "trace_id": trace_id}
+        t = threading.Thread(
+            target=self._submit_stream, args=(body, handle),
+            name=f"dstpu-remote-{self.label}", daemon=True)
+        with self._lock:
+            self._readers = [r for r in self._readers if r.is_alive()]
+            self._readers.append(t)
+        t.start()
+        return handle
+
+    def _submit_stream(self, body: Dict[str, Any],
+                       handle: StreamHandle) -> None:
+        try:
+            conn, resp, first = self._open_stream("/v1/submit", body)
+            if conn is None:
+                handle._resolve("error", error=first.get("error"))
+                return
+            ended = self._apply_first(first, handle)
+            if not ended:
+                self._read_stream(conn, resp, handle)
+            else:
+                conn.close()
+        except Exception as e:  # noqa: BLE001
+            self._stream_failed(handle, e)
+
+    def _apply_first(self, frame: Dict[str, Any],
+                     handle: StreamHandle) -> bool:
+        """First frame is ``accepted`` on the happy path; anything
+        terminal short-circuits. Returns True when the stream already
+        ended."""
+        if frame.get("event") == "accepted":
+            self._attach(handle, int(frame["uid"]))
+            if getattr(handle, "_cancel_requested", False):
+                self._post_cancel(int(frame["uid"]))
+            return False
+        if frame.get("event") == "end":
+            status = frame.get("status", "error")
+            if status == "rejected":
+                handle._resolve("rejected",
+                                reject_reason=frame.get("reject_reason"))
+            else:
+                handle._resolve(status if status != "migrated"
+                                else "error",
+                                error=frame.get("error"))
+            return True
+        return False
+
+    def cancel(self, handle: StreamHandle) -> None:
+        """StreamHandle.cancel() lands here (the handle's ``_frontend``
+        is this replica): forward to ``POST /v1/cancel`` once the
+        remote uid is known; the server frees the slot within one chunk
+        and the stream ends ``cancelled``."""
+        if handle.done:
+            return
+        handle._cancel_requested = True
+        uid = getattr(handle, "_remote_uid", None)
+        if uid is not None:
+            self._post_cancel(uid)
+
+    def _post_cancel(self, uid: int) -> None:
+        try:
+            self._post_json("/v1/cancel", {"uid": int(uid)})
+        except Exception as e:  # noqa: BLE001 — stream/health paths win
+            logger.debug(f"remote cancel uid={uid} failed: {e}")
+
+    def adopt(self, handle: StreamHandle,
+              rerouted_from: Optional[str] = None) -> bool:
+        """Re-home a (possibly mid-stream) handle from a dead or
+        draining peer onto the remote: ship the ``dstpu-snapshot-v1``,
+        let the server replay prompt + emitted prefix, and keep
+        streaming fresh tokens into the SAME handle. Positional dedup
+        guarantees zero duplicates. Returns False when the remote
+        declines (the router falls back)."""
+        if handle.done:
+            return False
+        with self._lock:
+            if self._closed or self._dead or self.draining:
+                return False
+        snap = ServingFrontend._handle_snapshot(handle)
+        body = {"snapshot": snap, "rerouted_from": rerouted_from}
+        try:
+            conn, resp, first = self._open_stream("/v1/adopt", body)
+        except Exception as e:  # noqa: BLE001 — decline, router falls back
+            logger.debug(f"remote adopt failed: {e}")
+            return False
+        if conn is None or first.get("event") != "accepted":
+            if conn is not None:
+                conn.close()
+            return False
+        handle._frontend = self
+        handle._cancel_requested = False
+        self._attach(handle, int(first["uid"]))
+        self.n_submitted += 1
+        self._spawn_reader(conn, resp, handle)
+        return True
+
+    # ------------------------------------------------------- migration
+    def migration_candidates(self) -> List[int]:
+        return [int(u) for u in
+                self._get_json("/v1/migratable",
+                               default={"uids": []}).get("uids", [])]
+
+    def migrate_out(self, uid: int,
+                    timeout: Optional[float] = None):
+        """Detach one running request from the remote: returns
+        ``(bundle, handle)`` where ``handle`` is the local caller
+        handle this client holds for the remote uid (its server stream
+        ends ``migrated`` and the reader leaves it pending for the
+        destination's continuation)."""
+        with self._lock:
+            handle = self._handles.get(int(uid))
+        if handle is None:
+            raise MigrationError(
+                f"uid {uid} is not streamed through this client")
+        payload = self._post_json("/v1/migrate_out", {"uid": int(uid)})
+        with self._lock:
+            self._handles.pop(int(uid), None)
+        return decode_bundle(payload), handle
+
+    def migrate_in(self, bundle: Dict[str, Any],
+                   handle: Optional[StreamHandle] = None, *,
+                   migrated_from: Optional[str] = None,
+                   timeout: Optional[float] = None) -> StreamHandle:
+        """Re-home an exported request onto the remote and resume
+        streaming into ``handle`` (minted locally when None). The
+        server's continuation frames start at the resumed cursor;
+        positional dedup keeps the caller's stream gapless."""
+        body = {"bundle": encode_bundle(bundle),
+                "migrated_from": migrated_from}
+        conn, resp, first = self._open_stream("/v1/migrate_in", body)
+        if conn is None:
+            raise MigrationError(first.get("error", "migrate_in failed"))
+        if first.get("event") != "accepted":
+            conn.close()
+            raise MigrationError(
+                f"unexpected first frame: {first!r}")
+        if handle is None:
+            req = Request(
+                prompt=np.asarray(bundle["prompt"], np.int32),
+                max_new_tokens=int(bundle["max_new_tokens"]),
+                eos_token_id=bundle.get("eos_token_id"),
+                deadline_s=bundle.get("deadline_s"),
+                trace_id=bundle.get("trace_id"),
+                tenant=str(bundle.get("tenant", "default")))
+            handle = StreamHandle(
+                req, self, tenant=req.tenant, priority=PRIORITY_NORMAL,
+                slo_ttft_s=None, submit_t=self._clock(),
+                trace_id=req.trace_id)
+            with handle._cond:
+                # resumed prefix was delivered at the source; keep the
+                # buffer's absolute indexing continuous, park the
+                # read cursor past it
+                handle._tokens = [int(t) for t in bundle["tokens"]]
+                handle._cursor = len(handle._tokens)
+        handle._frontend = self
+        handle._cancel_requested = False
+        self._attach(handle, int(first["uid"]))
+        self.n_submitted += 1
+        self._spawn_reader(conn, resp, handle)
+        return handle
+
+    # --------------------------------------------------------- queries
+    def holds_prefix(self, key: bytes) -> bool:
+        return bool(self._get_json(
+            f"/v1/prefix?key={key.hex()}",
+            default={"holds": False}).get("holds", False))
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        """``GET /v1/load`` — the same ``dstpu-load-v1`` dict the
+        in-process frontend returns. Unreachable remotes degrade to an
+        idle-shaped stub (placement already excludes them via
+        ``driver_alive``; the stub only keeps racing readers safe)."""
+        return self._get_json("/v1/load", default={
+            "schema": LOAD_SCHEMA,
+            "admission": {"pending": 0},
+            "throughput": {"tokens_per_s": None},
+            "engine_backlog_tokens": 0,
+            "engine_queue_depth": 0,
+            "engine_running": 0,
+        })
+
+    def stats(self) -> Dict[str, Any]:
+        return self._get_json("/v1/stats", default={
+            "submitted": self.n_submitted, "unreachable": True})
+
+    def drain_pending(self) -> List[StreamHandle]:
+        """Remote admission queues drain server-side (the server's own
+        driver keeps running); nothing to re-home from here."""
+        return []
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop using the remote. Does NOT close the remote server —
+        it has its own owner; in-flight streams are given ``timeout``
+        to finish naturally."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            readers = list(self._readers)
+        deadline = None if timeout is None else self._clock() + timeout
+        for t in readers:
+            left = None if deadline is None \
+                else max(0.0, deadline - self._clock())
+            t.join(left if left is not None else 5.0)
+
+    def __enter__(self) -> "RemoteReplica":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -56,8 +56,18 @@ distinguishable in one process-wide runtime; the router's own counters
 (``fleet/routed``, ``fleet/affinity_hits``, ``fleet/rerouted``,
 ``fleet/replayed``, ``fleet/reroute_failed``,
 ``fleet/replica_crashes``, ``fleet/scale_up``, ``fleet/scale_down``,
-``fleet/drained``) are recorded unlabeled — they are fleet-level, not
-per-replica.
+``fleet/drained``, ``fleet/migrated``, ``fleet/migrate_bytes``,
+``fleet/migrate_failed``) are recorded unlabeled — they are
+fleet-level, not per-replica.
+
+**Cross-host fleet**: ``add_remote()`` joins a replica that lives on
+the far side of the ``dstpu-fleet-v1`` wire (:mod:`.transport` /
+:mod:`.remote`) — the in-process frontend is just the loopback case of
+the same surface. ``migrate()`` is the live KV-block migration verb:
+a running request's blocks + cursor move to a less-loaded replica
+mid-decode (``rebalance()`` turns this crank under skew), with the
+caller's handle streaming across the hop with zero lost or duplicated
+tokens.
 
 Host-side only — this module never imports JAX.
 """
@@ -75,6 +85,7 @@ import numpy as np
 from ...telemetry import core as telemetry
 from ...telemetry.journey import journey_trace_events, new_trace_id
 from ...utils.logging import logger
+from ..engine import MigrationError
 from ..frontend.admission import AdmissionConfig, PRIORITY_NORMAL
 from ..frontend.frontend import ServingFrontend, StreamHandle
 from ..paged_kv import PrefixCache
@@ -116,18 +127,22 @@ class FleetRouter:
     ``ServingFrontend`` with its own driver thread; the router owns
     those frontends and ``close()`` drains all of them. ``admission``
     is copied per replica (the frontend mutates its config in place to
-    size memory-aware shedding from the engine arena).
+    size memory-aware shedding from the engine arena). ``remotes`` are
+    :class:`~.remote.RemoteReplica` clients joining at construction —
+    a fleet may be entirely remote (``engines=[]``).
     """
 
     def __init__(self, engines: Sequence[Any], *,
+                 remotes: Optional[Sequence[Any]] = None,
                  admission: Optional[AdmissionConfig] = None,
                  affinity: bool = True,
                  feed_depth: Optional[int] = None,
                  idle_wait_s: float = 0.005,
                  replica_factory=None,
                  clock=time.monotonic):
-        if not engines:
-            raise ValueError("FleetRouter needs at least one engine")
+        if not engines and not remotes:
+            raise ValueError("FleetRouter needs at least one engine "
+                             "or remote replica")
         self._clock = clock
         self.affinity = bool(affinity)
         self._lock = threading.Lock()
@@ -149,18 +164,27 @@ class FleetRouter:
         self.n_scale_up = 0
         self.n_scale_down = 0
         self.n_drained = 0
-        # journey journal: placement / reroute / crash records under one
-        # trace id per request — the input to ``export_chrome``'s
-        # journey lanes and the in-flight replay loop (bounded:
-        # a long-running router never grows without bound)
+        self.n_migrated = 0
+        self.n_migrate_failed = 0
+        self.migrate_bytes = 0
+        # journey journal: placement / reroute / crash / migration
+        # records under one trace id per request — the input to
+        # ``export_chrome``'s journey lanes and the in-flight replay
+        # loop (bounded: a long-running router never grows without
+        # bound)
         self._placements: deque = deque(maxlen=4096)
         self._reroutes: deque = deque(maxlen=1024)
         self._crashes: deque = deque(maxlen=256)
+        self._migrations: deque = deque(maxlen=1024)
         self.replicas: List[FleetReplica] = []
         self._by_frontend: Dict[int, FleetReplica] = {}
         self._next_rid = 0
         for eng in engines:
             self._spawn_replica(eng)
+        # construction-time remote replicas join without the scale-up
+        # counters — they are the fleet's initial size, not growth
+        for rem in (remotes or ()):
+            self._join_remote(rem)
 
     def _spawn_replica(self, engine: Any) -> FleetReplica:
         """Wrap one engine in a frontend + FleetReplica and register it
@@ -269,6 +293,15 @@ class FleetRouter:
 
     @staticmethod
     def _holds_prefix(replica: FleetReplica, key: bytes) -> bool:
+        # prefer the frontend's probe (in-process: a pure engine peek;
+        # remote: ``GET /v1/prefix`` — the transport made affinity a
+        # frontend surface, so the router stops reaching into engines)
+        probe = getattr(replica.frontend, "holds_prefix", None)
+        if probe is not None:
+            try:
+                return bool(probe(key))
+            except Exception:  # noqa: BLE001 — affinity is best-effort
+                return False
         kv = getattr(replica.engine, "kv", None)
         if kv is None or not getattr(kv, "prefix_enabled", False):
             return False
@@ -321,6 +354,149 @@ class FleetRouter:
         logger.info(f"fleet scale-up: replica {rep.rid} joined "
                     f"(ewma seed={donor_rate})")
         return rep
+
+    def _join_remote(self, remote: Any) -> FleetReplica:
+        """Register one remote replica (ctor path and ``add_remote``
+        share it): install the router's crash hook and wrap it in a
+        ``FleetReplica`` with ``engine=None`` — every engine-shaped
+        probe goes over the wire instead."""
+        rid = self._next_rid
+        self._next_rid += 1
+        remote.on_crash = self._on_replica_crash
+        rep = FleetReplica(rid=rid, engine=None, frontend=remote)
+        self.replicas.append(rep)
+        self._by_frontend[id(remote)] = rep
+        return rep
+
+    def add_remote(self, remote: Any) -> FleetReplica:
+        """Join a replica that lives on the far side of the fleet wire:
+        ``remote`` is a :class:`~.remote.RemoteReplica` (or anything
+        satisfying the frontend surface). It takes the same
+        ``FleetReplica`` slot an in-process frontend would — placement
+        (health → prefix affinity → least-loaded), crash salvage, and
+        migration all work unchanged. No EWMA warm-start: the remote's
+        own frontend measures its own throughput."""
+        rep = self._join_remote(remote)
+        with self._lock:
+            self.n_scale_up += 1
+        telemetry.count("fleet/scale_up")
+        telemetry.gauge("fleet/size", float(self.n_routable))
+        logger.info(f"fleet scale-up: remote replica {rep.rid} "
+                    f"({getattr(remote, 'label', '?')}) joined")
+        return rep
+
+    # --------------------------------------------------------- migration
+    def _resolve_replica(self,
+                         rep: Union[int, FleetReplica]) -> FleetReplica:
+        if isinstance(rep, FleetReplica):
+            return rep
+        found = next((r for r in self.replicas if r.rid == rep), None)
+        if found is None:
+            raise MigrationError(f"unknown replica {rep!r}")
+        return found
+
+    def migrate(self, uid: int, src: Union[int, FleetReplica],
+                dst: Union[int, FleetReplica]) -> bool:
+        """Live KV-block migration: detach a RUNNING request from
+        ``src`` (KV blocks + block table + decode cursor serialize into
+        a bundle), re-home it mid-decode onto ``dst``, and keep the
+        caller's SAME StreamHandle streaming — greedy bit-identical to
+        never having moved, zero lost or duplicated tokens. This is the
+        rebalancing verb: unlike crash replay nothing recomputes — the
+        device state itself moves.
+
+        On a destination failure the request is re-imported at the
+        source (the export does not destroy state until the import
+        lands... strictly: export+cancel, then best-effort restore), so
+        a failed migration degrades to a load-balancing miss, never a
+        lost stream. Returns True on success; failures count
+        ``fleet/migrate_failed``."""
+        src = self._resolve_replica(src)
+        dst = self._resolve_replica(dst)
+        t0 = self._clock()
+        try:
+            bundle, handle = src.frontend.migrate_out(uid)
+        except MigrationError as e:
+            self._record_migrate_failure(uid, src, dst, f"export: {e}")
+            return False
+        resumed = len(bundle["tokens"])
+        try:
+            dst.frontend.migrate_in(bundle, handle,
+                                    migrated_from=str(src.rid))
+        except MigrationError as e:
+            # destination refused: put the request back where it was
+            try:
+                src.frontend.migrate_in(bundle, handle,
+                                        migrated_from=None)
+            except MigrationError as e2:
+                handle._resolve(
+                    "error",
+                    error=f"migration failed both ways (dst: {e}; "
+                          f"src restore: {e2})")
+            self._record_migrate_failure(uid, src, dst, f"import: {e}")
+            return False
+        kv_bytes = int(bundle.get("kv_bytes", 0))
+        telemetry.count("fleet/migrated")
+        telemetry.count("fleet/migrate_bytes", float(kv_bytes))
+        telemetry.instant("fleet/migration", trace_id=handle.trace_id,
+                          from_replica=src.rid, to_replica=dst.rid,
+                          resumed_tokens=resumed, kv_bytes=kv_bytes)
+        with self._lock:
+            self.n_migrated += 1
+            self.migrate_bytes += kv_bytes
+            self._migrations.append({
+                "trace_id": handle.trace_id, "uid": int(uid),
+                "t": t0, "dur_s": self._clock() - t0,
+                "from_replica": src.rid, "to_replica": dst.rid,
+                "resumed_tokens": resumed, "kv_bytes": kv_bytes})
+        logger.info(f"fleet migration: uid={uid} replica {src.rid} -> "
+                    f"{dst.rid} ({resumed} tokens resumed, "
+                    f"{kv_bytes} KV bytes)")
+        return True
+
+    def _record_migrate_failure(self, uid: int, src: FleetReplica,
+                                dst: FleetReplica, why: str) -> None:
+        telemetry.count("fleet/migrate_failed")
+        with self._lock:
+            self.n_migrate_failed += 1
+            self._migrations.append({
+                "trace_id": None, "uid": int(uid), "t": self._clock(),
+                "from_replica": src.rid, "to_replica": dst.rid,
+                "failed": why})
+        logger.warning(f"fleet migration uid={uid} "
+                       f"{src.rid}->{dst.rid} failed: {why}")
+
+    def rebalance(self, *, spread_threshold: int = 2,
+                  max_moves: int = 1) -> List[Dict[str, Any]]:
+        """One load-balancing pass: while the spread between the
+        busiest and idlest routable replica's running count is at least
+        ``spread_threshold``, migrate one movable request hot -> cold
+        (up to ``max_moves``). Called periodically (benches, the
+        elastic controller's optional hook) to keep per-replica
+        occupancy spread bounded under skew — hot replicas rebalance
+        instead of only shedding. Returns the move records."""
+        moves: List[Dict[str, Any]] = []
+        for _ in range(max(0, int(max_moves))):
+            cands = [r for r in self.replicas if r.routable]
+            if len(cands) < 2:
+                break
+            occ = {r.rid: int(r.frontend.load_snapshot()
+                              .get("engine_running", 0)) for r in cands}
+            hot = max(cands, key=lambda r: occ[r.rid])
+            cold = min(cands, key=lambda r: occ[r.rid])
+            if occ[hot.rid] - occ[cold.rid] < spread_threshold:
+                break
+            movable = hot.frontend.migration_candidates()
+            if not movable:
+                break
+            uid = movable[0]
+            ok = self.migrate(uid, hot, cold)
+            moves.append({"uid": int(uid), "from_replica": hot.rid,
+                          "to_replica": cold.rid, "ok": ok,
+                          "spread": occ[hot.rid] - occ[cold.rid]})
+            if not ok:
+                break
+        return moves
 
     def retire_replica(self, rid: Optional[int] = None, *,
                        min_routable: int = 1) -> Optional[FleetReplica]:
@@ -486,6 +662,9 @@ class FleetRouter:
                 "scale_up": self.n_scale_up,
                 "scale_down": self.n_scale_down,
                 "drained": self.n_drained,
+                "migrated": self.n_migrated,
+                "migrate_failed": self.n_migrate_failed,
+                "migrate_bytes": self.migrate_bytes,
                 "crashes": [dict(c) for c in self._crashes],
             }
         out["per_replica"] = {
@@ -529,6 +708,7 @@ class FleetRouter:
                 "placements": [dict(p) for p in self._placements],
                 "reroutes": [dict(r) for r in self._reroutes],
                 "crashes": [dict(c) for c in self._crashes],
+                "migrations": [dict(m) for m in self._migrations],
             }
         journal["replicas"] = {r.rid: r.frontend.tracing.to_json()
                                for r in self.replicas}

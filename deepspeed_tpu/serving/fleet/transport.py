@@ -1,0 +1,451 @@
+"""ReplicaServer: the fleet's wire — one serving replica behind a
+stdlib streaming HTTP endpoint.
+
+The fleet so far is N driver threads in one process; this module is the
+network boundary that makes it a distributed system. It generalizes the
+PR-6 exposition-server pattern (stdlib ``ThreadingHTTPServer``, no new
+dependency) from scrape-sized responses to **incremental token
+streams**: one :class:`~..frontend.frontend.ServingFrontend` is exposed
+over HTTP, and every placement-relevant surface the
+:class:`~.router.FleetRouter` drives in process — submit / stream /
+cancel / adopt, ``load_snapshot``, prefix-cache peeks, migration — has
+a URL. The client half lives in :mod:`.remote`
+(:class:`~.remote.RemoteReplica`); together they make the in-process
+frontend the loopback case of the same protocol.
+
+Protocol ``dstpu-fleet-v1`` — NDJSON frames over close-delimited
+HTTP/1.0 streaming (no Content-Length on streams; one JSON object per
+line, flushed per frame, the connection close IS the end-of-stream):
+
+* ``POST /v1/submit``       body = submit kwargs -> token stream
+* ``POST /v1/adopt``        body = ``dstpu-snapshot-v1`` + rerouted_from
+                            -> replayed token stream (crash/drain
+                            re-home across the wire)
+* ``POST /v1/migrate_in``   body = encoded KV bundle -> continuation
+                            stream from the migrated cursor
+* ``POST /v1/cancel``       body = {uid} -> {ok} (the stream then ends
+                            ``cancelled`` within one decode chunk)
+* ``POST /v1/migrate_out``  body = {uid} -> the encoded KV bundle; the
+                            original stream ends ``migrated``
+* ``GET  /v1/load``         ``load_snapshot()`` (``dstpu-load-v1``)
+* ``GET  /v1/prefix?key=<hex>``  prefix-cache membership peek
+* ``GET  /v1/migratable``   movable uids (rebalancer input)
+* ``GET  /v1/stats`` · ``/v1/trace`` · ``/v1/tenants`` · ``/healthz``
+
+Stream frames (each a JSON line):
+
+* ``{"event": "accepted", "uid", "trace_id", "start"}``
+* ``{"event": "tokens", "start": N, "tokens": [...]}`` — ``start`` is
+  the ABSOLUTE index of the first token in the frame, so a client that
+  already holds a prefix (adopt replay, migration resume) dedups by
+  position, never by guessing: zero duplicate tokens by construction.
+* ``{"event": "hb"}`` — idle heartbeat; its real job is detecting a
+  silently departed client (the write raises, the server cancels).
+* ``{"event": "end", "status", "n_tokens", "reject_reason", "error"}``
+
+KV bundles cross the wire as ``encode_bundle`` output: every cache
+leaf base64-encoded with dtype+shape (``bfloat16`` round-trips via
+``ml_dtypes``), every cursor field plain JSON. In-process migrations
+skip the codec entirely — the bundle's ndarrays pass by reference.
+
+This module never imports JAX: it must serve ``/healthz`` and
+``/v1/load`` even while the device backend is wedged.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from ...telemetry.exposition import ReusableThreadingHTTPServer
+from ...utils.logging import logger
+from ..engine import MigrationError
+from ..frontend.admission import PRIORITY_NORMAL
+from ..frontend.frontend import ServingFrontend, StreamHandle
+from ..scheduler import Request
+
+#: wire protocol version — frames and endpoint shapes above
+FLEET_SCHEMA = "dstpu-fleet-v1"
+
+NDJSON_TYPE = "application/x-ndjson"
+
+
+# ----------------------------------------------------------- KV codec
+def encode_bundle(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-encode a migration bundle: every ``kv`` leaf becomes
+    ``{"b64", "dtype", "shape"}``; cursor fields are already plain.
+    The inverse of :func:`decode_bundle`."""
+    out = {k: v for k, v in bundle.items() if k != "kv"}
+    kv: Dict[str, Any] = {}
+    for name, arr in bundle.get("kv", {}).items():
+        a = np.ascontiguousarray(arr)
+        kv[name] = {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+                    "dtype": str(a.dtype), "shape": list(a.shape)}
+    out["kv"] = kv
+    out["kv_encoding"] = "b64-v1"
+    return out
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 and friends: numpy only knows them through the
+        # ml_dtypes registrations JAX ships with
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def decode_bundle(obj: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`encode_bundle`. Leaves that are already
+    ndarrays (the in-process no-codec path) pass through untouched."""
+    out = {k: v for k, v in obj.items() if k not in ("kv", "kv_encoding")}
+    kv: Dict[str, Any] = {}
+    for name, spec in obj.get("kv", {}).items():
+        if isinstance(spec, dict) and "b64" in spec:
+            kv[name] = np.frombuffer(
+                base64.b64decode(spec["b64"]),
+                dtype=_wire_dtype(spec["dtype"])).reshape(spec["shape"])
+        else:
+            kv[name] = spec
+    out["kv"] = kv
+    return out
+
+
+# ------------------------------------------------------------ handler
+class _FleetHandler(BaseHTTPRequestHandler):
+    server_version = "dstpu-fleet/1"
+    # HTTP/1.0 on purpose: close-delimited bodies make the token stream
+    # framing trivial (no chunked-transfer encoder on either side)
+    protocol_version = "HTTP/1.0"
+
+    def log_message(self, *args):        # silence per-request stderr spam
+        pass
+
+    # ------------------------------------------------------- plumbing
+    @property
+    def rs(self) -> "ReplicaServer":
+        return self.server.replica_server  # type: ignore[attr-defined]
+
+    def _body(self) -> Dict[str, Any]:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        return json.loads(raw.decode("utf-8")) if raw else {}
+
+    def _send_json(self, code: int, obj: Any) -> None:
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _open_stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", NDJSON_TYPE)
+        self.end_headers()               # no Content-Length: streaming
+
+    def _frame(self, obj: Dict[str, Any]) -> None:
+        self.wfile.write(json.dumps(obj).encode("utf-8") + b"\n")
+        self.wfile.flush()
+
+    # -------------------------------------------------------- streams
+    def _stream_handle(self, handle: StreamHandle, cursor: int) -> None:
+        """Pump one handle's tokens to the socket as NDJSON frames until
+        terminal. ``cursor`` is the absolute index streaming starts at
+        (0 for submit; the already-delivered prefix for adopt/migrate —
+        the client holds those tokens, resending them would be the
+        duplicate-token bug the ``start`` field exists to prevent).
+
+        A client that disappears mid-stream surfaces as a send error;
+        the server-side request is then cancelled so its slot frees
+        within one decode chunk instead of decoding to a dead socket."""
+        rs = self.rs
+        rs._register(handle, self.connection)
+        try:
+            self._frame({"event": "accepted", "uid": int(handle.uid),
+                         "trace_id": handle.trace_id,
+                         "start": int(cursor)})
+            last_write = time.monotonic()
+            while True:
+                # server-local handle: this thread is its only stream
+                # consumer, so reading the internals under its own
+                # condition is the blocking-iterator pattern inlined
+                with handle._cond:
+                    handle._cond.wait_for(
+                        lambda: len(handle._tokens) > cursor
+                        or handle._status is not None,
+                        timeout=rs.heartbeat_s)
+                    toks = [int(t) for t in handle._tokens[cursor:]]
+                    status = handle._status
+                if toks:
+                    self._frame({"event": "tokens", "start": int(cursor),
+                                 "tokens": toks})
+                    cursor += len(toks)
+                    last_write = time.monotonic()
+                if status is not None:
+                    self._frame({
+                        "event": "end", "status": status,
+                        "n_tokens": int(cursor),
+                        "reject_reason": handle.reject_reason,
+                        "error": handle.error})
+                    return
+                if time.monotonic() - last_write >= rs.heartbeat_s:
+                    self._frame({"event": "hb"})
+                    last_write = time.monotonic()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream: stop decoding for it
+            if not handle.done:
+                try:
+                    handle.cancel()
+                except Exception:  # noqa: BLE001 — already disconnected
+                    pass
+        finally:
+            rs._unregister(handle, self.connection)
+
+    # ------------------------------------------------------ endpoints
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        fe = self.rs.frontend
+        url = urlparse(self.path)
+        try:
+            if url.path == "/healthz":
+                self._send_json(200, {
+                    "status": "alive", "schema": FLEET_SCHEMA,
+                    "driver_alive": bool(fe.driver_alive),
+                    "draining": bool(getattr(fe, "draining", False))})
+            elif url.path == "/v1/load":
+                self._send_json(200, fe.load_snapshot())
+            elif url.path == "/v1/prefix":
+                key = parse_qs(url.query).get("key", [""])[0]
+                holds = bool(key) and fe.holds_prefix(bytes.fromhex(key))
+                self._send_json(200, {"holds": bool(holds)})
+            elif url.path == "/v1/migratable":
+                self._send_json(200, {"uids": fe.migration_candidates()})
+            elif url.path == "/v1/stats":
+                self._send_json(200, fe.stats())
+            elif url.path == "/v1/trace":
+                self._send_json(200, fe.tracing.to_json())
+            elif url.path == "/v1/tenants":
+                self._send_json(200, fe.tracing.tenants_report())
+            else:
+                self._send_json(404, {"error": "not found"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001 — probe must not kill server
+            self._safe_error(e)
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        try:
+            body = self._body()
+            if url.path == "/v1/submit":
+                self._do_submit(body)
+            elif url.path == "/v1/adopt":
+                self._do_adopt(body)
+            elif url.path == "/v1/cancel":
+                self._do_cancel(body)
+            elif url.path == "/v1/migrate_out":
+                self._do_migrate_out(body)
+            elif url.path == "/v1/migrate_in":
+                self._do_migrate_in(body)
+            else:
+                self._send_json(404, {"error": "not found"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            self._safe_error(e)
+
+    def _safe_error(self, e: Exception) -> None:
+        try:
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+        except Exception:  # noqa: BLE001 — headers already sent
+            pass
+
+    def _do_submit(self, body: Dict[str, Any]) -> None:
+        fe = self.rs.frontend
+        handle = fe.submit(
+            np.asarray(body["prompt"], np.int32),
+            priority=int(body.get("priority", PRIORITY_NORMAL)),
+            tenant=str(body.get("tenant", "default")),
+            slo_ttft_s=body.get("slo_ttft_s"),
+            deadline_s=body.get("deadline_s"),
+            max_new_tokens=int(body.get("max_new_tokens", 32)),
+            eos_token_id=body.get("eos_token_id"),
+            trace_id=body.get("trace_id"))
+        self._open_stream()
+        self._stream_handle(handle, cursor=0)
+
+    def _do_adopt(self, body: Dict[str, Any]) -> None:
+        """Cross-host re-home: rebuild a server-local StreamHandle from
+        the caller's ``dstpu-snapshot-v1`` and hand it to the frontend's
+        existing ``adopt`` replay machinery — the stream resumes past
+        the emitted prefix with zero duplicates (frames carry absolute
+        ``start``)."""
+        fe = self.rs.frontend
+        snap = body["snapshot"]
+        sampling = snap.get("sampling", {})
+        req = Request(
+            prompt=np.asarray(snap["prompt"], np.int32),
+            max_new_tokens=int(snap["max_new_tokens"]),
+            eos_token_id=sampling.get("eos_token_id"),
+            deadline_s=sampling.get("deadline_s"),
+            trace_id=snap.get("trace_id"),
+            tenant=str(sampling.get("tenant", "default")))
+        handle = StreamHandle(
+            req, fe, tenant=req.tenant,
+            priority=int(sampling.get("priority", PRIORITY_NORMAL)),
+            slo_ttft_s=sampling.get("slo_ttft_s"),
+            submit_t=fe._clock(), trace_id=snap.get("trace_id"))
+        emitted = [int(t) for t in snap.get("tokens_emitted", [])]
+        with handle._cond:
+            handle._tokens = list(emitted)
+        ok = fe.adopt(handle,
+                      rerouted_from=body.get("rerouted_from"))
+        if not ok:
+            self._send_json(409, {
+                "error": "adopt rejected",
+                "reject_reason": handle.reject_reason})
+            return
+        self._open_stream()
+        self._stream_handle(handle, cursor=len(emitted))
+
+    def _do_cancel(self, body: Dict[str, Any]) -> None:
+        handle = self.rs._live(int(body["uid"]))
+        if handle is None:
+            self._send_json(404, {"ok": False,
+                                  "error": "unknown or finished uid"})
+            return
+        handle.cancel()
+        self._send_json(200, {"ok": True})
+
+    def _do_migrate_out(self, body: Dict[str, Any]) -> None:
+        """Serialize-and-detach: the bundle travels back as the response
+        body while the original ``/v1/submit`` stream for the uid ends
+        with status ``migrated`` — the signal that the client's caller
+        handle must stay open for the destination's continuation."""
+        rs = self.rs
+        uid = int(body["uid"])
+        try:
+            bundle, handle = rs.frontend.migrate_out(
+                uid, timeout=rs.verb_timeout_s)
+        except MigrationError as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        # terminate the server-local stream; "migrated" is non-terminal
+        # on the WIRE (the client keeps its caller handle pending) but
+        # terminal for this server's copy
+        handle._resolve("migrated")
+        self._send_json(200, encode_bundle(bundle))
+
+    def _do_migrate_in(self, body: Dict[str, Any]) -> None:
+        rs = self.rs
+        bundle = decode_bundle(body["bundle"])
+        try:
+            handle = rs.frontend.migrate_in(
+                bundle, None, migrated_from=body.get("migrated_from"),
+                timeout=rs.verb_timeout_s)
+        except MigrationError as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        resumed = len(bundle.get("tokens", []))
+        self._open_stream()
+        self._stream_handle(handle, cursor=resumed)
+
+
+# ------------------------------------------------------------- server
+class ReplicaServer:
+    """Serve one :class:`ServingFrontend` over the fleet wire.
+
+    Stdlib-only (the exposition-server pattern): a
+    :class:`~...telemetry.exposition.ReusableThreadingHTTPServer` —
+    ``SO_REUSEADDR`` + daemon request threads — with one thread per
+    in-flight stream. ``port=0`` binds an ephemeral port; read the
+    kernel's choice back from ``.port`` (the test/bench pattern).
+
+    The server does not own the frontend's lifecycle: ``close()`` stops
+    accepting connections and ends in-flight streams (their sockets
+    close; clients see a disconnect), but the frontend keeps running
+    until its owner closes it."""
+
+    def __init__(self, frontend: ServingFrontend, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_s: float = 1.0,
+                 verb_timeout_s: float = 30.0):
+        self.frontend = frontend
+        self.heartbeat_s = float(heartbeat_s)
+        self.verb_timeout_s = float(verb_timeout_s)
+        self._lock = threading.Lock()
+        self._streams: Dict[int, StreamHandle] = {}
+        self._stream_conns: Dict[int, Any] = {}  # uid -> raw socket
+        self._httpd = ReusableThreadingHTTPServer((host, port),
+                                                  _FleetHandler)
+        self._httpd.replica_server = self  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        # tight poll: close() severs live streams only after shutdown()
+        # returns, so the accept loop must notice the flag promptly
+        self._thread = threading.Thread(
+            target=lambda: self._httpd.serve_forever(poll_interval=0.05),
+            name="dstpu-fleet-server", daemon=True)
+        self._thread.start()
+        logger.info(f"fleet replica server listening on {self.url}")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # live-stream registry: /v1/cancel resolves uids through it, and
+    # close() severs the registered sockets so a dead server looks
+    # dead to its clients instead of streaming on from handler threads
+    def _register(self, handle: StreamHandle, conn: Any) -> None:
+        with self._lock:
+            self._streams[int(handle.uid)] = handle
+            self._stream_conns[int(handle.uid)] = conn
+
+    def _unregister(self, handle: StreamHandle, conn: Any) -> None:
+        with self._lock:
+            self._streams.pop(int(handle.uid), None)
+            self._stream_conns.pop(int(handle.uid), None)
+
+    def _live(self, uid: int) -> Optional[StreamHandle]:
+        with self._lock:
+            return self._streams.get(uid)
+
+    @property
+    def n_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        # hard-sever in-flight streams: clients must see a disconnect
+        # (EOF without an end frame -> their salvage path), not a
+        # handler thread immortally feeding an orphaned socket
+        with self._lock:
+            conns = list(self._stream_conns.values())
+            self._stream_conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ReplicaServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

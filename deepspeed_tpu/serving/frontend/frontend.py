@@ -50,6 +50,7 @@ from ...telemetry import core as telemetry
 from ...telemetry.flight_recorder import FlightRecorder
 from ...telemetry.journey import new_trace_id
 from ...utils.logging import logger
+from ..engine import MigrationError
 from ..scheduler import Request
 from .admission import (AdmissionConfig, AdmissionController,
                         ChunkThroughputEstimator, PRIORITY_NORMAL,
@@ -58,6 +59,10 @@ from .tracing import TraceLog
 
 #: statuses after which a handle will never change again
 TERMINAL_STATUSES = ("done", "cancelled", "rejected", "error", "expired")
+
+#: versioned wire schemas (the transport serializes these verbatim)
+LOAD_SCHEMA = "dstpu-load-v1"
+SNAPSHOT_SCHEMA = "dstpu-snapshot-v1"
 
 
 class StreamHandle:
@@ -264,6 +269,9 @@ class ServingFrontend:
 
         self._wake = threading.Condition()
         self._cancel_requests: List[StreamHandle] = []
+        # (kind, payload, box) migration events the driver thread
+        # executes at its next iteration; callers block on box["done"]
+        self._migrations: List[tuple] = []
         self._closing = False
         self._closed = False
         self._crashed = False
@@ -416,13 +424,18 @@ class ServingFrontend:
         controller's and throughput estimator's locked snapshots plus
         the engine backlog. Engine-side numbers are read without the
         driver's cooperation, so they are approximate under concurrency
-        — fine for load scoring, not for invariants."""
+        — fine for load scoring, not for invariants.
+
+        The dict is ``dstpu-load-v1``: plain ints/floats/strings only,
+        so ``json.dumps`` round-trips it losslessly — the transport
+        serves it verbatim at ``GET /v1/load``."""
         sched = self._engine.scheduler
         backlog = sum(r.max_new_tokens - len(r.tokens)
                       for r in list(sched.running.values()))
         backlog += sum(q.max_new_tokens + q.prompt_len
                        for q in list(sched.queue))
         return {
+            "schema": LOAD_SCHEMA,
             "admission": self._controller.snapshot(),
             "throughput": self._estimator.snapshot(),
             "engine_backlog_tokens": int(backlog),
@@ -436,24 +449,35 @@ class ServingFrontend:
         needs about one handle: the ORIGINAL prompt and budget, the
         tokens emitted to the caller so far, and the sampling/admission
         parameters. The shared shape behind ``request_snapshot`` and
-        the flight recorder's ``in_flight`` records."""
+        the flight recorder's ``in_flight`` records.
+
+        The dict is ``dstpu-snapshot-v1``: JSON-round-trippable by
+        construction — the prompt is a plain int list, never the
+        ndarray it used to leak (which ``json.dumps`` rejects), so the
+        transport's ``/v1/adopt`` ships it verbatim."""
         with handle._cond:
             emitted = list(handle._tokens)
             status = handle._status or "pending"
         req = handle._request
         return {
+            "schema": SNAPSHOT_SCHEMA,
             "uid": handle.uid,
             "trace_id": handle.trace_id,
             "status": status,
-            "prompt": handle._prompt.copy(),
+            "prompt": [int(t) for t in handle._prompt],
             "prompt_len": int(handle._prompt.shape[0]),
-            "tokens_emitted": emitted,
+            "tokens_emitted": [int(t) for t in emitted],
             "max_new_tokens": handle._max_new_tokens,
-            "sampling": {"eos_token_id": req.eos_token_id,
-                         "deadline_s": req.deadline_s,
-                         "priority": handle.priority,
+            "sampling": {"eos_token_id": (
+                             None if req.eos_token_id is None
+                             else int(req.eos_token_id)),
+                         "deadline_s": (None if req.deadline_s is None
+                                        else float(req.deadline_s)),
+                         "priority": int(handle.priority),
                          "tenant": handle.tenant,
-                         "slo_ttft_s": handle.slo_ttft_s},
+                         "slo_ttft_s": (
+                             None if handle.slo_ttft_s is None
+                             else float(handle.slo_ttft_s))},
         }
 
     def request_snapshot(self, uid: int) -> Optional[Dict[str, Any]]:
@@ -474,6 +498,87 @@ class ServingFrontend:
         if handle is None:
             return None
         return self._handle_snapshot(handle)
+
+    def holds_prefix(self, key: bytes) -> bool:
+        """Pure prefix-cache membership peek (no LRU touch) — the
+        router's placement affinity probe, and the surface the
+        transport's ``GET /v1/prefix`` serves. False on engines without
+        a prefix cache."""
+        kv = getattr(self._engine, "kv", None)
+        cache = getattr(kv, "prefix_cache", None)
+        if cache is None or not getattr(kv, "prefix_enabled", False):
+            return False
+        return key in cache
+
+    def migration_candidates(self) -> List[int]:
+        """uids of requests movable RIGHT NOW (running, fully
+        prefilled, at least one emitted token, paged KV) — the set a
+        rebalancer picks from. Thread-safe, approximate under
+        concurrency: the driver re-checks at migrate time."""
+        eng = self._engine
+        can = getattr(eng, "can_migrate", None)
+        if can is None:
+            return []
+        out: List[int] = []
+        for req in list(eng.scheduler.running.values()):
+            try:
+                if req.uid in self._handles and can(req):
+                    out.append(int(req.uid))
+            except Exception:  # noqa: BLE001 — a racing retire is a no
+                continue
+        return out
+
+    def migrate_out(self, uid: int, timeout: Optional[float] = 30.0):
+        """Serialize and DETACH one running request: returns
+        ``(bundle, handle)`` where ``bundle`` is the engine's KV +
+        cursor export and ``handle`` is the caller's still-pending
+        StreamHandle, released from this frontend (its engine-side
+        request is cancelled, its trace segment closes ``migrated``).
+        The handle keeps streaming once a target's ``migrate_in``
+        re-attaches it. Runs on the driver thread (this call blocks
+        until it executes); raises :class:`MigrationError` when the
+        request is not migratable or the driver is gone."""
+        box: Dict[str, Any] = {"done": threading.Event()}
+        with self._wake:
+            if self._closing or self._crashed:
+                raise MigrationError("frontend is closed or crashed")
+            self._migrations.append(("out", {"uid": int(uid)}, box))
+            self._wake.notify()
+        if not box["done"].wait(timeout):
+            raise MigrationError(
+                f"migrate_out uid={uid} did not execute within "
+                f"{timeout}s")
+        if "error" in box:
+            raise MigrationError(box["error"])
+        return box["bundle"], box["handle"]
+
+    def migrate_in(self, bundle: Dict[str, Any],
+                   handle: Optional[StreamHandle] = None, *,
+                   migrated_from: Optional[str] = None,
+                   timeout: Optional[float] = 30.0) -> StreamHandle:
+        """Re-home an exported request HERE, mid-decode: lease blocks,
+        scatter the bundle's KV, and join the running set — the next
+        chunk continues from the migrated cursor, greedy bit-identical
+        to never having moved. ``handle`` (the in-process case) is
+        re-attached and keeps streaming to its caller; without one (the
+        transport server case) a fresh handle is built whose delivered
+        prefix is the bundle's resumed tokens. Raises
+        :class:`MigrationError` when this engine cannot host the
+        request (the caller re-imports at the source)."""
+        box: Dict[str, Any] = {"done": threading.Event()}
+        with self._wake:
+            if self._closing or self._crashed:
+                raise MigrationError("frontend is closed or crashed")
+            self._migrations.append(
+                ("in", {"bundle": bundle, "handle": handle,
+                        "migrated_from": migrated_from}, box))
+            self._wake.notify()
+        if not box["done"].wait(timeout):
+            raise MigrationError(
+                f"migrate_in did not execute within {timeout}s")
+        if "error" in box:
+            raise MigrationError(box["error"])
+        return box["handle"]
 
     def stats(self) -> Dict[str, Any]:
         """Control-plane counters (thread-safe, approximate under
@@ -616,14 +721,27 @@ class ServingFrontend:
     def _drive_once(self) -> bool:
         eng = self._engine
         with self._wake:
-            if not (self._cancel_requests or self._closing
-                    or self._controller.pending
+            if not (self._cancel_requests or self._migrations
+                    or self._closing or self._controller.pending
                     or eng.scheduler.has_work() or eng.chunk_in_flight):
                 self._wake.wait(self._idle_wait_s)
             cancels, self._cancel_requests = self._cancel_requests, []
+            migrations, self._migrations = self._migrations, []
             closing = self._closing
         for handle in cancels:
             self._do_cancel(handle)
+        for kind, payload, box in migrations:
+            try:
+                if kind == "out":
+                    self._do_migrate_out(payload["uid"], box)
+                else:
+                    self._do_migrate_in(payload["bundle"],
+                                        payload["handle"],
+                                        payload["migrated_from"], box)
+            except Exception as e:  # noqa: BLE001 — caller unblocks
+                box["error"] = f"{type(e).__name__}: {e}"
+            finally:
+                box["done"].set()
         self._feed()
         if eng.scheduler.has_work() or eng.chunk_in_flight:
             tokens_before = eng.metrics.tokens_out
@@ -741,6 +859,80 @@ class ServingFrontend:
             self.tracing.finish(req.uid, req.status)
             handle._resolve(req.status)
 
+    def _do_migrate_out(self, uid: int, box: Dict[str, Any]) -> None:
+        """Driver-side half of :meth:`migrate_out`: flush delivered
+        tokens (the handle's emitted prefix must equal the request's
+        committed tokens — the bundle's resumed-token count), export
+        the KV bundle, then detach: pop the handle, cancel the
+        engine-side request (slot + blocks free within this
+        iteration), and close the trace segment ``migrated``."""
+        eng = self._engine
+        handle = self._handles.get(uid)
+        if handle is None:
+            box["error"] = f"uid {uid} is not inside this engine"
+            return
+        req = handle._request
+        self._push_progress(req, handle)
+        bundle = eng.export_request(req)       # raises MigrationError
+        self._handles.pop(uid, None)
+        eng.cancel(req)
+        self.flight.record("migrate_out", uid=uid,
+                           trace_id=handle.trace_id,
+                           n_tokens=len(bundle["tokens"]),
+                           kv_bytes=bundle["kv_bytes"])
+        self.tracing.finish(uid, "migrated")
+        box["bundle"] = bundle
+        box["handle"] = handle
+
+    def _do_migrate_in(self, bundle: Dict[str, Any],
+                       handle: Optional[StreamHandle],
+                       migrated_from: Optional[str],
+                       box: Dict[str, Any]) -> None:
+        """Driver-side half of :meth:`migrate_in`: import the bundle
+        into the engine (slot + blocks + cursor), then attach the
+        caller's handle (or mint one for a transport-server stream) so
+        delivery resumes exactly past the resumed-token prefix."""
+        eng = self._engine
+        req = eng.import_request(bundle)       # raises MigrationError
+        resumed = len(req.tokens)
+        if handle is None:
+            handle = StreamHandle(
+                req, self, tenant=req.tenant, priority=PRIORITY_NORMAL,
+                slo_ttft_s=None, submit_t=self._clock(),
+                trace_id=req.trace_id)
+            with handle._cond:
+                # the resumed prefix was already delivered at the
+                # source; keep it in the buffer so absolute token
+                # indices (the wire's dedup key) stay continuous, and
+                # park the cursor past it so a server-side stream
+                # starts at the first fresh token
+                handle._tokens = [int(t) for t in req.tokens]
+                handle._cursor = resumed
+        handle._request = req
+        handle._frontend = self
+        handle._ticket = None
+        handle._pushed = resumed
+        handle._prefill_marked = True
+        self._handles[req.uid] = handle
+        self.n_submitted += 1
+        meta = dict(tenant=handle.tenant, priority=handle.priority,
+                    prompt_len=req.prompt_len,
+                    max_new_tokens=req.max_new_tokens,
+                    slo_ttft_s=handle.slo_ttft_s,
+                    deadline_s=req.deadline_s,
+                    trace_id=handle.trace_id,
+                    replica=self._telemetry_label,
+                    migrated_from=migrated_from,
+                    resumed_tokens=resumed)
+        self.tracing.start(req.uid, **meta)
+        self.tracing.mark(req.uid, "submitted", t=handle.submit_t)
+        self.tracing.mark(req.uid, "admitted")
+        self.flight.record("migrate_in", uid=req.uid,
+                           trace_id=handle.trace_id,
+                           migrated_from=migrated_from,
+                           resumed_tokens=resumed)
+        box["handle"] = handle
+
     def _do_cancel(self, handle: StreamHandle) -> None:
         if handle.done:
             return
@@ -801,6 +993,10 @@ class ServingFrontend:
             self._crashed = True
             self._crash_error = exc
             cancels, self._cancel_requests = self._cancel_requests, []
+            migrations, self._migrations = self._migrations, []
+        for _kind, _payload, box in migrations:
+            box["error"] = f"driver crashed: {msg}"
+            box["done"].set()
         cancel_uids = {h.uid for h in cancels}
         salvaged: List[StreamHandle] = []
         for ticket in self._controller.drain():

@@ -114,7 +114,8 @@ class RequestTrace:
     __slots__ = ("uid", "tenant", "priority", "prompt_len",
                  "max_new_tokens", "slo_ttft_s", "deadline_s", "events",
                  "chunks", "status", "reject_reason", "error", "n_tokens",
-                 "trace_id", "replica", "rerouted_from", "replayed_tokens")
+                 "trace_id", "replica", "rerouted_from", "replayed_tokens",
+                 "migrated_from", "resumed_tokens")
 
     def __init__(self, uid: int, *, tenant: str = "default",
                  priority: int = 1, prompt_len: int = 0,
@@ -124,7 +125,9 @@ class RequestTrace:
                  trace_id: Optional[str] = None,
                  replica: Optional[str] = None,
                  rerouted_from: Optional[str] = None,
-                 replayed_tokens: int = 0):
+                 replayed_tokens: int = 0,
+                 migrated_from: Optional[str] = None,
+                 resumed_tokens: int = 0):
         self.uid = uid
         self.tenant = tenant
         self.priority = priority
@@ -142,6 +145,11 @@ class RequestTrace:
         # opened: >0 marks an in-flight replay after a crash (the
         # survivor re-prefilled prompt + this many emitted tokens)
         self.replayed_tokens = replayed_tokens
+        # live KV-block migration hop: the replica this segment's KV
+        # arrived from, and the decode cursor it resumed at (no
+        # re-prefill — the blocks moved, unlike a crash replay)
+        self.migrated_from = migrated_from
+        self.resumed_tokens = resumed_tokens
         self.events: Dict[str, float] = {}
         self.chunks: List[List[float]] = []      # [t, n_tokens] pairs
         self.status: Optional[str] = None        # terminal status
@@ -185,6 +193,8 @@ class RequestTrace:
             "replica": self.replica,
             "rerouted_from": self.rerouted_from,
             "replayed_tokens": self.replayed_tokens,
+            "migrated_from": self.migrated_from,
+            "resumed_tokens": self.resumed_tokens,
             "tenant": self.tenant,
             "priority": self.priority,
             "prompt_len": self.prompt_len,

@@ -324,6 +324,25 @@ class PagedSlotAllocator:
                 return False
         return True
 
+    def alloc_span(self, fill_len: int,
+                   n_blocks: int) -> Optional[int]:
+        """Lease a slot with EXACTLY ``n_blocks`` fresh blocks at fill
+        ``fill_len`` — the migration-import lease: the incoming request
+        already has its KV (the bundle carries the block payload), so no
+        prefix planning, no admit plan, just a slot whose table can
+        receive the scattered blocks. None = no slot or not enough
+        blocks even after cache eviction (OOM is a value)."""
+        if n_blocks < 1 or n_blocks > self.blocks_per_seq:
+            raise ValueError(
+                f"n_blocks {n_blocks} out of range [1, "
+                f"{self.blocks_per_seq}]")
+        if not self._free_slots:
+            return None
+        if not self._ensure_free(n_blocks):
+            return None
+        table = [self.blocks.alloc() for _ in range(n_blocks)]
+        return self._take_slot(fill_len, table)
+
     def alloc(self, fill_len: int = 0) -> Optional[int]:
         """Dense-compatible lease (no Request in hand): reserves the full
         per-sequence block budget, skipping the prefix cache. The
@@ -654,6 +673,66 @@ class PagedKVCacheManager:
         normal slot release."""
         if plan.key is not None:
             self.allocator._pending.discard(plan.key)
+
+    # ------------------------------------------------- block portability
+    def export_blocks(self, slot: int,
+                      n_blocks: Optional[int] = None) -> Dict[str, Any]:
+        """Gather one slot's leased KV blocks off-device: the payload a
+        live migration ships. Returns ``{normalized leaf key ->
+        np.ndarray [..., n, block_size, h*d]}`` in block-TABLE order
+        (position order), for every kv pool leaf — index leaves
+        (cache_index / block_tables) are reconstructed at import, never
+        shipped. One eager gather per leaf; migration is a rare
+        host-paced op, so nothing here is jitted (no retrace-budget
+        surface)."""
+        import jax
+        import jax.numpy as jnp
+        table = self.allocator.tables[slot]
+        if n_blocks is None:
+            n_blocks = len(table)
+        idx = jnp.asarray(np.asarray(table[:n_blocks], np.int32))
+        out: Dict[str, Any] = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache)[0]:
+            ks = jax.tree_util.keystr(path)
+            if "cache_index" in ks or "block_tables" in ks:
+                continue
+            lead = leaf.ndim - 3
+            out[_norm_key(ks)] = np.asarray(
+                jnp.take(leaf, idx, axis=lead))
+        return out
+
+    def import_blocks(self, slot: int, leaves: Dict[str, Any]) -> None:
+        """Scatter exported block payloads into ``slot``'s freshly
+        leased blocks (``alloc_span``) and install the slot's table row +
+        write cursor on device — the receiving half of a live migration.
+        ``leaves`` maps normalized leaf keys (``export_blocks`` output)
+        to ``[..., n, block_size, h*d]`` arrays; ``n`` may be smaller
+        than the lease (only written blocks ship). Eager per-leaf
+        scatter, same rare-op rationale as ``export_blocks``."""
+        import jax
+        import jax.numpy as jnp
+        table = self.allocator.tables[slot]
+        fill = int(self.allocator.fill[slot])
+
+        def leaf(path, a):
+            ks = jax.tree_util.keystr(path)
+            if "block_tables" in ks:
+                return a.at[..., slot, :].set(
+                    jnp.asarray(self.allocator.padded_table(slot)))
+            if "cache_index" in ks:
+                return a.at[..., slot].set(jnp.int32(fill))
+            payload = leaves.get(_norm_key(ks))
+            if payload is None:
+                raise KeyError(
+                    f"migration bundle is missing kv leaf {ks!r}")
+            lead = a.ndim - 3
+            n = payload.shape[lead]
+            idx = jnp.asarray(np.asarray(table[:n], np.int32))
+            sel = (slice(None),) * lead + (idx,)
+            return a.at[sel].set(jnp.asarray(payload).astype(a.dtype))
+
+        self.cache = jax.tree_util.tree_map_with_path(leaf, self.cache)
 
     def update(self, new_cache: Any) -> None:
         self.cache = new_cache

@@ -222,6 +222,18 @@ def parse_prometheus_text(text: str) -> Dict[str, Any]:
     return {"samples": samples, "types": types}
 
 
+class ReusableThreadingHTTPServer(ThreadingHTTPServer):
+    """Shared HTTP server base for every dstpu endpoint (metrics, fleet
+    transport): ``SO_REUSEADDR`` so benches and tests can rebind a port
+    still in TIME_WAIT back-to-back, daemon request threads so a wedged
+    handler never blocks interpreter exit. Bind with ``port=0`` for an
+    ephemeral port and read the kernel's choice back from
+    ``.server_address[1]``."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dstpu-metrics/1"
 
@@ -304,8 +316,7 @@ class MetricsServer:
         self.health = health
         self.slo = slo
         self.namespace = namespace
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = ReusableThreadingHTTPServer((host, port), _Handler)
         self._httpd.metrics_server = self        # type: ignore[attr-defined]
         self.host = host
         self.port = self._httpd.server_address[1]

@@ -30,7 +30,17 @@ Journal shape (``FleetRouter.journey_journal()``)::
      "reroutes":   [{trace_id, uid, t, from_replica, to_replica,
                      postmortem}],
      "crashes":    [{replica, t, error, postmortem, n_salvaged}],
+     "migrations": [{trace_id, uid, t, dur_s, from_replica, to_replica,
+                     resumed_tokens, kv_bytes}],
      "replicas":   {rid: TraceLog.to_json()}}
+
+A live KV-block migration (PR 15) is a journey hop like a reroute, but
+the device state MOVED instead of replaying: the source segment closes
+``migrated``, the destination segment opens with ``migrated_from`` +
+``resumed_tokens``, and a ``migrate`` flow arrow ties the hop. The
+validator gates token continuity across the hop — the resumed prefix
+must equal everything emitted before it (zero lost, zero duplicated
+tokens).
 
 Stdlib-only — ``bin/tputrace`` imports this without JAX.
 """
@@ -72,7 +82,8 @@ def assemble_journeys(journal: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
         if tid not in journeys:
             journeys[tid] = {"trace_id": tid, "uid": None,
                              "placement": None, "segments": [],
-                             "reroutes": [], "status": None}
+                             "reroutes": [], "migrations": [],
+                             "status": None}
         return journeys[tid]
 
     for p in journal.get("placements", ()):
@@ -92,6 +103,11 @@ def assemble_journeys(journal: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             j["segments"].append({"replica": rid, "record": rec})
     for r in journal.get("reroutes", ()):
         entry(r["trace_id"])["reroutes"].append(dict(r))
+    for m in journal.get("migrations", ()):
+        # failed migrations journal with trace_id=None — they are not
+        # journey hops (the request never moved)
+        if m.get("trace_id") and not m.get("failed"):
+            entry(m["trace_id"])["migrations"].append(dict(m))
     for j in journeys.values():
         j["segments"].sort(key=lambda s: _segment_time(s["record"]))
         if j["segments"]:
@@ -146,6 +162,9 @@ def journey_trace_events(journal: Dict[str, Any], *,
                     "n_tokens": rec.get("n_tokens")}
             if rec.get("rerouted_from") is not None:
                 args["rerouted_from"] = rec["rerouted_from"]
+            if rec.get("migrated_from") is not None:
+                args["migrated_from"] = rec["migrated_from"]
+                args["resumed_tokens"] = rec.get("resumed_tokens")
             events.append({
                 "name": f"replica{rid}:{rec.get('status') or 'live'}",
                 "ph": "X", "ts": us(sub),
@@ -180,6 +199,20 @@ def journey_trace_events(journal: Dict[str, Any], *,
             events.append({**common, "ph": "s", "ts": us(r["t"])})
             events.append({**common, "ph": "f", "bp": "e",
                            "ts": us(r["t"]) + 1.0})
+        for i, m in enumerate(j["migrations"]):
+            fid = f"migrate:{tid_str}:{i}"
+            args = {"trace_id": tid_str,
+                    "migrated_from": m.get("from_replica"),
+                    "migrated_to": m.get("to_replica"),
+                    "resumed_tokens": m.get("resumed_tokens"),
+                    "kv_bytes": m.get("kv_bytes")}
+            common = {"name": "migrate", "cat": "migrate", "id": fid,
+                      "pid": pid, "tid": lane, "args": args}
+            events.append({**common, "ph": "s", "ts": us(m["t"])})
+            events.append({**common, "ph": "f", "bp": "e",
+                           "ts": us(m["t"])
+                           + max(float(m.get("dur_s") or 0.0) * _US,
+                                 1.0)})
     return events
 
 
@@ -208,7 +241,11 @@ def validate_journeys(trace_obj: Dict[str, Any], *,
       journey per trace id, even across a reroute;
     * a journey that finished ``done`` streamed at least one chunk;
     * any segment carrying ``rerouted_from`` has a matching ``reroute``
-      flow-arrow pair (``s`` + ``f``).
+      flow-arrow pair (``s`` + ``f``);
+    * migration hops are gated: the journey stays on its single lane,
+      each ``migrated_from`` segment has EXACTLY one ``migrate`` flow
+      arrow, and there is no token gap at the hop — the segment's
+      ``resumed_tokens`` equals everything emitted before it.
 
     Returns a list of problems (empty = valid)."""
     problems: List[str] = []
@@ -247,6 +284,36 @@ def validate_journeys(trace_obj: Dict[str, Any], *,
                 problems.append(
                     f"journey {tid}: rerouted segment without a "
                     f"reroute flow link (have phases {sorted(flows)})")
+        ordered = sorted(segments, key=lambda e: e.get("ts", 0.0))
+        migrated = [e for e in ordered
+                    if (e.get("args") or {}).get("migrated_from")
+                    is not None]
+        m_starts = [e for e in evs if e.get("cat") == "migrate"
+                    and e.get("ph") == "s"]
+        m_ends = [e for e in evs if e.get("cat") == "migrate"
+                  and e.get("ph") == "f"]
+        if len(m_starts) != len(migrated) or len(m_ends) != len(migrated):
+            problems.append(
+                f"journey {tid}: {len(migrated)} migrated segment(s) "
+                f"but {len(m_starts)} migrate flow start(s) / "
+                f"{len(m_ends)} end(s) — expected exactly one arrow "
+                f"per hop")
+        # no token gap at the hop: the resumed prefix must equal the
+        # sum of everything earlier segments emitted (zero lost, zero
+        # duplicated tokens across the migration)
+        for idx, e in enumerate(ordered):
+            a = e.get("args") or {}
+            if a.get("migrated_from") is None:
+                continue
+            resumed = a.get("resumed_tokens")
+            before = sum(
+                int((s.get("args") or {}).get("n_tokens") or 0)
+                for s in ordered[:idx])
+            if resumed is None or int(resumed) != before:
+                problems.append(
+                    f"journey {tid}: token gap at migration hop "
+                    f"(resumed_tokens={resumed}, emitted before "
+                    f"hop={before})")
     return problems
 
 

@@ -290,6 +290,30 @@ FLEET_SPECS: List[MetricSpec] = [
                note="no SLO, all done — exactly 1.0"),
     MetricSpec(("tenant_goodput", "tenants", "tenant-b",
                 "goodput_fraction"), SHIFT, abs_tol=0.0),
+    # ---- cross-host transport + live KV-block migration (--transport) ----
+    MetricSpec(("transport", "loopback_parity"), SHIFT, abs_tol=0.0,
+               note="loopback-HTTP routed streams vs ServingEngine.run "
+                    "bit-exactness is binary"),
+    MetricSpec(("transport", "migration_parity"), SHIFT, abs_tol=0.0,
+               note="real-KV migration mid-decode stays greedy "
+                    "bit-identical — zero lost/dup tokens, binary"),
+    MetricSpec(("transport", "migrated"), SHIFT, abs_tol=0.0,
+               note="binary: at least one live migration on each leg "
+                    "(raw counts are timing-shaped and unwatched)"),
+    MetricSpec(("transport", "migrate_failed"), SHIFT, abs_tol=0.0,
+               note="binary: a failed migration must never lose a "
+                    "stream — failure degrades to a load-balancing "
+                    "miss"),
+    MetricSpec(("transport", "lost_tokens"), SHIFT, abs_tol=0.0,
+               note="zero tokens lost across migrations"),
+    MetricSpec(("transport", "duplicate_tokens"), SHIFT, abs_tol=0.0,
+               note="zero tokens duplicated across migrations"),
+    MetricSpec(("transport", "errors"), SHIFT, abs_tol=0.0,
+               note="no stream resolves error on the pinned workload"),
+    MetricSpec(("transport", "occupancy_spread"), LOWER, 0.50,
+               abs_tol=1.0,
+               note="max-min per-replica running count after rebalance; "
+                    "the hard bound is asserted inside the bench"),
 ]
 
 SPEC_SETS: Dict[str, List[MetricSpec]] = {

@@ -1,0 +1,281 @@
+"""Cross-host fleet transport: wire codec, snapshot schemas, loopback
+parity, failure modes, live KV migration, exposition rebinding.
+
+Covers the PR-15 transport tier at tier-1 speed, JAX-free (replicas
+are the fleet bench's :class:`SimulatedEngine` — real scheduler, real
+slot accounting, sleep-for-device):
+
+* the ``dstpu-migrate-v1`` bundle codec: ndarray leaves survive a full
+  JSON round trip (b64 + dtype + shape), already-decoded leaves pass
+  through;
+* the versioned ``dstpu-load-v1`` / ``dstpu-snapshot-v1`` dicts are
+  JSON-round-trippable — including the regression where the handle
+  snapshot leaked the prompt ndarray ``json.dumps`` rejects;
+* loopback parity: a fleet built ENTIRELY from remote replicas
+  (``engines=[]``) streams the same tokens the in-process path
+  produces;
+* failure modes: a mid-stream server death resolves a structured
+  ``error`` (never hangs); a server-side cancel frees the slot within
+  a chunk; a dead remote behind a router re-homes every live stream
+  onto the survivor with zero lost or duplicated tokens;
+* live migration: a running request moves mid-decode between remote
+  replicas and finishes bit-identical, the journey export validating
+  with the migration hop connected; a bogus uid fails non-lossily;
+* the shared exposition server base: ``port=0`` ephemeral binding and
+  back-to-back rebinding of the same port (``SO_REUSEADDR``).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.benchmarks.fleet_bench import (SimulatedEngine,
+                                                  _sim_expected)
+from deepspeed_tpu.serving.engine import MIGRATE_SCHEMA
+from deepspeed_tpu.serving.fleet import (FleetRouter, RemoteReplica,
+                                         ReplicaServer, decode_bundle,
+                                         encode_bundle)
+from deepspeed_tpu.serving.frontend.frontend import (LOAD_SCHEMA,
+                                                     SNAPSHOT_SCHEMA,
+                                                     ServingFrontend)
+from deepspeed_tpu.telemetry.exposition import (MetricsServer,
+                                                ReusableThreadingHTTPServer)
+from deepspeed_tpu.telemetry.journey import validate_journeys
+
+
+def _prompt(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 100, (n,)).astype(np.int32)
+
+
+@pytest.fixture
+def replica_factory():
+    """Builds (engine, frontend, server, remote) quadruples and tears
+    every layer down afterwards whatever the test did to them."""
+    made = []
+
+    def make(**eng_kw):
+        kw = dict(max_batch=2, decode_chunk=4, chunk_time_s=0.005)
+        kw.update(eng_kw)
+        eng = SimulatedEngine(**kw)
+        fe = ServingFrontend(eng)
+        srv = ReplicaServer(fe)
+        rem = RemoteReplica("127.0.0.1", srv.port)
+        made.append((eng, fe, srv, rem))
+        return eng, fe, srv, rem
+
+    yield make
+    for _, fe, srv, rem in made:
+        rem.close(timeout=5)
+        srv.close()
+        fe.close(timeout=5)
+
+
+def _wait(cond, timeout=20.0, every=0.005):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(every)
+    return True
+
+
+# --------------------------------------------------- wire bundle codec
+class TestBundleCodec:
+    def _bundle(self):
+        return {
+            "schema": MIGRATE_SCHEMA,
+            "prompt": [1, 2, 3], "tokens": [3, 1],
+            "max_new_tokens": 8, "eos_token_id": None,
+            "deadline_s": None, "tenant": "default", "trace_id": "t-1",
+            "fill": 4, "block_size": 4, "n_blocks": 1, "kv_bytes": 64,
+            "kv": {"layer0/k": np.arange(12, dtype=np.float32)
+                   .reshape(3, 4),
+                   "layer0/v": np.arange(6, dtype=np.int32).reshape(2, 3)},
+        }
+
+    def test_json_round_trip_preserves_leaves(self):
+        bundle = self._bundle()
+        wire = json.loads(json.dumps(encode_bundle(bundle)))
+        assert wire["kv_encoding"] == "b64-v1"
+        back = decode_bundle(wire)
+        assert back["schema"] == MIGRATE_SCHEMA
+        assert back["tokens"] == [3, 1]
+        for name, leaf in bundle["kv"].items():
+            got = back["kv"][name]
+            assert got.dtype == leaf.dtype and got.shape == leaf.shape
+            assert np.array_equal(got, leaf)
+
+    def test_decoded_leaves_pass_through(self):
+        bundle = self._bundle()
+        back = decode_bundle(bundle)          # never encoded: local hop
+        assert back["kv"]["layer0/k"] is bundle["kv"]["layer0/k"]
+
+
+# ------------------------------------------- versioned snapshot schemas
+class TestSnapshotSchemas:
+    def test_load_snapshot_json_round_trips(self, replica_factory):
+        _, fe, _, _ = replica_factory()
+        snap = fe.load_snapshot()
+        assert snap["schema"] == LOAD_SCHEMA
+        assert json.loads(json.dumps(snap)) == snap
+
+    def test_handle_snapshot_json_round_trips(self, replica_factory):
+        # the regression: the snapshot used to carry the prompt ndarray,
+        # which json.dumps rejects — it must be a plain int list
+        _, fe, _, _ = replica_factory(chunk_time_s=0.05)
+        h = fe.submit(_prompt(), max_new_tokens=16)
+        snap = fe.request_snapshot(h.uid)
+        deadline = time.monotonic() + 20.0
+        while snap is None and not h.done \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+            snap = fe.request_snapshot(h.uid)
+        assert snap is not None
+        assert snap["schema"] == SNAPSHOT_SCHEMA
+        assert isinstance(snap["prompt"], list)
+        assert all(isinstance(t, int) for t in snap["prompt"])
+        assert json.loads(json.dumps(snap)) == snap
+        assert h.result(timeout=30) == "done"
+
+
+# --------------------------------------------------- loopback transport
+class TestLoopbackTransport:
+    def test_all_remote_fleet_streams_parity(self, replica_factory):
+        _, _, _, rem = replica_factory()
+        prompts = [_prompt(seed=s) for s in range(4)]
+        with FleetRouter([], remotes=[rem]) as router:
+            handles = [router.submit(p, max_new_tokens=12)
+                       for p in prompts]
+            for h, p in zip(handles, prompts):
+                assert h.result(timeout=60) == "done"
+                assert [int(t) for t in h.tokens] == _sim_expected(p, 12)
+            assert router.stats()["routed"] == 4
+
+    def test_empty_fleet_still_rejected(self):
+        with pytest.raises(ValueError):
+            FleetRouter([], remotes=[])
+
+    def test_server_side_cancel_frees_slot(self, replica_factory):
+        eng, _, _, rem = replica_factory(chunk_time_s=0.05)
+        h = rem.submit(_prompt(), max_new_tokens=512)
+        assert _wait(lambda: len(h.tokens) >= 1)
+        h.cancel()
+        assert h.result(timeout=30) == "cancelled"
+        # the engine-side slot must come back within about one chunk
+        assert _wait(lambda: not eng.scheduler.running, timeout=5.0)
+
+
+# -------------------------------------------------------- failure modes
+class TestFailureModes:
+    def test_mid_stream_disconnect_is_structured_error(self,
+                                                       replica_factory):
+        _, fe, srv, rem = replica_factory(chunk_time_s=0.05)
+        h = rem.submit(_prompt(), max_new_tokens=512)
+        assert _wait(lambda: len(h.tokens) >= 1)
+        srv.close()          # hard mid-stream death, no end frame
+        fe.close(timeout=5)
+        assert h.result(timeout=30) == "error"
+        assert "remote replica" in (h.error or "")
+        assert rem.crashed
+
+    def test_dead_remote_rehomes_streams_no_duplicates(
+            self, replica_factory):
+        # all three streams must be concurrently LIVE on A when it dies
+        # (max_batch=4), and long enough (64 tokens ~ 0.8s) that they
+        # are still mid-decode once close() finishes shutting down the
+        # accept loop and severs them
+        max_new = 64
+        _, fe_a, srv_a, rem_a = replica_factory(chunk_time_s=0.05,
+                                                max_batch=4)
+        _, _, _, rem_b = replica_factory(chunk_time_s=0.005, max_batch=4)
+        prompts = [_prompt(seed=s) for s in range(3)]
+        with FleetRouter([], remotes=[rem_a, rem_b]) as router:
+            router.replicas[1].dead = True      # everything lands on A
+            handles = [router.submit(p, max_new_tokens=max_new)
+                       for p in prompts]
+            assert _wait(lambda: all(len(h.tokens) >= 1
+                                     for h in handles))
+            router.replicas[1].dead = False
+            prefixes = [list(h.tokens) for h in handles]
+            srv_a.close()                       # A dies mid-stream
+            fe_a.close(timeout=5)
+            statuses = [h.result(timeout=60) for h in handles]
+            assert statuses == ["done"] * len(handles)
+            for h, pre in zip(handles, prefixes):
+                got = [int(t) for t in h.tokens]
+                # zero lost or duplicated tokens: exact budget, and the
+                # pre-crash prefix survives verbatim
+                assert len(got) == max_new
+                assert got[:len(pre)] == [int(t) for t in pre]
+            assert router.stats()["replica_crashes"] == 1
+            assert router.stats()["rerouted"] == len(handles)
+
+
+# ------------------------------------------------------- live migration
+class TestLiveMigration:
+    def test_migrate_mid_decode_bit_identical(self, replica_factory):
+        max_new = 32
+        _, _, _, rem_a = replica_factory(chunk_time_s=0.05)
+        _, _, _, rem_b = replica_factory(chunk_time_s=0.005)
+        prompt = _prompt()
+        with FleetRouter([], remotes=[rem_a, rem_b]) as router:
+            rep_a, rep_b = router.replicas
+            rep_b.dead = True                   # deterministic placement
+            h = router.submit(prompt, max_new_tokens=max_new)
+            assert _wait(lambda: h._remote_uid is not None
+                         and len(h.tokens) >= 4)
+            rep_b.dead = False
+            assert not h.done
+            assert router.migrate(int(h._remote_uid), rep_a, rep_b)
+            assert h.result(timeout=60) == "done"
+            got = [int(t) for t in h.tokens]
+            assert got == _sim_expected(prompt, max_new)
+            stats = router.stats()
+            assert stats["migrated"] == 1
+            assert stats["migrate_failed"] == 0
+            problems = validate_journeys(router.export_chrome(None))
+            assert problems == []
+
+    def test_failed_migration_is_not_lossy(self, replica_factory):
+        _, _, _, rem_a = replica_factory(chunk_time_s=0.02)
+        _, _, _, rem_b = replica_factory()
+        prompt = _prompt()
+        with FleetRouter([], remotes=[rem_a, rem_b]) as router:
+            rep_a, rep_b = router.replicas
+            rep_b.dead = True
+            h = router.submit(prompt, max_new_tokens=16)
+            assert _wait(lambda: len(h.tokens) >= 1)
+            rep_b.dead = False
+            # a uid the client never streamed: export fails, nothing
+            # moves, nothing is lost
+            assert router.migrate(999_999, rep_a, rep_b) is False
+            assert router.stats()["migrate_failed"] == 1
+            assert h.result(timeout=60) == "done"
+            assert [int(t) for t in h.tokens] == _sim_expected(prompt, 16)
+
+
+# ------------------------------------------------- exposition rebinding
+class TestExpositionRebind:
+    def test_port_zero_binds_ephemeral(self):
+        ms = MetricsServer(port=0)
+        try:
+            assert ms.port > 0
+        finally:
+            ms.stop()
+
+    def test_back_to_back_rebind_same_port(self):
+        # SO_REUSEADDR on the shared server base: a freshly released
+        # port (connections possibly in TIME_WAIT) must rebind at once
+        assert ReusableThreadingHTTPServer.allow_reuse_address is True
+        assert ReusableThreadingHTTPServer.daemon_threads is True
+        ms = MetricsServer(port=0)
+        port = ms.port
+        ms.stop()
+        ms2 = MetricsServer(port=port)
+        try:
+            assert ms2.port == port
+        finally:
+            ms2.stop()
