@@ -13,11 +13,16 @@
 # tokens/s on the repetitive workload; acceptance rate reported), and
 # the default-on fused chunked-prefill A/B (prompts consumed in-scan:
 # bit-identical greedy dense AND paged, pinned fused retrace budgets,
-# zero attributed prefill stall).
+# zero attributed prefill stall), and the --tiered case (a workload
+# whose aggregate context is 10x the HBM block pool: cold prefixes
+# demote to host DRAM/NVMe and promote back on re-serve — bit-identical
+# greedy vs an all-HBM reference, >= 0.8x its throughput, demote/promote
+# counters nonzero, paged compile count within one retrace of the
+# untiered run, spill files cleaned on close).
 # Writes BENCH_serving.json (tokens/s for both loops, chunk_speedup,
-# prefill padding waste, the paged/speculative/int8_kv/fused blocks) at the
-# repo root and exits nonzero on parity failure or any crash — fast
-# enough for tier-1.
+# prefill padding waste, the paged/speculative/int8_kv/fused/tiered
+# blocks) at the repo root and exits nonzero on parity failure or any
+# crash — fast enough for tier-1.
 #
 # Usage: bin/serving_smoke.sh        (from the repo root, or anywhere)
 
@@ -27,5 +32,5 @@ exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m deepspeed_tpu.benchmarks.serving_bench \
     --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
     --decode-chunk 8 --skip-sequential --paged \
-    --speculative --kv-dtype int8 \
+    --speculative --kv-dtype int8 --tiered \
     --out-dir /tmp/serving_smoke_csv --json-out BENCH_serving.json

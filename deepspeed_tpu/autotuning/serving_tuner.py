@@ -1,0 +1,252 @@
+"""Serving capacity autotuner: measured search over the serving knobs.
+
+The training ``Autotuner`` sweeps (ZeRO stage, micro-batch, mesh); this
+reuses its experiment loop (records, plateau early-stop, best tracking,
+result files) but swaps the axes for the serving engine's capacity
+knobs — KV block size, fused decode-chunk ``K``, speculative ``spec_k``,
+fused-prefill chunk ``C``, and the tiered-KV DRAM watermark — and the
+measurement for a short REAL serving run (warm pass + timed pass over a
+fixed workload, tokens/s harvested).
+
+Each experiment also records the engine's KV HBM footprint
+(``arena_report()``), so the output is not a single winner but a
+**Pareto frontier** over (tokens/s up, HBM bytes down): the all-HBM
+corner and the tier-heavy corner are both kept if neither dominates.
+``write_tuned_config`` emits the frontier as ``dstpu-tuned-v1`` JSON,
+which ``ServingEngine(tuned_config=...)`` loads directly (it picks
+``best``, or the max-throughput frontier point).
+
+Usage::
+
+    tuner = ServingCapacityTuner(engine_factory, workload_factory)
+    tuner.tune(ServingTuningSpace(block_sizes=(8, 16),
+                                  decode_chunks=(4, 8)))
+    tuner.write_tuned_config("tuned.json")
+    serving = ServingEngine(engine=eng, tuned_config="tuned.json")
+
+or the one-call convenience ``tune_serving_capacity(base_engine, ...)``.
+"""
+
+from __future__ import annotations
+
+import gc
+import itertools
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import logger
+from .autotuner import Autotuner, Experiment
+
+#: schema tag of the emitted tuned-config JSON (consumed by
+#: ``ServingEngine(tuned_config=...)``).
+TUNED_SCHEMA = "dstpu-tuned-v1"
+
+METRIC_TOKENS_PER_S = "tokens_per_s"
+
+#: axis key -> short tag used in experiment names
+_ABBREV = {"kv_block_size": "bs", "decode_chunk": "k", "spec_k": "sk",
+           "prefill_chunk": "c", "tier_dram_bytes": "dram"}
+
+
+@dataclass
+class ServingTuningSpace:
+    """Explored serving axes. Values are lists; singletons pin an axis.
+
+    ``spec_ks`` uses 0 for "speculation off"; ``tier_dram_bytes`` uses
+    ``None`` for "tiering off" (pure HBM) — mixing None with byte
+    budgets sweeps the tier watermark against the all-HBM baseline.
+    """
+    block_sizes: Sequence[int] = (8, 16)
+    decode_chunks: Sequence[int] = (4, 8)
+    spec_ks: Sequence[int] = (0,)
+    prefill_chunks: Sequence[int] = (16,)
+    tier_dram_bytes: Sequence[Optional[int]] = (None,)
+
+
+class ServingCapacityTuner(Autotuner):
+    """Grid/random tuner over serving capacity knobs.
+
+    Args:
+      engine_factory: callable(config_dict) -> ``ServingEngine``. The
+        config dict carries the swept keys (``kv_block_size``,
+        ``decode_chunk``, ``spec_k``, ``prefill_chunk``,
+        ``tier_dram_bytes``) merged over ``base_config``.
+      workload_factory: callable(config_dict) -> (prompts,
+        max_new_tokens); called per experiment so the workload can adapt
+        to the config (it usually ignores it).
+      base_config: keys merged under every experiment's config.
+    """
+
+    def __init__(self, engine_factory: Callable[[dict], Any],
+                 workload_factory: Callable[[dict], Any],
+                 base_config: Optional[dict] = None, *,
+                 warmup_runs: int = 1, **kw):
+        kw.setdefault("metric", METRIC_TOKENS_PER_S)
+        kw.setdefault("results_dir", "serving_tuning_results")
+        super().__init__(engine_factory, workload_factory,
+                         base_config or {}, warmup_steps=warmup_runs,
+                         **kw)
+        if self.tuner_type == "model":
+            raise ValueError(
+                "serving tuner supports tuner_type 'gridsearch' or "
+                "'random' (the training cost model's features do not "
+                "transfer)")
+        #: per-experiment side data keyed by name: hbm_bytes, wall_s, ...
+        self._aux: Dict[str, Dict[str, Any]] = {}
+
+    # ---- experiment generation --------------------------------------------
+    def _experiments(self, space) -> List[Experiment]:
+        axes = [("kv_block_size", space.block_sizes),
+                ("decode_chunk", space.decode_chunks),
+                ("spec_k", space.spec_ks),
+                ("prefill_chunk", space.prefill_chunks),
+                ("tier_dram_bytes", space.tier_dram_bytes)]
+        exps = []
+        for vals in itertools.product(*(v for _, v in axes)):
+            cfg = json.loads(json.dumps(self.base_config))
+            cfg.update({k: v for (k, _), v in zip(axes, vals)})
+            # plateau groups by block size: the decode_chunk sweep within
+            # one block size is the monotone-until-the-knee family
+            group = f"bs{cfg['kv_block_size']}"
+            name = "_".join(
+                f"{_ABBREV[k]}{'off' if v is None else v}"
+                for (k, _), v in zip(axes, vals))
+            exps.append(Experiment(name=name, config=cfg, group=group))
+        if self.tuner_type == "random":
+            order = self.rng.permutation(len(exps))
+            exps = [exps[i] for i in order]
+        return exps[:self.max_experiments]
+
+    # ---- measurement -------------------------------------------------------
+    def _run_inproc(self, exp: Experiment) -> Optional[float]:
+        serving = None
+        try:
+            serving = self.engine_factory(exp.config)
+            prompts, max_new = self.data_factory(exp.config)
+            prompts = [np.asarray(p, np.int32) for p in prompts]
+            for _ in range(self.warmup_steps):
+                serving.run([p.copy() for p in prompts],
+                            max_new_tokens=max_new)
+            t0 = time.perf_counter()
+            results = serving.run([p.copy() for p in prompts],
+                                  max_new_tokens=max_new)
+            dt = time.perf_counter() - t0
+            tokens = sum(len(r.tokens) for r in results)
+            rep = serving.kv.arena_report()
+            hbm = int(rep.get("kv_bytes") or rep.get("arena_bytes") or 0)
+            self._aux[exp.name] = {
+                "hbm_bytes": hbm,
+                "wall_s": dt,
+                "tokens": tokens,
+                "tiers": rep.get("tiers"),
+            }
+            return tokens / max(dt, 1e-9)
+        finally:
+            close = getattr(serving, "close", None)
+            if close is not None:
+                close()
+            del serving
+            gc.collect()
+
+    # ---- Pareto frontier ---------------------------------------------------
+    def pareto_points(self) -> List[Dict[str, Any]]:
+        """Measured points not dominated on (tokens/s up, HBM bytes
+        down), sorted by ascending HBM footprint."""
+        pts = []
+        for r in self.records:
+            if r.metric_val is None:
+                continue
+            aux = self._aux.get(r.name, {})
+            pts.append({"name": r.name, "config": r.config,
+                        "tokens_per_s": float(r.metric_val),
+                        "hbm_bytes": int(aux.get("hbm_bytes", 0))})
+        frontier = [p for p in pts if not any(
+            q["tokens_per_s"] >= p["tokens_per_s"]
+            and q["hbm_bytes"] <= p["hbm_bytes"]
+            and (q["tokens_per_s"] > p["tokens_per_s"]
+                 or q["hbm_bytes"] < p["hbm_bytes"])
+            for q in pts)]
+        frontier.sort(key=lambda p: (p["hbm_bytes"], -p["tokens_per_s"]))
+        return frontier
+
+    def tuned_config_doc(self) -> Dict[str, Any]:
+        frontier = self.pareto_points()
+        best = max(frontier, key=lambda p: p["tokens_per_s"]) \
+            if frontier else None
+        return {
+            "schema": TUNED_SCHEMA,
+            "metric": self.metric,
+            "best": best,
+            "pareto": frontier,
+            "records": [r.as_record() for r in self.records],
+        }
+
+    def write_tuned_config(self, path: str) -> Dict[str, Any]:
+        doc = self.tuned_config_doc()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+        logger.info(f"serving tuner: wrote {len(doc['pareto'])} Pareto "
+                    f"point(s) to {path}")
+        return doc
+
+    # ---- main loop ---------------------------------------------------------
+    def tune(self, space: Optional[ServingTuningSpace] = None):
+        space = space or ServingTuningSpace()
+        best = super().tune(space)
+        self.write_tuned_config(
+            os.path.join(self.results_dir, "tuned_config.json"))
+        return best
+
+
+def tune_serving_capacity(base_engine, *, n_requests: int = 4,
+                          prompt_len: int = 16, max_new_tokens: int = 8,
+                          space: Optional[ServingTuningSpace] = None,
+                          out: Optional[str] = None, seed: int = 0,
+                          **tuner_kw) -> Dict[str, Any]:
+    """One-call tune over a base ``InferenceEngine``: paged serving
+    engines built per config (tiered when the config carries a
+    ``tier_dram_bytes`` budget; speculative engines run the per-token
+    loop like the production spec config), a fixed mixed-length
+    workload, ``dstpu-tuned-v1`` JSON returned (and written to ``out``).
+    """
+    from ..serving import ServingEngine
+
+    vocab = base_engine.module.cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(min(4, prompt_len), prompt_len + 1, n_requests)
+    lens[0] = prompt_len
+    prompts = [rng.integers(0, vocab, (int(n),)).astype(np.int32)
+               for n in lens]
+
+    def engine_factory(cfg):
+        kw = dict(engine=base_engine, max_batch=n_requests,
+                  max_prompt_len=prompt_len, max_queue=n_requests,
+                  paged=True,
+                  kv_block_size=int(cfg.get("kv_block_size", 16)),
+                  decode_chunk=int(cfg.get("decode_chunk", 8)),
+                  prefill_chunk=int(cfg.get("prefill_chunk", 16)))
+        if cfg.get("spec_k"):
+            kw.update(speculative=True, spec_k=int(cfg["spec_k"]),
+                      decode_chunk=1)
+        if cfg.get("tier_dram_bytes") is not None:
+            kw.update(tiered_kv=True,
+                      tier_dram_bytes=int(cfg["tier_dram_bytes"]))
+        return ServingEngine(**kw)
+
+    def workload_factory(cfg):
+        return [p.copy() for p in prompts], max_new_tokens
+
+    tuner = ServingCapacityTuner(engine_factory, workload_factory,
+                                 seed=seed, **tuner_kw)
+    tuner.tune(space or ServingTuningSpace())
+    if out is not None:
+        return tuner.write_tuned_config(out)
+    return tuner.tuned_config_doc()

@@ -15,6 +15,7 @@ from .scheduler import (ContinuousBatchScheduler, Request,  # noqa: F401
 from .metrics import (Reservoir, ServingMetrics,  # noqa: F401
                       csv_monitor_master)
 from .engine import MigrationError, ServingEngine  # noqa: F401
+from .kv_tiers import KVTierManager  # noqa: F401
 from .fleet import (ElasticConfig, ElasticController,  # noqa: F401
                     FleetReplica, FleetRouter, RemoteReplica,
                     ReplicaServer)

@@ -44,6 +44,7 @@ rivals the model's step time.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
@@ -142,6 +143,38 @@ class _InflightChunk:
     launch_t: float = 0.0
 
 
+def _load_tuned_config(tuned_config) -> Dict[str, Any]:
+    """Normalize a ``tuned_config=`` argument into a flat knob dict.
+
+    Accepts the serving capacity tuner's Pareto JSON document (a path
+    or the loaded dict — the best point's config is used), a bare
+    ``{"config": {...}}`` point, or a flat knob dict. ``block_size``
+    (the tuner's axis name) aliases ``kv_block_size``."""
+    import json
+    doc = tuned_config
+    if isinstance(doc, (str, os.PathLike)):
+        with open(doc) as f:
+            doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"tuned_config must be a dict or a JSON path, "
+                         f"got {type(tuned_config).__name__}")
+    schema = doc.get("schema")
+    if schema is not None and schema != "dstpu-tuned-v1":
+        raise ValueError(f"unsupported tuned_config schema {schema!r} "
+                         f"(want dstpu-tuned-v1)")
+    if "best" in doc:
+        doc = doc["best"]
+    elif "pareto" in doc:
+        pts = doc["pareto"]
+        if not pts:
+            raise ValueError("tuned_config has an empty Pareto frontier")
+        doc = max(pts, key=lambda p: p.get("tokens_per_s", 0.0))
+    cfg = dict(doc.get("config", doc))
+    if "block_size" in cfg and "kv_block_size" not in cfg:
+        cfg["kv_block_size"] = cfg.pop("block_size")
+    return cfg
+
+
 class ServingEngine:
     """Continuous-batching server over a decoder LM.
 
@@ -190,9 +223,36 @@ class ServingEngine:
                  prefill_chunk: int = 16,
                  chunk_token_budget: Optional[int] = None,
                  sp_prefill_threshold: Optional[int] = None,
+                 tiered_kv: bool = False,
+                 tier_dram_bytes: int = 256 << 20,
+                 tier_nvme_bytes: Optional[int] = None,
+                 tier_spill_dir: Optional[str] = None,
+                 tuned_config=None,
                  **inference_kwargs):
         import jax
         import jax.numpy as jnp
+
+        # ---- autotuned defaults (autotuning/serving_tuner.py) ----
+        # A Pareto-frontier JSON (path or dict) supplies tuned values
+        # for the capacity knobs; an explicitly passed non-default
+        # argument always wins over the tuned value.
+        self.tuned_config = None
+        if tuned_config is not None:
+            tuned = _load_tuned_config(tuned_config)
+            self.tuned_config = tuned
+            _sig = {"decode_chunk": 8, "spec_k": 4, "kv_block_size": 16,
+                    "prefill_chunk": 16, "tier_dram_bytes": 256 << 20}
+            ns = locals()
+            # a null tuned value means "axis off" (e.g. the untiered
+            # Pareto corner's tier_dram_bytes) — keep the default
+            picked = {k: tuned[k] for k in _sig
+                      if tuned.get(k) is not None and ns[k] == _sig[k]}
+            decode_chunk = picked.get("decode_chunk", decode_chunk)
+            spec_k = picked.get("spec_k", spec_k)
+            kv_block_size = picked.get("kv_block_size", kv_block_size)
+            prefill_chunk = picked.get("prefill_chunk", prefill_chunk)
+            tier_dram_bytes = picked.get("tier_dram_bytes",
+                                         tier_dram_bytes)
 
         if engine is None:
             from ..inference.engine import InferenceEngine
@@ -315,6 +375,27 @@ class ServingEngine:
         else:
             self.kv = SlotKVCacheManager(self.module, engine.params,
                                          self.max_batch)
+
+        # ---- tiered KV (serving/kv_tiers.py) ----
+        # Demote cold prefix entries HBM -> host DRAM -> NVMe instead of
+        # evicting; promote back asynchronously on a later hit.
+        self.kv_tier = None
+        if tiered_kv:
+            if not self.paged:
+                raise ValueError(
+                    "tiered_kv requires paged=True (demotion is "
+                    "block-granular behind the paged allocator)")
+            if not self.kv.prefix_enabled:
+                raise ValueError(
+                    "tiered_kv needs the prefix cache (prefix_cache="
+                    "True and temperature=0): demotion operates on "
+                    "prefix-cache entries")
+            from .kv_tiers import KVTierManager
+            self.kv_tier = KVTierManager(
+                dram_bytes=int(tier_dram_bytes),
+                nvme_bytes=tier_nvme_bytes,
+                spill_dir=tier_spill_dir)
+            self.kv.attach_tier(self.kv_tier)
 
         # ---- mesh placement: tp-sharded KV + disaggregated prefill ----
         # Which params each program family sees. Default: the inference
@@ -1254,6 +1335,8 @@ class ServingEngine:
         BEFORE miss inserts — dispatch order is the device write order,
         so a fork's COW source is copied before anything could recycle
         its block."""
+        if self.kv_tier is not None:
+            self._install_promotions()
         if self.fused_prefill:
             # chunk-budget fill policy: running lanes drain the per-step
             # token budget (a prompt chunk for prefilling lanes, one
@@ -1392,10 +1475,51 @@ class ServingEngine:
         self._admit_patches[slot] = patch
         self._deact_slots.discard(slot)
 
+    def _install_promotions(self) -> None:
+        """Drain completed async promotions (KVTierManager's worker ran
+        the NVMe read / decode off-thread) and scatter them back into
+        the HBM pool — the ONLY place tier payloads touch the device, so
+        the pool stays engine-thread-owned. Everything that drained
+        ready in this pass installs through ONE batched scatter
+        (``readmit_prefix_many`` — eager-op dispatch dominates, so k
+        promotions cost one entry's dispatch). A promotion the pool
+        cannot take right now goes back to the tier and retries at a
+        later, less-pressured pump; nothing blocks the chunk launch."""
+        ready = self.kv_tier.drain_ready()
+        if not ready:
+            return
+        with telemetry.span("serve/tier_promote_install",
+                            n=len(ready)):
+            installed, rejected = self.kv.readmit_prefix_many(ready)
+        for _ in installed:
+            telemetry.count("serve/tier_promote")
+        for key, prompt_len, first_token, leaves in rejected:
+            self.kv_tier.abandon_ready(
+                key, (prompt_len, first_token, leaves))
+
     def _gauge_block_pool(self) -> None:
         blocks = self.kv.allocator.blocks
         telemetry.gauge("serve/block_pool_used", float(blocks.n_used))
         telemetry.gauge("serve/block_pool_free", float(blocks.n_free))
+        tier = self.kv_tier
+        if tier is not None:
+            rep = tier.report()
+            telemetry.gauge("serve/tier_dram_bytes",
+                            float(rep["dram_bytes"]))
+            telemetry.gauge("serve/tier_nvme_bytes",
+                            float(rep["nvme_bytes"]))
+            telemetry.gauge("serve/tier_dram_entries",
+                            float(rep["dram_entries"]))
+            telemetry.gauge("serve/tier_nvme_entries",
+                            float(rep["nvme_entries"]))
+            telemetry.gauge("serve/tier_demotions",
+                            float(rep["demotions_dram"]
+                                  + rep["demotions_nvme"]))
+            telemetry.gauge("serve/tier_promotions",
+                            float(rep["promotions_dram"]
+                                  + rep["promotions_nvme"]))
+            telemetry.gauge("serve/tier_promote_wait_p50_s",
+                            float(rep["promote_wait_p50_s"]))
 
     def _prefill_admit(self, admitted: List[Request],
                        plans: Optional[Dict[int, Any]] = None) -> None:
@@ -1966,3 +2090,10 @@ class ServingEngine:
         external driver (the serving frontend) runs incrementally."""
         while self.scheduler.has_work() or self._pending is not None:
             self.pump()
+
+    def close(self) -> None:
+        """Release host-side serving resources: the KV tier's promotion
+        worker and its NVMe spill files. Idempotent; engines without a
+        tier have nothing to release."""
+        if self.kv_tier is not None:
+            self.kv_tier.close()

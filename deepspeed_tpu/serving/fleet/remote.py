@@ -548,6 +548,22 @@ class RemoteReplica:
             f"/v1/prefix?key={key.hex()}",
             default={"holds": False}).get("holds", False))
 
+    def fetch_prefix(self, key: bytes) -> Optional[Dict[str, Any]]:
+        """``GET /v1/prefix?fetch=1`` — pull the remote's demoted prefix
+        payload (decoded ``dstpu-prefix-v1`` bundle), or None when the
+        remote holds nothing fetchable."""
+        payload = self._get_json(
+            f"/v1/prefix?key={key.hex()}&fetch=1",
+            default={"bundle": None}).get("bundle")
+        return None if payload is None else decode_bundle(payload)
+
+    def install_prefix(self, bundle: Dict[str, Any]) -> bool:
+        """``POST /v1/prefix`` — install a fetched prefix bundle into
+        the remote's DRAM tier."""
+        return bool(self._post_json(
+            "/v1/prefix",
+            {"bundle": encode_bundle(bundle)}).get("ok", False))
+
     def load_snapshot(self) -> Dict[str, Any]:
         """``GET /v1/load`` — the same ``dstpu-load-v1`` dict the
         in-process frontend returns. Unreachable remotes degrade to an

@@ -157,6 +157,7 @@ class FleetRouter:
         self.replica_factory = replica_factory
         self.n_routed = 0
         self.n_affinity_hits = 0
+        self.n_tier_fetches = 0
         self.n_rerouted = 0
         self.n_replayed = 0
         self.n_reroute_failed = 0
@@ -275,21 +276,68 @@ class FleetRouter:
             candidates = [r for r in self.replicas if r.alive]
         if not candidates:
             return self.replicas[0], decision
-        if self.affinity and len(candidates) > 1:
+        if self.affinity:
             key = PrefixCache.key_for(prompt)
-            hits = [r for r in candidates if self._holds_prefix(r, key)]
+            hits = [r for r in candidates if self._holds_prefix(r, key)] \
+                if len(candidates) > 1 else []
             if hits:
                 telemetry.count("fleet/affinity_hits")
                 with self._lock:
                     self.n_affinity_hits += 1
                 candidates = hits
                 decision["affinity_hit"] = True
+            else:
+                # tier-fetch fallback: no PLACEABLE replica holds the
+                # prefix, but an unroutable one (draining, or load-
+                # filtered out) may still hold it in its DRAM/NVMe tier
+                # — the chosen replica pulls the bundle over
+                # ``/v1/prefix?fetch=1`` before the submit, so the
+                # request admits warm instead of re-prefilling
+                cand_rids = {r.rid for r in candidates}
+                holder = next(
+                    (r for r in self.replicas
+                     if r.alive and r.rid not in cand_rids
+                     and self._holds_prefix(r, key)), None)
+                if holder is not None:
+                    decision["candidates"] = [r.rid for r in candidates]
+                    if len(candidates) > 1:
+                        scores = {r.rid: self._load_score(r)
+                                  for r in candidates}
+                        decision["scores"] = scores
+                        target = min(candidates,
+                                     key=lambda r: scores[r.rid])
+                    else:
+                        target = candidates[0]
+                    if self._tier_fetch(holder, target, key):
+                        decision["tier_fetch"] = holder.rid
+                        telemetry.count("fleet/tier_fetches")
+                        with self._lock:
+                            self.n_tier_fetches += 1
+                    return target, decision
         decision["candidates"] = [r.rid for r in candidates]
         if len(candidates) == 1:
             return candidates[0], decision
         scores = {r.rid: self._load_score(r) for r in candidates}
         decision["scores"] = scores
         return min(candidates, key=lambda r: scores[r.rid]), decision
+
+    @staticmethod
+    def _tier_fetch(holder: FleetReplica, target: FleetReplica,
+                    key: bytes) -> bool:
+        """Pull ``key``'s demoted prefix from ``holder`` and install it
+        into ``target``'s DRAM tier. Best-effort: any failure just means
+        the request prefills normally on ``target``."""
+        try:
+            fetch = getattr(holder.frontend, "fetch_prefix", None)
+            install = getattr(target.frontend, "install_prefix", None)
+            if fetch is None or install is None:
+                return False
+            bundle = fetch(key)
+            if bundle is None:
+                return False
+            return bool(install(bundle))
+        except Exception:  # noqa: BLE001 — fetch is an optimization
+            return False
 
     @staticmethod
     def _holds_prefix(replica: FleetReplica, key: bytes) -> bool:
@@ -655,6 +703,7 @@ class FleetRouter:
                 "retired": sum(1 for r in self.replicas if r.retired),
                 "routed": self.n_routed,
                 "affinity_hits": self.n_affinity_hits,
+                "tier_fetches": self.n_tier_fetches,
                 "rerouted": self.n_rerouted,
                 "replayed": self.n_replayed,
                 "reroute_failed": self.n_reroute_failed,

@@ -224,9 +224,26 @@ class _FleetHandler(BaseHTTPRequestHandler):
             elif url.path == "/v1/load":
                 self._send_json(200, fe.load_snapshot())
             elif url.path == "/v1/prefix":
-                key = parse_qs(url.query).get("key", [""])[0]
+                qs = parse_qs(url.query)
+                key = qs.get("key", [""])[0]
+                fetch = qs.get("fetch", ["0"])[0] not in ("", "0")
                 holds = bool(key) and fe.holds_prefix(bytes.fromhex(key))
-                self._send_json(200, {"holds": bool(holds)})
+                if not fetch:
+                    self._send_json(200, {"holds": bool(holds)})
+                else:
+                    # bundle-payload mode (?fetch=1): serve the demoted
+                    # prefix itself — tier entries only (host-side; the
+                    # device pool belongs to the engine thread), encoded
+                    # with the same codec a migrated block rides
+                    bundle = fe.fetch_prefix(bytes.fromhex(key)) \
+                        if key else None
+                    if bundle is None:
+                        self._send_json(200, {"holds": bool(holds),
+                                              "bundle": None})
+                    else:
+                        self._send_json(200, {
+                            "holds": True,
+                            "bundle": encode_bundle(bundle)})
             elif url.path == "/v1/migratable":
                 self._send_json(200, {"uids": fe.migration_candidates()})
             elif url.path == "/v1/stats":
@@ -256,6 +273,13 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 self._do_migrate_out(body)
             elif url.path == "/v1/migrate_in":
                 self._do_migrate_in(body)
+            elif url.path == "/v1/prefix":
+                # install a peer-fetched prefix bundle into the local
+                # DRAM tier (no device access — it promotes through the
+                # normal async path when a request for it arrives)
+                ok = self.rs.frontend.install_prefix(
+                    decode_bundle(body["bundle"]))
+                self._send_json(200, {"ok": bool(ok)})
             else:
                 self._send_json(404, {"error": "not found"})
         except (BrokenPipeError, ConnectionResetError):

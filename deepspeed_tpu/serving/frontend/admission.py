@@ -171,6 +171,13 @@ class AdmissionConfig:
     shed_memory_infeasible: bool = False
     slot_tokens: Optional[int] = None
     fused_prefill_chunk: Optional[int] = None
+    # tiered KV (serving/kv_tiers.py): DRAM+NVMe tier capacity in KV
+    # tokens, counted toward feasibility at ``tier_discount`` — demoted
+    # blocks re-admit via promotion, so the HBM wall is no longer the
+    # shed boundary. Wired from the engine's tier by the frontend when
+    # left None; 0/None keeps the pure-HBM gate.
+    tier_tokens: Optional[int] = None
+    tier_discount: float = 0.5
 
     def cost_tokens(self, ticket: "Ticket") -> float:
         """Decode-token-equivalent cost of serving ``ticket`` under the
@@ -258,11 +265,15 @@ class AdmissionController:
                     self.clock() >= ticket.deadline_s:
                 from ..scheduler import REJECT_DEADLINE_EXPIRED
                 return REJECT_DEADLINE_EXPIRED
-            if cfg.shed_memory_infeasible and cfg.slot_tokens and \
-                    ticket.prompt_len + ticket.max_new_tokens > \
-                    cfg.slot_tokens:
-                self.n_memory_infeasible += 1
-                return REJECT_MEMORY_INFEASIBLE
+            if cfg.shed_memory_infeasible and cfg.slot_tokens:
+                cap = float(cfg.slot_tokens)
+                if cfg.tier_tokens:
+                    # tier-aware feasibility: lower-tier headroom counts
+                    # at a discount (promotion costs a round trip)
+                    cap += cfg.tier_discount * float(cfg.tier_tokens)
+                if ticket.prompt_len + ticket.max_new_tokens > cap:
+                    self.n_memory_infeasible += 1
+                    return REJECT_MEMORY_INFEASIBLE
             if self._pending >= cfg.max_pending:
                 return REJECT_FRONTEND_QUEUE_FULL
             bucket = self._bucket_for(ticket.tenant)

@@ -239,8 +239,27 @@ class ServingFrontend:
         cfg = self._controller.config
         if cfg.shed_memory_infeasible and cfg.slot_tokens is None:
             # memory-aware shedding sized from the engine's own arena:
-            # one slot row holds at most max_seq_len KV positions
+            # one slot row holds at most max_seq_len KV positions (for a
+            # paged pool SMALLER than one full row per slot, the pool
+            # itself is the tighter wall)
             cfg.slot_tokens = engine.max_seq_len
+            pool_cap = getattr(getattr(engine, "kv", None), "allocator",
+                               None)
+            pool_cap = getattr(pool_cap, "pool_capacity_tokens", None)
+            if pool_cap is not None:
+                cfg.slot_tokens = min(cfg.slot_tokens, int(pool_cap))
+        if cfg.shed_memory_infeasible and cfg.tier_tokens is None and \
+                getattr(engine, "kv_tier", None) is not None:
+            # tiered KV: DRAM+NVMe capacity counts toward feasibility at
+            # a discounted rate (tier_discount) instead of rejecting at
+            # the HBM wall — demoted blocks re-admit via promotion
+            rep = engine.kv.arena_report()
+            bpt = max(int(rep.get("bytes_per_token", 0)), 1)
+            tier = engine.kv_tier
+            tier_bytes = int(tier.dram_capacity)
+            if tier.nvme_capacity is not None:
+                tier_bytes += int(tier.nvme_capacity)
+            cfg.tier_tokens = tier_bytes // bpt
         if cfg.fused_prefill_chunk is None and \
                 getattr(engine, "fused_prefill", False):
             # fused chunked prefill: prompts ride the decode scan at
@@ -502,13 +521,44 @@ class ServingFrontend:
     def holds_prefix(self, key: bytes) -> bool:
         """Pure prefix-cache membership peek (no LRU touch) — the
         router's placement affinity probe, and the surface the
-        transport's ``GET /v1/prefix`` serves. False on engines without
-        a prefix cache."""
+        transport's ``GET /v1/prefix`` serves. Covers BOTH residency
+        levels: the HBM prefix cache and the demoted DRAM/NVMe tier
+        (a tier-held prefix still saves the full prefill — it admits
+        through an async promotion instead of a recompute). False on
+        engines without a prefix cache."""
         kv = getattr(self._engine, "kv", None)
         cache = getattr(kv, "prefix_cache", None)
         if cache is None or not getattr(kv, "prefix_enabled", False):
             return False
-        return key in cache
+        if key in cache:
+            return True
+        tier = getattr(self._engine, "kv_tier", None)
+        return tier is not None and tier.holds(key)
+
+    def fetch_prefix(self, key: bytes) -> Optional[Dict[str, Any]]:
+        """Serve a peer's prefix fetch: the demoted entry's KV payload
+        as a ``dstpu-prefix-v1`` bundle, or None when this replica does
+        not hold it in a FETCHABLE tier. Tier entries only — the HBM
+        prefix cache lives in the device pool, which only the engine
+        thread may read; a warm prefix becomes fetchable once it
+        demotes. Thread-safe (the tier is host-side, lock-protected),
+        no driver round-trip."""
+        tier = getattr(self._engine, "kv_tier", None)
+        if tier is None:
+            return None
+        return tier.fetch_bundle(key)
+
+    def install_prefix(self, bundle: Dict[str, Any]) -> bool:
+        """Install a peer-fetched prefix bundle into the local DRAM
+        tier (the receiving half of the distributed prefix cache). The
+        entry promotes to HBM through the normal async path when a
+        request for its prompt arrives — zero re-prefill. Thread-safe;
+        False on engines without a tier or when the tier declined
+        (duplicate key / closed)."""
+        tier = getattr(self._engine, "kv_tier", None)
+        if tier is None:
+            return False
+        return tier.install_bundle(bundle)
 
     def migration_candidates(self) -> List[int]:
         """uids of requests movable RIGHT NOW (running, fully

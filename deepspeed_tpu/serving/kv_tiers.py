@@ -1,0 +1,440 @@
+"""Tiered KV cache: HBM block pool → pinned host DRAM → NVMe spill.
+
+The paged allocator treats HBM as a hard wall: ``_ensure_free`` EVICTS
+cold prefix-cache entries (serving/paged_kv.py) and their KV is gone — a
+repeated prompt re-prefills from scratch. This module is the DeepSpeed
+swap_tensor / ZeRO-Infinity NVMe-tier design reborn behind the paged
+allocator: eviction becomes DEMOTION. A cold prefix entry's blocks are
+gathered off-device into host DRAM; when the DRAM tier overflows its
+watermark, the coldest entries spill to NVMe files through the
+``ops/aio.py`` heritage path (``AsyncIOHandle`` — the ``csrc/aio``
+analogue). A later request for the same prompt PROMOTES the entry back:
+the fetch + decode runs on a background worker thread, overlapped
+against the engine's double-buffered chunk launches, and the engine
+installs completed promotions at its next admission pass — re-admission
+never blocks the decode scan.
+
+Serialization is PR 15's migration codec
+(:func:`~deepspeed_tpu.serving.fleet.transport.encode_bundle`): a
+demoted block and a migrated block are the same bytes. That makes the
+DRAM tier double as a *distributed* prefix cache — a peer replica
+fetches a neighbor's demoted prefix over ``GET /v1/prefix?fetch=1``
+(:meth:`KVTierManager.fetch_bundle` / :meth:`install_bundle`) instead
+of re-prefilling.
+
+Thread model (the invariants the race tests pin down):
+  * the DEVICE pool is touched only by the engine thread — demotion
+    gathers happen inside the prefix cache's eviction hook (engine
+    thread), promotion scatters happen in ``ServingEngine._admit``'s
+    drain of :meth:`drain_ready` (engine thread);
+  * everything in this module is host-side numpy behind one lock —
+    transport threads may probe/fetch/install concurrently with the
+    worker and the engine;
+  * a promotion in flight keeps the entry OUT of the tier maps (no
+    double-promote) but :meth:`holds` still answers True so the
+    allocator keeps deferring the request until the payload lands.
+
+Host-only: imports no JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.aio import AsyncIOHandle
+
+# schema tag stamped on every report() / wire bundle this module emits,
+# versioned like dstpu-tenants/dstpu-migrate so readers can gate on shape
+TIERS_SCHEMA = "dstpu-tiers-v1"
+PREFIX_FETCH_SCHEMA = "dstpu-prefix-v1"
+
+_spill_seq = itertools.count()
+
+
+@dataclasses.dataclass
+class _DramEntry:
+    prompt_len: int
+    first_token: int
+    leaves: Dict[str, np.ndarray]    # normalized leaf key -> blocks array
+    nbytes: int
+
+
+@dataclasses.dataclass
+class _NvmeEntry:
+    prompt_len: int
+    first_token: int
+    path: str
+    # per-leaf (key, dtype, shape, nbytes) in file order — the file is
+    # the concatenated raw bytes; dtype objects (not strings) so
+    # ml_dtypes kinds like bfloat16 round-trip exactly
+    meta: List[Tuple[str, Any, Tuple[int, ...], int]]
+    nbytes: int
+
+
+def _leaves_nbytes(leaves: Dict[str, np.ndarray]) -> int:
+    return sum(int(a.nbytes) for a in leaves.values())
+
+
+class KVTierManager:
+    """Host-side demotion/promotion ladder for prefix-cache entries.
+
+    ``dram_bytes`` is the DRAM tier's high watermark: admissions past it
+    spill the coldest entries to NVMe. ``nvme_bytes`` caps the spill
+    tier; past it the coldest spill files are dropped (the data is then
+    gone — the request re-prefills, exactly the pre-tier behavior, so
+    the ladder degrades to the old eviction semantics under unbounded
+    pressure). ``spill_dir`` defaults to a private tempdir removed by
+    :meth:`close`."""
+
+    def __init__(self, *, dram_bytes: int = 256 << 20,
+                 nvme_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 aio: Optional[AsyncIOHandle] = None):
+        if dram_bytes < 0:
+            raise ValueError(f"dram_bytes must be >= 0, got {dram_bytes}")
+        self.dram_capacity = int(dram_bytes)
+        self.nvme_capacity = None if nvme_bytes is None else int(nvme_bytes)
+        self._own_spill_dir = spill_dir is None
+        self._spill_dir = spill_dir
+        self._aio = aio if aio is not None else AsyncIOHandle()
+        self._lock = threading.RLock()
+        self._dram: "OrderedDict[bytes, _DramEntry]" = OrderedDict()
+        self._nvme: "OrderedDict[bytes, _NvmeEntry]" = OrderedDict()
+        self._inflight: Dict[bytes, float] = {}   # key -> request clock
+        self._ready: "OrderedDict[bytes, _DramEntry]" = OrderedDict()
+        self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._closed = False
+        # counters (report() exports; engine mirrors as serve/tier_*)
+        self.demotions_dram = 0      # HBM -> DRAM admits
+        self.demotions_nvme = 0      # DRAM -> NVMe spills
+        self.promotions_dram = 0     # DRAM -> HBM completions
+        self.promotions_nvme = 0     # NVMe -> HBM completions
+        self.dropped = 0             # capacity drops (data lost)
+        self.promote_failures = 0
+        self.peer_fetches = 0        # bundles served to peers
+        self.peer_installs = 0       # bundles installed from peers
+        self._promote_wait_s: deque = deque(maxlen=512)
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="kv-tier-promote", daemon=True)
+        self._worker.start()
+
+    # ---------------------------------------------------------- demotion
+    def admit(self, key: bytes, prompt_len: int, first_token: int,
+              leaves: Dict[str, np.ndarray]) -> bool:
+        """Admit a demoted prefix entry into the DRAM tier (called from
+        the prefix cache's eviction hook — engine thread — or from
+        :meth:`install_bundle` — transport thread). Overflow cascades:
+        coldest DRAM entries spill to NVMe, coldest NVMe entries drop."""
+        with self._lock:
+            if self._closed:
+                return False
+            if (key in self._dram or key in self._nvme
+                    or key in self._inflight or key in self._ready):
+                return False                 # already tiered somewhere
+            leaves = {k: np.ascontiguousarray(a)
+                      for k, a in leaves.items()}
+            entry = _DramEntry(int(prompt_len), int(first_token), leaves,
+                               _leaves_nbytes(leaves))
+            if entry.nbytes > self.dram_capacity:
+                # an entry no empty DRAM tier could hold goes straight
+                # to NVMe (or drops if that is also too small)
+                if not self._spill(key, entry):
+                    self.dropped += 1
+                    return False
+                self.demotions_dram += 1
+                self._enforce_watermarks()
+                return True
+            self._dram[key] = entry
+            self.demotions_dram += 1
+            self._enforce_watermarks()
+            return True
+
+    def _enforce_watermarks(self) -> None:
+        while self.dram_bytes > self.dram_capacity and self._dram:
+            key, entry = self._dram.popitem(last=False)
+            if not self._spill(key, entry):
+                self.dropped += 1
+        while (self.nvme_capacity is not None
+               and self.nvme_bytes > self.nvme_capacity and self._nvme):
+            key, spilled = self._nvme.popitem(last=False)
+            self._unlink(spilled.path)
+            self.dropped += 1
+
+    def _spill(self, key: bytes, entry: _DramEntry) -> bool:
+        """DRAM -> NVMe: one spill file per entry, the leaves' raw bytes
+        concatenated in sorted-key order, written through the aio
+        handle. Caller holds the lock."""
+        if self.nvme_capacity is not None \
+                and entry.nbytes > self.nvme_capacity:
+            return False
+        path = os.path.join(self.spill_dir,
+                            f"prefix-{next(_spill_seq):08d}.kv")
+        meta: List[Tuple[str, Any, Tuple[int, ...], int]] = []
+        offset = 0
+        try:
+            for name in sorted(entry.leaves):
+                a = entry.leaves[name]
+                flat = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+                self._aio.async_pwrite(flat, path, offset)
+                meta.append((name, a.dtype, tuple(a.shape), int(a.nbytes)))
+                offset += int(a.nbytes)
+            self._aio.wait()
+        except OSError:
+            self._unlink(path)
+            return False
+        self._nvme[key] = _NvmeEntry(entry.prompt_len, entry.first_token,
+                                     path, meta, entry.nbytes)
+        self.demotions_nvme += 1
+        return True
+
+    def _unspill(self, spilled: _NvmeEntry) -> _DramEntry:
+        """NVMe -> host numpy (worker thread; no lock needed — the entry
+        was already removed from the maps by the caller)."""
+        leaves: Dict[str, np.ndarray] = {}
+        offset = 0
+        for name, dtype, shape, nbytes in spilled.meta:
+            buf = np.empty(nbytes, np.uint8)
+            self._aio.async_pread(buf, spilled.path, offset)
+            self._aio.wait()
+            leaves[name] = buf.view(dtype).reshape(shape)
+            offset += nbytes
+        return _DramEntry(spilled.prompt_len, spilled.first_token, leaves,
+                          spilled.nbytes)
+
+    def _unlink(self, path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    # --------------------------------------------------------- promotion
+    def holds(self, key: bytes) -> bool:
+        """Membership across every tier INCLUDING promotions in flight /
+        ready — the allocator defers a request while this is True, so an
+        entry mid-promotion must keep answering."""
+        with self._lock:
+            return (key in self._dram or key in self._nvme
+                    or key in self._inflight or key in self._ready)
+
+    def request_promotion(self, key: bytes) -> bool:
+        """Queue an async promotion (engine thread; returns immediately).
+        The worker moves the payload to host numpy; the engine drains
+        completions via :meth:`drain_ready` at its next admission pass."""
+        with self._lock:
+            if self._closed or key in self._inflight or key in self._ready:
+                return False
+            if key not in self._dram and key not in self._nvme:
+                return False
+            self._inflight[key] = time.monotonic()
+        self._queue.put(key)
+        return True
+
+    def drain_ready(self) -> List[Tuple[bytes, int, int,
+                                        Dict[str, np.ndarray]]]:
+        """Pop every completed promotion: ``[(key, prompt_len,
+        first_token, leaves), ...]``. Engine thread only — the caller
+        scatters the leaves back into the device pool and republishes
+        the prefix-cache entry."""
+        out = []
+        with self._lock:
+            while self._ready:
+                key, entry = self._ready.popitem(last=False)
+                out.append((key, entry.prompt_len, entry.first_token,
+                            entry.leaves))
+        return out
+
+    def _worker_loop(self) -> None:
+        while True:
+            key = self._queue.get()
+            if key is None:
+                return
+            try:
+                self._promote_one(key)
+            except Exception:
+                # a failed promotion must not wedge the allocator's
+                # deferral loop: drop every trace of the key so holds()
+                # flips False and the request re-prefills as a miss
+                with self._lock:
+                    self._inflight.pop(key, None)
+                    self._dram.pop(key, None)
+                    spilled = self._nvme.pop(key, None)
+                    if spilled is not None:
+                        self._unlink(spilled.path)
+                    self.promote_failures += 1
+
+    def _promote_one(self, key: bytes) -> None:
+        with self._lock:
+            t0 = self._inflight.get(key)
+            entry = self._dram.pop(key, None)
+            spilled = None if entry is not None \
+                else self._nvme.pop(key, None)
+        if entry is None and spilled is None:
+            with self._lock:
+                self._inflight.pop(key, None)
+            return
+        from_nvme = entry is None
+        if from_nvme:
+            entry = self._unspill(spilled)
+            self._unlink(spilled.path)
+        with self._lock:
+            self._ready[key] = entry
+            self._inflight.pop(key, None)
+            if from_nvme:
+                self.promotions_nvme += 1
+            else:
+                self.promotions_dram += 1
+            if t0 is not None:
+                self._promote_wait_s.append(time.monotonic() - t0)
+
+    def abandon_ready(self, key: bytes, entry_fields: Tuple[int, int,
+                      Dict[str, np.ndarray]]) -> None:
+        """Return a drained promotion the engine could NOT install (the
+        pool had no free blocks): the payload goes back to the DRAM tier
+        so a later, less-pressured pump can retry — nothing is lost."""
+        prompt_len, first_token, leaves = entry_fields
+        self.admit(key, prompt_len, first_token, leaves)
+
+    # ------------------------------------------------------- fleet fetch
+    def fetch_bundle(self, key: bytes) -> Optional[Dict[str, Any]]:
+        """Serve a peer's prefix fetch (transport thread): the entry's
+        payload in the migrate-bundle shape ``encode_bundle`` speaks.
+        Non-destructive — the local tier keeps its copy (the peer's
+        fetch must not evict the home replica's warm state)."""
+        with self._lock:
+            entry = self._dram.get(key)
+            if entry is not None:
+                self._dram.move_to_end(key)
+                leaves = dict(entry.leaves)
+                pl_, ft = entry.prompt_len, entry.first_token
+            else:
+                spilled = self._nvme.get(key)
+                if spilled is None:
+                    ready = self._ready.get(key)
+                    if ready is None:
+                        return None
+                    leaves = dict(ready.leaves)
+                    pl_, ft = ready.prompt_len, ready.first_token
+                else:
+                    entry = self._unspill(spilled)
+                    leaves = entry.leaves
+                    pl_, ft = entry.prompt_len, entry.first_token
+            self.peer_fetches += 1
+        return {"schema": PREFIX_FETCH_SCHEMA, "key": key.hex(),
+                "prompt_len": int(pl_), "first_token": int(ft),
+                "kv": leaves}
+
+    def install_bundle(self, bundle: Dict[str, Any]) -> bool:
+        """Install a peer-fetched prefix bundle into the DRAM tier
+        (transport thread; no device access — the entry promotes through
+        the normal async path when a request for it arrives)."""
+        if bundle.get("schema") != PREFIX_FETCH_SCHEMA:
+            raise ValueError(
+                f"unsupported prefix bundle schema {bundle.get('schema')!r}"
+                f" (want {PREFIX_FETCH_SCHEMA})")
+        key = bytes.fromhex(bundle["key"])
+        leaves = {k: np.asarray(v) for k, v in bundle["kv"].items()}
+        ok = self.admit(key, int(bundle["prompt_len"]),
+                        int(bundle["first_token"]), leaves)
+        if ok:
+            with self._lock:
+                self.peer_installs += 1
+        return ok
+
+    # --------------------------------------------------------- accounting
+    @property
+    def dram_bytes(self) -> int:
+        return sum(e.nbytes for e in self._dram.values()) \
+            + sum(e.nbytes for e in self._ready.values())
+
+    @property
+    def nvme_bytes(self) -> int:
+        return sum(e.nbytes for e in self._nvme.values())
+
+    @property
+    def spill_dir(self) -> str:
+        if self._spill_dir is None:
+            self._spill_dir = tempfile.mkdtemp(prefix="dstpu-kv-tier-")
+        return self._spill_dir
+
+    def spill_files(self) -> List[str]:
+        with self._lock:
+            return [e.path for e in self._nvme.values()]
+
+    def _promote_wait_pct(self, q: float) -> float:
+        with self._lock:
+            waits = sorted(self._promote_wait_s)
+        if not waits:
+            return 0.0
+        i = min(int(q * len(waits)), len(waits) - 1)
+        return waits[i]
+
+    def report(self) -> Dict[str, Any]:
+        """Per-tier accounting merged into ``arena_report()`` and
+        exported as ``serve/tier_*`` gauges — schema-versioned like the
+        dstpu-tenants blocks so dashboards can gate on shape."""
+        with self._lock:
+            return {
+                "schema": TIERS_SCHEMA,
+                "dram_entries": len(self._dram) + len(self._ready),
+                "dram_bytes": self.dram_bytes,
+                "dram_capacity_bytes": self.dram_capacity,
+                "nvme_entries": len(self._nvme),
+                "nvme_bytes": self.nvme_bytes,
+                "nvme_capacity_bytes": self.nvme_capacity,
+                "spill_files": len(self._nvme),
+                "inflight_promotions": len(self._inflight),
+                "demotions_dram": self.demotions_dram,
+                "demotions_nvme": self.demotions_nvme,
+                "promotions_dram": self.promotions_dram,
+                "promotions_nvme": self.promotions_nvme,
+                "promote_failures": self.promote_failures,
+                "dropped": self.dropped,
+                "peer_fetches": self.peer_fetches,
+                "peer_installs": self.peer_installs,
+                "promote_wait_p50_s": self._promote_wait_pct(0.50),
+                "promote_wait_p99_s": self._promote_wait_pct(0.99),
+            }
+
+    # ------------------------------------------------------------ closing
+    def close(self) -> None:
+        """Stop the worker and remove every spill file (and the private
+        spill dir). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._queue.put(None)
+        self._worker.join(timeout=5.0)
+        with self._lock:
+            for spilled in self._nvme.values():
+                self._unlink(spilled.path)
+            self._nvme.clear()
+            self._dram.clear()
+            self._ready.clear()
+            self._inflight.clear()
+        if self._own_spill_dir and self._spill_dir is not None:
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+            self._spill_dir = None
+
+    def __enter__(self) -> "KVTierManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

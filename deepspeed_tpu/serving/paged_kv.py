@@ -129,6 +129,10 @@ class PrefixCache:
         self.hits = 0            # successful hit-plan admissions
         self.misses = 0          # successful miss-plan admissions
         self.evictions = 0
+        # demotion hook (serving/kv_tiers.py): called with (key, entry)
+        # BEFORE the entry's block refs drop, while the blocks still
+        # hold their device payload — eviction becomes demotion
+        self.on_evict = None
 
     @staticmethod
     def key_for(prompt) -> bytes:
@@ -165,10 +169,26 @@ class PrefixCache:
         if not self._entries:
             return False
         key, entry = self._entries.popitem(last=False)
+        self._drop(key, entry, block_allocator)
+        return True
+
+    def demote(self, key: bytes, block_allocator: BlockAllocator) -> bool:
+        """Evict ONE entry by key through the demotion hook — the
+        explicit 'push this prefix down a tier' verb (tests, and the
+        fleet's make-fetchable path)."""
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        self._drop(key, entry, block_allocator)
+        return True
+
+    def _drop(self, key: bytes, entry: _PrefixEntry,
+              block_allocator: BlockAllocator) -> None:
+        if self.on_evict is not None:
+            self.on_evict(key, entry)
         for b in entry.blocks:
             block_allocator.decref(b)
         self.evictions += 1
-        return True
 
     def __contains__(self, key: bytes) -> bool:
         """Pure membership peek — no LRU reordering, no counter touch.
@@ -236,6 +256,9 @@ class PagedSlotAllocator:
         self.tables: List[List[int]] = [[] for _ in range(max_batch)]
         self.plans: Dict[int, PagedAdmitPlan] = {}
         self._pending: set = set()   # prompt keys mid-prefill (defer dups)
+        # KVTierManager when tiering is on (PagedKVCacheManager wires
+        # it): tier-held prompts defer admission while promoting
+        self.tier = None
         self.peak_active = 0
         self.cow_forks = 0
 
@@ -262,6 +285,15 @@ class PagedSlotAllocator:
             if key in self._pending:
                 return None
             entry = self.prefix.lookup(key)
+            if (entry is None and self.tier is not None
+                    and self.tier.holds(key)):
+                # tier hit: DEFER (the async promotion is overlapped
+                # against running chunks; the engine installs it at a
+                # later admission pass and this retry becomes a plain
+                # HBM hit) — same retry-next-pump contract as the
+                # duplicate-prompt deferral above
+                self.tier.request_promotion(key)
+                return None
         if entry is not None:
             return self._lease_hit(req, key, entry, n_total)
         return self._lease_miss(req, key, pl_, n_total)
@@ -490,6 +522,7 @@ class PagedKVCacheManager:
             prefix_cache=PrefixCache(prefix_cache_capacity),
             prefix_caching=prefix_caching)
         self.num_blocks = self.allocator.blocks.num_blocks
+        self.tier = None                     # KVTierManager (attach_tier)
         if slot_axis is None:
             slot_axis = 1 if getattr(cfg, "scan_layers", False) else 0
         self._slot_axis = slot_axis
@@ -685,22 +718,33 @@ class PagedKVCacheManager:
         shipped. One eager gather per leaf; migration is a rare
         host-paced op, so nothing here is jitted (no retrace-budget
         surface)."""
-        import jax
-        import jax.numpy as jnp
         table = self.allocator.tables[slot]
         if n_blocks is None:
             n_blocks = len(table)
-        idx = jnp.asarray(np.asarray(table[:n_blocks], np.int32))
-        out: Dict[str, Any] = {}
+        return self.export_block_ids(table[:n_blocks])
+
+    def export_block_ids(self, blocks) -> Dict[str, Any]:
+        """``export_blocks`` by explicit block-id list (position order)
+        instead of a slot's table — the tier-demotion gather reads a
+        prefix-cache entry's blocks, which belong to no slot. Same
+        eager no-jit rationale: demotion is host-paced, and the gather
+        is dispatched before the caller's decrefs can recycle the
+        blocks, so the payload is the pre-overwrite bytes."""
+        import jax
+        import jax.numpy as jnp
+        idx = jnp.asarray(np.asarray(list(blocks), np.int32))
+        gathered: Dict[str, Any] = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(
                 self.cache)[0]:
             ks = jax.tree_util.keystr(path)
             if "cache_index" in ks or "block_tables" in ks:
                 continue
             lead = leaf.ndim - 3
-            out[_norm_key(ks)] = np.asarray(
-                jnp.take(leaf, idx, axis=lead))
-        return out
+            gathered[_norm_key(ks)] = jnp.take(leaf, idx, axis=lead)
+        # one transfer for the whole tree — per-leaf np.asarray would
+        # block on a device sync per leaf, which shows up directly in
+        # the demotion path's host time
+        return jax.device_get(gathered)
 
     def import_blocks(self, slot: int, leaves: Dict[str, Any]) -> None:
         """Scatter exported block payloads into ``slot``'s freshly
@@ -736,6 +780,101 @@ class PagedKVCacheManager:
 
     def update(self, new_cache: Any) -> None:
         self.cache = new_cache
+
+    # ------------------------------------------------------------ tiering
+    def attach_tier(self, tier) -> None:
+        """Wire a :class:`~deepspeed_tpu.serving.kv_tiers.KVTierManager`
+        behind the allocator: prefix-cache eviction becomes DEMOTION
+        (gather + DRAM admit), and tier-held prompts defer admission
+        while their async promotion runs."""
+        self.tier = tier
+        self.allocator.tier = tier
+        self.allocator.prefix.on_evict = self._demote_entry
+
+    def _demote_entry(self, key: bytes, entry) -> None:
+        """Eviction hook (engine thread — eviction fires inside
+        allocator calls the engine drives): gather the entry's blocks
+        off-device and admit them to the DRAM tier."""
+        if self.tier is None or key is None:
+            return
+        leaves = self.export_block_ids(entry.blocks)
+        self.tier.admit(key, entry.prompt_len, entry.first_token, leaves)
+
+    def demote_prefix(self, key: bytes) -> bool:
+        """Explicitly push one cached prefix down to the tier (tests and
+        the fleet's make-fetchable path). Engine thread only."""
+        return self.allocator.prefix.demote(key, self.allocator.blocks)
+
+    def readmit_prefix(self, key: bytes, prompt_len: int,
+                       first_token: int, leaves: Dict[str, Any]) -> bool:
+        """Install a completed promotion back into HBM: lease blocks,
+        eagerly scatter the payload into them (the import_blocks pattern
+        — no slot, no table row), and republish the prefix-cache entry.
+        The next ``alloc_request`` for this prompt is then a plain HBM
+        hit. Returns False when the pool cannot free enough blocks —
+        the caller returns the payload to the tier and retries later.
+        Engine thread only; eager, zero jit variants."""
+        installed, _rejected = self.readmit_prefix_many(
+            [(key, prompt_len, first_token, leaves)])
+        return bool(installed)
+
+    def readmit_prefix_many(self, entries):
+        """Batched :meth:`readmit_prefix`: every promotion that drained
+        ready in the same admission pass installs through ONE scatter
+        per pool leaf (indices and payloads concatenated on the block
+        axis). Eager-op dispatch dominates the install cost, so k
+        simultaneous promotions cost one entry's dispatch, not k.
+        ``entries`` is ``[(key, prompt_len, first_token, leaves), ...]``;
+        returns ``(installed_keys, rejected_entries)`` where rejected
+        entries did not fit the pool (caller returns them to the tier).
+        Engine thread only; eager, zero jit variants."""
+        import jax
+        import jax.numpy as jnp
+        al = self.allocator
+        bs = self.block_size
+        installed: list = []
+        rejected: list = []
+        plan: list = []           # (key, plen, ftok, leaves, blocks)
+        for key, plen, ftok, leaves in entries:
+            if al.prefix.lookup(key) is not None:
+                installed.append(key)        # re-prefilled meanwhile
+                continue
+            n = -(-int(plen) // bs)
+            if not al._ensure_free(n):
+                rejected.append((key, plen, ftok, leaves))
+                continue
+            plan.append((key, plen, ftok, leaves,
+                         [al.blocks.alloc() for _ in range(n)]))
+        if not plan:
+            return installed, rejected
+        idx = jnp.asarray(np.asarray(
+            [b for *_, blks in plan for b in blks], np.int32))
+
+        def leaf(path, a):
+            ks = jax.tree_util.keystr(path)
+            if "cache_index" in ks or "block_tables" in ks:
+                return a
+            lead = a.ndim - 3
+            parts = []
+            for _key, _plen, _ftok, leaves, _blks in plan:
+                payload = leaves.get(_norm_key(ks))
+                if payload is None:
+                    raise KeyError(
+                        f"promotion payload is missing kv leaf {ks!r}")
+                parts.append(np.asarray(payload))
+            payload = parts[0] if len(parts) == 1 else \
+                np.concatenate(parts, axis=lead)
+            sel = (slice(None),) * lead + (idx,)
+            return a.at[sel].set(jnp.asarray(payload).astype(a.dtype))
+
+        self.cache = jax.tree_util.tree_map_with_path(leaf, self.cache)
+        for key, plen, ftok, _leaves, blks in plan:
+            al.prefix.put(key, tuple(blks), int(plen), int(ftok),
+                          al.blocks)
+            for b in blks:
+                al.blocks.decref(b)          # cache holds the sole ref
+            installed.append(key)
+        return installed, rejected
 
     # ---------------------------------------------------------- accounting
     def arena_report(self) -> dict:
@@ -775,7 +914,7 @@ class PagedKVCacheManager:
         used = al.blocks.n_used
         free_ = al.blocks.n_free
         held = al.prefix.blocks_held
-        return {
+        rep = {
             "layout": "paged",
             "arena_bytes": kv_bytes + index_bytes,
             "kv_bytes": kv_bytes,
@@ -803,6 +942,16 @@ class PagedKVCacheManager:
             "prefix_cache_blocks": held,
             "prefix_cache_share": held / self.num_blocks,
         }
+        if self.tier is not None:
+            # per-tier accounting rides along under its own versioned
+            # schema (dstpu-tiers-v1): hbm_* mirrors the pool numbers so
+            # the tiers block reads standalone on dashboards
+            tiers = self.tier.report()
+            tiers["hbm_bytes"] = rep["active_bytes"]
+            tiers["hbm_capacity_bytes"] = kv_bytes
+            tiers["hbm_blocks"] = used
+            rep["tiers"] = tiers
+        return rep
 
     # ---------------------------------------------- allocator passthrough
     @property

@@ -452,6 +452,14 @@ def _serving_doc(**over):
             "inline_prefill_tokens": 65,
             "prefill_stall_s": 0.0,
         },
+        "tiered": {
+            "greedy_parity": True,
+            "oversubscription": 10.0,
+            "tiered_vs_all_hbm": 0.9,
+            "tiered_tokens_per_s": 90.0,
+            "decode_chunk_compiles": 3,
+            "promote_failures": 0,
+        },
     }
     doc.update(over)
     return doc
