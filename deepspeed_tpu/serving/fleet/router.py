@@ -278,8 +278,11 @@ class FleetRouter:
             return self.replicas[0], decision
         if self.affinity:
             key = PrefixCache.key_for(prompt)
-            hits = [r for r in candidates if self._holds_prefix(r, key)] \
-                if len(candidates) > 1 else []
+            # probe even a sole candidate: placement has no choice, but
+            # a hit must still short-circuit the tier-fetch fallback —
+            # otherwise a replica already holding the prefix in HBM
+            # gets a redundant cross-replica bundle pulled at it
+            hits = [r for r in candidates if self._holds_prefix(r, key)]
             if hits:
                 telemetry.count("fleet/affinity_hits")
                 with self._lock:

@@ -171,11 +171,18 @@ class AdmissionConfig:
     shed_memory_infeasible: bool = False
     slot_tokens: Optional[int] = None
     fused_prefill_chunk: Optional[int] = None
-    # tiered KV (serving/kv_tiers.py): DRAM+NVMe tier capacity in KV
-    # tokens, counted toward feasibility at ``tier_discount`` — demoted
-    # blocks re-admit via promotion, so the HBM wall is no longer the
-    # shed boundary. Wired from the engine's tier by the frontend when
-    # left None; 0/None keeps the pure-HBM gate.
+    # tiered KV (serving/kv_tiers.py): the tier NEVER raises the
+    # per-ticket wall — active-sequence KV cannot live below HBM, so a
+    # request past ``slot_tokens`` is infeasible tier or no tier. What
+    # the tier buys is AGGREGATE headroom: cold prefixes demote instead
+    # of occupying the pool, so the pending queue's total KV demand may
+    # exceed the HBM pool (``pool_tokens``) by the tier capacity
+    # (``tier_tokens``) at a discount (promotion costs a round trip).
+    # Offers past that ladder-wide ceiling shed with
+    # ``memory_infeasible`` backpressure. All three wired from the
+    # engine by the frontend when left None; tier_tokens 0/None keeps
+    # the pure per-ticket HBM gate (historical queueing behavior).
+    pool_tokens: Optional[int] = None
     tier_tokens: Optional[int] = None
     tier_discount: float = 0.5
 
@@ -228,6 +235,7 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._heap: List[Tuple[int, int, Ticket]] = []
         self._pending = 0                    # live (non-tombstone) tickets
+        self._pending_kv_tokens = 0          # their summed KV demand
         self._buckets: Dict[str, TokenBucket] = {}
         self.n_offered = 0
         self.n_rate_limited = 0
@@ -265,13 +273,24 @@ class AdmissionController:
                     self.clock() >= ticket.deadline_s:
                 from ..scheduler import REJECT_DEADLINE_EXPIRED
                 return REJECT_DEADLINE_EXPIRED
+            demand = ticket.prompt_len + ticket.max_new_tokens
             if cfg.shed_memory_infeasible and cfg.slot_tokens:
-                cap = float(cfg.slot_tokens)
-                if cfg.tier_tokens:
-                    # tier-aware feasibility: lower-tier headroom counts
-                    # at a discount (promotion costs a round trip)
-                    cap += cfg.tier_discount * float(cfg.tier_tokens)
-                if ticket.prompt_len + ticket.max_new_tokens > cap:
+                # per-ticket wall is pure HBM: active-sequence KV can
+                # never demote, so a request past one slot row / the
+                # pool can NEVER be served — tier or no tier
+                if demand > cfg.slot_tokens:
+                    self.n_memory_infeasible += 1
+                    return REJECT_MEMORY_INFEASIBLE
+            if cfg.shed_memory_infeasible and cfg.tier_tokens \
+                    and cfg.pool_tokens:
+                # tier-aware AGGREGATE gate: the pending queue's total
+                # KV demand may exceed the HBM pool by the lower tiers'
+                # capacity at a discount (promotion costs a round
+                # trip); past that the ladder itself would thrash, so
+                # shed instead of queueing forever
+                cap = float(cfg.pool_tokens) \
+                    + cfg.tier_discount * float(cfg.tier_tokens)
+                if self._pending_kv_tokens + demand > cap:
                     self.n_memory_infeasible += 1
                     return REJECT_MEMORY_INFEASIBLE
             if self._pending >= cfg.max_pending:
@@ -283,6 +302,7 @@ class AdmissionController:
             heapq.heappush(self._heap,
                            (ticket.priority, ticket.seq, ticket))
             self._pending += 1
+            self._pending_kv_tokens += demand
             return None
 
     def remove(self, ticket: Ticket) -> bool:
@@ -296,6 +316,8 @@ class AdmissionController:
                 if t is ticket:
                     ticket.cancelled = True
                     self._pending -= 1
+                    self._pending_kv_tokens -= \
+                        ticket.prompt_len + ticket.max_new_tokens
                     return True
             return False
 
@@ -321,6 +343,8 @@ class AdmissionController:
                 if ticket.cancelled:
                     continue
                 self._pending -= 1
+                self._pending_kv_tokens -= \
+                    ticket.prompt_len + ticket.max_new_tokens
                 if ticket.deadline_s is not None and \
                         now >= ticket.deadline_s:
                     self.n_shed += 1
@@ -381,4 +405,5 @@ class AdmissionController:
             out = [t for _, _, t in self._heap if not t.cancelled]
             self._heap = []
             self._pending = 0
+            self._pending_kv_tokens = 0
             return out

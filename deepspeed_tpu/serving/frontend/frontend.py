@@ -248,18 +248,30 @@ class ServingFrontend:
             pool_cap = getattr(pool_cap, "pool_capacity_tokens", None)
             if pool_cap is not None:
                 cfg.slot_tokens = min(cfg.slot_tokens, int(pool_cap))
-        if cfg.shed_memory_infeasible and cfg.tier_tokens is None and \
+        if cfg.shed_memory_infeasible and \
                 getattr(engine, "kv_tier", None) is not None:
-            # tiered KV: DRAM+NVMe capacity counts toward feasibility at
-            # a discounted rate (tier_discount) instead of rejecting at
-            # the HBM wall — demoted blocks re-admit via promotion
+            # tiered KV: DRAM+NVMe capacity counts toward AGGREGATE
+            # feasibility at a discounted rate (tier_discount) — the
+            # pending queue's total KV demand may exceed the HBM pool
+            # (pool_tokens) by the tier's discounted headroom. The
+            # per-ticket wall stays pure-HBM (slot_tokens): an active
+            # sequence's KV can never live below HBM, so a request
+            # that cannot fit one slot row / the pool is infeasible
+            # no matter how deep the tier is.
             rep = engine.kv.arena_report()
             bpt = max(int(rep.get("bytes_per_token", 0)), 1)
-            tier = engine.kv_tier
-            tier_bytes = int(tier.dram_capacity)
-            if tier.nvme_capacity is not None:
-                tier_bytes += int(tier.nvme_capacity)
-            cfg.tier_tokens = tier_bytes // bpt
+            if cfg.tier_tokens is None:
+                tier = engine.kv_tier
+                tier_bytes = int(tier.dram_capacity)
+                if tier.nvme_capacity is not None:
+                    tier_bytes += int(tier.nvme_capacity)
+                cfg.tier_tokens = tier_bytes // bpt
+            if cfg.pool_tokens is None:
+                pool_cap = getattr(
+                    getattr(engine.kv, "allocator", None),
+                    "pool_capacity_tokens", None)
+                cfg.pool_tokens = int(pool_cap) if pool_cap is not None \
+                    else cfg.slot_tokens
         if cfg.fused_prefill_chunk is None and \
                 getattr(engine, "fused_prefill", False):
             # fused chunked prefill: prompts ride the decode scan at

@@ -27,9 +27,19 @@ Thread model (the invariants the race tests pin down):
     gathers happen inside the prefix cache's eviction hook (engine
     thread), promotion scatters happen in ``ServingEngine._admit``'s
     drain of :meth:`drain_ready` (engine thread);
-  * everything in this module is host-side numpy behind one lock —
-    transport threads may probe/fetch/install concurrently with the
-    worker and the engine;
+  * the tier maps are host-side numpy behind one map lock — transport
+    threads may probe/fetch/install concurrently with the worker and
+    the engine;
+  * every use of the shared ``AsyncIOHandle`` is serialized behind a
+    dedicated I/O mutex (separate from the map lock): the handle's
+    pending-op/fd lists are not thread-safe and ``wait()`` drains and
+    closes EVERYTHING in flight, so an unserialized spill racing an
+    unspill could complete the other thread's ops and hand back an
+    uninitialized read buffer;
+  * NVMe reads run with the map lock DROPPED (only the I/O mutex held)
+    so a peer fetch of a spilled entry never stalls the engine
+    thread's admit path; the spill file is pinned for the read and a
+    concurrent promotion defers its unlink until the pin releases;
   * a promotion in flight keeps the entry OUT of the tier maps (no
     double-promote) but :meth:`holds` still answers True so the
     allocator keeps deferring the request until the payload lands.
@@ -109,8 +119,19 @@ class KVTierManager:
         self._spill_dir = spill_dir
         self._aio = aio if aio is not None else AsyncIOHandle()
         self._lock = threading.RLock()
+        # the shared AsyncIOHandle is NOT thread-safe (wait() drains and
+        # closes every op/fd in flight, whoever submitted it): all aio
+        # use — spill writes and unspill reads, from any thread — runs
+        # under this mutex, which nests INSIDE the map lock (never take
+        # the map lock while holding it)
+        self._io_lock = threading.Lock()
         self._dram: "OrderedDict[bytes, _DramEntry]" = OrderedDict()
         self._nvme: "OrderedDict[bytes, _NvmeEntry]" = OrderedDict()
+        # spill files a peer fetch is reading with the map lock dropped:
+        # key -> reader count; an unlink that lands mid-read parks in
+        # _unlink_deferred and the last unpin performs it
+        self._pins: Dict[bytes, int] = {}
+        self._unlink_deferred: Dict[bytes, str] = {}
         self._inflight: Dict[bytes, float] = {}   # key -> request clock
         self._ready: "OrderedDict[bytes, _DramEntry]" = OrderedDict()
         self._queue: "queue.Queue[Optional[bytes]]" = queue.Queue()
@@ -168,13 +189,13 @@ class KVTierManager:
         while (self.nvme_capacity is not None
                and self.nvme_bytes > self.nvme_capacity and self._nvme):
             key, spilled = self._nvme.popitem(last=False)
-            self._unlink(spilled.path)
+            self._unlink_entry(key, spilled.path)
             self.dropped += 1
 
     def _spill(self, key: bytes, entry: _DramEntry) -> bool:
         """DRAM -> NVMe: one spill file per entry, the leaves' raw bytes
         concatenated in sorted-key order, written through the aio
-        handle. Caller holds the lock."""
+        handle. Caller holds the map lock."""
         if self.nvme_capacity is not None \
                 and entry.nbytes > self.nvme_capacity:
             return False
@@ -183,13 +204,16 @@ class KVTierManager:
         meta: List[Tuple[str, Any, Tuple[int, ...], int]] = []
         offset = 0
         try:
-            for name in sorted(entry.leaves):
-                a = entry.leaves[name]
-                flat = np.ascontiguousarray(a).view(np.uint8).reshape(-1)
-                self._aio.async_pwrite(flat, path, offset)
-                meta.append((name, a.dtype, tuple(a.shape), int(a.nbytes)))
-                offset += int(a.nbytes)
-            self._aio.wait()
+            with self._io_lock:
+                for name in sorted(entry.leaves):
+                    a = entry.leaves[name]
+                    flat = np.ascontiguousarray(a) \
+                        .view(np.uint8).reshape(-1)
+                    self._aio.async_pwrite(flat, path, offset)
+                    meta.append((name, a.dtype, tuple(a.shape),
+                                 int(a.nbytes)))
+                    offset += int(a.nbytes)
+                self._aio.wait()
         except OSError:
             self._unlink(path)
             return False
@@ -199,16 +223,19 @@ class KVTierManager:
         return True
 
     def _unspill(self, spilled: _NvmeEntry) -> _DramEntry:
-        """NVMe -> host numpy (worker thread; no lock needed — the entry
-        was already removed from the maps by the caller)."""
+        """NVMe -> host numpy. Runs WITHOUT the map lock (worker or
+        transport thread) — only the I/O mutex, so a disk read never
+        blocks holds()/admit, and a concurrent spill cannot have its
+        pending aio ops drained by this read's wait()."""
         leaves: Dict[str, np.ndarray] = {}
         offset = 0
-        for name, dtype, shape, nbytes in spilled.meta:
-            buf = np.empty(nbytes, np.uint8)
-            self._aio.async_pread(buf, spilled.path, offset)
-            self._aio.wait()
-            leaves[name] = buf.view(dtype).reshape(shape)
-            offset += nbytes
+        with self._io_lock:
+            for name, dtype, shape, nbytes in spilled.meta:
+                buf = np.empty(nbytes, np.uint8)
+                self._aio.async_pread(buf, spilled.path, offset)
+                self._aio.wait()
+                leaves[name] = buf.view(dtype).reshape(shape)
+                offset += nbytes
         return _DramEntry(spilled.prompt_len, spilled.first_token, leaves,
                           spilled.nbytes)
 
@@ -217,6 +244,25 @@ class KVTierManager:
             os.unlink(path)
         except OSError:
             pass
+
+    def _unlink_entry(self, key: bytes, path: str) -> None:
+        """Unlink ``key``'s spill file — or defer while a peer fetch is
+        mid-read on it (caller holds the map lock; the reader's unpin
+        performs the deferred unlink)."""
+        if self._pins.get(key):
+            self._unlink_deferred[key] = path
+        else:
+            self._unlink(path)
+
+    def _unpin_locked(self, key: bytes) -> None:
+        n = self._pins.get(key, 0) - 1
+        if n > 0:
+            self._pins[key] = n
+            return
+        self._pins.pop(key, None)
+        path = self._unlink_deferred.pop(key, None)
+        if path is not None:
+            self._unlink(path)
 
     # --------------------------------------------------------- promotion
     def holds(self, key: bytes) -> bool:
@@ -265,12 +311,14 @@ class KVTierManager:
                 # a failed promotion must not wedge the allocator's
                 # deferral loop: drop every trace of the key so holds()
                 # flips False and the request re-prefills as a miss
+                # (_promote_one already unlinked the spill file it had
+                # popped; this pop only covers a key never reached)
                 with self._lock:
                     self._inflight.pop(key, None)
                     self._dram.pop(key, None)
                     spilled = self._nvme.pop(key, None)
                     if spilled is not None:
-                        self._unlink(spilled.path)
+                        self._unlink_entry(key, spilled.path)
                     self.promote_failures += 1
 
     def _promote_one(self, key: bytes) -> None:
@@ -285,8 +333,17 @@ class KVTierManager:
             return
         from_nvme = entry is None
         if from_nvme:
-            entry = self._unspill(spilled)
-            self._unlink(spilled.path)
+            try:
+                entry = self._unspill(spilled)
+            except BaseException:
+                # the entry is already popped from _nvme: unlink its
+                # file here or it leaks — the worker's failure handler
+                # can no longer find it
+                with self._lock:
+                    self._unlink_entry(key, spilled.path)
+                raise
+            with self._lock:
+                self._unlink_entry(key, spilled.path)
         with self._lock:
             self._ready[key] = entry
             self._inflight.pop(key, None)
@@ -310,25 +367,52 @@ class KVTierManager:
         """Serve a peer's prefix fetch (transport thread): the entry's
         payload in the migrate-bundle shape ``encode_bundle`` speaks.
         Non-destructive — the local tier keeps its copy (the peer's
-        fetch must not evict the home replica's warm state)."""
+        fetch must not evict the home replica's warm state). A spilled
+        entry's NVMe read runs with the map lock DROPPED (the engine
+        thread's holds()/admit path must never wait on a disk read);
+        the pin keeps a concurrent promotion from unlinking the file
+        mid-read."""
+        payload = None
+        spilled = None
         with self._lock:
             entry = self._dram.get(key)
             if entry is not None:
                 self._dram.move_to_end(key)
-                leaves = dict(entry.leaves)
-                pl_, ft = entry.prompt_len, entry.first_token
+                payload = (dict(entry.leaves), entry.prompt_len,
+                           entry.first_token)
             else:
-                spilled = self._nvme.get(key)
-                if spilled is None:
-                    ready = self._ready.get(key)
-                    if ready is None:
-                        return None
-                    leaves = dict(ready.leaves)
-                    pl_, ft = ready.prompt_len, ready.first_token
+                ready = self._ready.get(key)
+                if ready is not None:
+                    payload = (dict(ready.leaves), ready.prompt_len,
+                               ready.first_token)
                 else:
-                    entry = self._unspill(spilled)
-                    leaves = entry.leaves
-                    pl_, ft = entry.prompt_len, entry.first_token
+                    spilled = self._nvme.get(key)
+                    if spilled is None:
+                        return None
+                    self._pins[key] = self._pins.get(key, 0) + 1
+        if payload is None:
+            try:
+                entry = self._unspill(spilled)
+                payload = (entry.leaves, entry.prompt_len,
+                           entry.first_token)
+            except OSError:
+                pass
+            finally:
+                with self._lock:
+                    self._unpin_locked(key)
+            if payload is None:
+                # the file vanished mid-read (close(), or a capacity
+                # drop racing the pin): the payload may have landed in
+                # an in-memory tier via a concurrent promotion — retry
+                # those once before reporting a miss
+                with self._lock:
+                    entry = self._dram.get(key) or self._ready.get(key)
+                    if entry is None:
+                        return None
+                    payload = (dict(entry.leaves), entry.prompt_len,
+                               entry.first_token)
+        leaves, pl_, ft = payload
+        with self._lock:
             self.peer_fetches += 1
         return {"schema": PREFIX_FETCH_SCHEMA, "key": key.hex(),
                 "prompt_len": int(pl_), "first_token": int(ft),
@@ -417,8 +501,8 @@ class KVTierManager:
         self._queue.put(None)
         self._worker.join(timeout=5.0)
         with self._lock:
-            for spilled in self._nvme.values():
-                self._unlink(spilled.path)
+            for key, spilled in self._nvme.items():
+                self._unlink_entry(key, spilled.path)
             self._nvme.clear()
             self._dram.clear()
             self._ready.clear()

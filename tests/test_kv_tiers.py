@@ -136,6 +136,105 @@ class TestTierManagerUnits:
         tier.close()                          # idempotent
         assert not tier.admit(b"k2", 8, 0, _leaves(256))  # closed
 
+    def test_failed_nvme_promotion_unlinks_caller_dir_spill(
+            self, tmp_path, monkeypatch):
+        """``_promote_one`` pops the NVMe entry BEFORE the disk read: a
+        failing read must still unlink the popped entry's spill file —
+        with a caller-provided spill_dir ``close()`` never rmtrees, so
+        a missed unlink is a permanent leak."""
+        with KVTierManager(dram_bytes=0, spill_dir=str(tmp_path)) as tier:
+            assert tier.admit(b"k", 8, 0, _leaves(256))
+            path = tier.spill_files()[0]
+
+            def boom(spilled):
+                raise OSError("injected read failure")
+
+            monkeypatch.setattr(tier, "_unspill", boom)
+            assert tier.request_promotion(b"k")
+            deadline = time.monotonic() + 10
+            while tier.holds(b"k") and time.monotonic() < deadline:
+                time.sleep(0.001)
+            assert not tier.holds(b"k")     # dropped: re-prefills as miss
+            assert tier.promote_failures == 1
+            assert not os.path.exists(path)  # no spill-file leak
+
+    def test_fetch_pin_defers_concurrent_unlink(self):
+        """A peer fetch mid-read pins the spill file: a concurrent
+        promotion's unlink parks until the pin releases (the fetch's
+        per-leaf reads would otherwise race the file's removal)."""
+        with KVTierManager(dram_bytes=0) as tier:
+            assert tier.admit(b"k", 8, 0, _leaves(256))
+            path = tier.spill_files()[0]
+            with tier._lock:
+                tier._pins[b"k"] = 1          # a fetch is mid-read
+            assert tier.request_promotion(b"k")
+            deadline = time.monotonic() + 10
+            while not tier._ready and time.monotonic() < deadline:
+                time.sleep(0.001)
+            assert tier.promotions_nvme == 1
+            assert os.path.exists(path)       # unlink deferred by pin
+            with tier._lock:
+                tier._unpin_locked(b"k")
+            assert not os.path.exists(path)   # performed at unpin
+
+    def test_concurrent_spill_and_fetch_bit_exact(self):
+        """Spills (engine thread, map lock held) and peer fetches'
+        NVMe reads (transport threads, map lock dropped) hammer the
+        SHARED AsyncIOHandle concurrently: the I/O mutex keeps every
+        payload bit-exact — an unserialized ``wait()`` would drain the
+        other thread's in-flight ops and hand back uninitialized read
+        buffers."""
+        import threading
+        ref = {f"k{i}".encode(): _leaves(1024, seed=100 + i)
+               for i in range(8)}
+        with KVTierManager(dram_bytes=0) as tier:  # every admit spills
+            errs = []
+
+            def fetcher():
+                try:
+                    for _ in range(20):
+                        for key, lv in ref.items():
+                            b = tier.fetch_bundle(key)
+                            if b is None:
+                                continue       # not admitted yet
+                            for name, a in lv.items():
+                                got = np.asarray(b["kv"][name])
+                                np.testing.assert_array_equal(
+                                    got.view(np.uint8), a.view(np.uint8))
+                except Exception as e:  # noqa: BLE001 — collected
+                    errs.append(e)
+
+            def admitter():
+                try:
+                    for key, lv in ref.items():
+                        assert tier.admit(key, 8, 0, lv)
+                        time.sleep(0.001)
+                except Exception as e:  # noqa: BLE001 — collected
+                    errs.append(e)
+
+            threads = [threading.Thread(target=admitter),
+                       threading.Thread(target=fetcher),
+                       threading.Thread(target=fetcher)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+            # promotion after the storm still round-trips every byte
+            for key in ref:
+                assert tier.request_promotion(key)
+            got = {}
+            deadline = time.monotonic() + 10
+            while len(got) < len(ref) and time.monotonic() < deadline:
+                for k, _pl, _ft, leaves in tier.drain_ready():
+                    got[k] = leaves
+                time.sleep(0.001)
+            assert set(got) == set(ref)
+            for key, lv in ref.items():
+                for name, a in lv.items():
+                    np.testing.assert_array_equal(
+                        got[key][name].view(np.uint8), a.view(np.uint8))
+
     def test_bundle_wire_schema_and_install(self):
         with KVTierManager(dram_bytes=1 << 20) as src, \
                 KVTierManager(dram_bytes=1 << 20) as dst:
@@ -158,23 +257,41 @@ class TestTierAwareAdmission:
         from deepspeed_tpu.serving.frontend.admission import Ticket
         return Ticket(prompt_len=prompt_len, max_new_tokens=mnt)
 
-    def test_tier_tokens_extend_feasibility_at_discount(self):
+    def test_tier_extends_backlog_not_per_ticket_cap(self):
         from deepspeed_tpu.serving.frontend.admission import (
             AdmissionConfig, AdmissionController,
             REJECT_MEMORY_INFEASIBLE)
-        hbm_only = AdmissionController(AdmissionConfig(
-            shed_memory_infeasible=True, slot_tokens=32))
-        assert hbm_only.offer(self._ticket(30, 10)) \
-            == REJECT_MEMORY_INFEASIBLE
-        # 32 HBM + 0.5 * 32 tier tokens = 48-token cap: the same
-        # request admits once the tier's headroom counts
+        # the per-ticket wall stays pure HBM even with a tier: the tier
+        # only holds COLD prefix entries — an active sequence's KV can
+        # never demote, so a request past one slot row / the pool can
+        # NEVER be served; admitting it would defer forever instead of
+        # shedding (liveness)
         tiered = AdmissionController(AdmissionConfig(
             shed_memory_infeasible=True, slot_tokens=32,
-            tier_tokens=32, tier_discount=0.5))
-        assert tiered.offer(self._ticket(30, 10)) is None
-        assert tiered.offer(self._ticket(40, 10)) \
-            == REJECT_MEMORY_INFEASIBLE      # past even the tiered cap
-        assert tiered.n_memory_infeasible == 1
+            pool_tokens=32, tier_tokens=32, tier_discount=0.5))
+        assert tiered.offer(self._ticket(30, 10)) \
+            == REJECT_MEMORY_INFEASIBLE
+        # what the tier buys is AGGREGATE headroom: 32 pool + 0.5 * 32
+        # tier = 48 pending KV tokens — two 24-token tickets queue,
+        # the third sheds instead of thrashing the ladder
+        assert tiered.offer(self._ticket(16, 8)) is None
+        assert tiered.offer(self._ticket(16, 8)) is None
+        assert tiered.offer(self._ticket(16, 8)) \
+            == REJECT_MEMORY_INFEASIBLE
+        assert tiered.n_memory_infeasible == 2
+        # popping a ticket releases its backlog budget
+        admits, sheds = tiered.pop(room=1, rate=None, backlog_tokens=0.0)
+        assert len(admits) == 1 and not sheds
+        assert tiered.offer(self._ticket(16, 8)) is None
+        # without a tier there is no aggregate gate — the historical
+        # behavior queues past the pool instead of shedding
+        hbm_only = AdmissionController(AdmissionConfig(
+            shed_memory_infeasible=True, slot_tokens=32,
+            pool_tokens=32))
+        assert hbm_only.offer(self._ticket(30, 10)) \
+            == REJECT_MEMORY_INFEASIBLE
+        for _ in range(4):
+            assert hbm_only.offer(self._ticket(16, 8)) is None
 
 
 # ------------------------------------------------ engine (integration)
@@ -458,6 +575,58 @@ class TestFleetPrefixFetch:
                 fe.close(timeout=5)
             serve_a.close()
             serve_b.close()
+
+    def test_single_candidate_affinity_short_circuits_tier_fetch(self):
+        """A sole routable candidate that already holds the prefix in
+        its own HBM cache must count as an affinity hit, NOT trigger
+        the tier-fetch fallback (a wasted cross-replica transfer plus
+        a redundant DRAM-tier copy on the target)."""
+        from collections import deque
+        from deepspeed_tpu.serving import PrefixCache
+        from deepspeed_tpu.serving.fleet import FleetRouter
+
+        class _Sched:
+            def __init__(self):
+                self.queue = deque()
+                self.running = {}
+                self.finished = []
+
+            def has_work(self):
+                return False
+
+        class _KV:
+            prefix_enabled = True
+
+            def __init__(self):
+                self.prefix_cache = set()
+
+        class _Eng:
+            def __init__(self):
+                self.max_seq_len = 64
+                self.max_batch = 4
+                self.scheduler = _Sched()
+                self.chunk_in_flight = False
+                self.kv = _KV()
+
+        prompt = np.arange(1, 9, dtype=np.int32)
+        key = PrefixCache.key_for(prompt)
+        fetches = []
+        with FleetRouter([_Eng(), _Eng()]) as router:
+            router._tier_fetch = \
+                lambda holder, target, k: fetches.append(k) or True
+            router.replicas[1].draining = True    # unroutable holder
+            router.replicas[1].engine.kv.prefix_cache.add(key)
+            # the sole candidate holds the prefix in HBM: affinity hit
+            router.replicas[0].engine.kv.prefix_cache.add(key)
+            rep, decision = router._place_decision(prompt)
+            assert rep.rid == 0 and decision["affinity_hit"]
+            assert not fetches and router.n_tier_fetches == 0
+            # once it does NOT hold it, the fallback still fires
+            router.replicas[0].engine.kv.prefix_cache.discard(key)
+            rep, decision = router._place_decision(prompt)
+            assert rep.rid == 0 and not decision["affinity_hit"]
+            assert decision.get("tier_fetch") == 1
+            assert fetches == [key] and router.n_tier_fetches == 1
 
     def test_router_tier_fetch_helper_best_effort(self):
         """The router's fallback hop is best-effort plumbing around the
