@@ -18,19 +18,31 @@
 # demote to host DRAM/NVMe and promote back on re-serve — bit-identical
 # greedy vs an all-HBM reference, >= 0.8x its throughput, demote/promote
 # counters nonzero, paged compile count within one retrace of the
-# untiered run, spill files cleaned on close).
+# untiered run, spill files cleaned on close), and the --megakernel A/B
+# (fused decode megakernel engine vs the composed baseline:
+# bit-identical greedy dense AND paged, pinned megakernel retrace
+# budgets, jit-cache variant-name isolation).
 # Writes BENCH_serving.json (tokens/s for both loops, chunk_speedup,
-# prefill padding waste, the paged/speculative/int8_kv/fused/tiered
-# blocks) at the repo root and exits nonzero on parity failure or any
-# crash — fast enough for tier-1.
+# prefill padding waste, the paged/speculative/int8_kv/fused/tiered/
+# megakernel blocks) at the repo root, then runs the kernel-level bench
+# (composed-vs-fused megakernel speedup — roofline proxy on CPU hosts —
+# plus the tp collective/MLP overlap step model; the TPU-only
+# decode_microbench case skips itself on CPU) into BENCH_kernels.json.
+# Exits nonzero on parity failure, a missed gate, or any crash — fast
+# enough for tier-1.
 #
 # Usage: bin/serving_smoke.sh        (from the repo root, or anywhere)
 
 cd "$(dirname "$0")/.." || exit 1
 
-exec timeout -k 10 600 env JAX_PLATFORMS=cpu \
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
     python -m deepspeed_tpu.benchmarks.serving_bench \
     --n-requests 8 --max-new-tokens 24 --prompt-len 16 \
     --decode-chunk 8 --skip-sequential --paged \
-    --speculative --kv-dtype int8 --tiered \
-    --out-dir /tmp/serving_smoke_csv --json-out BENCH_serving.json
+    --speculative --kv-dtype int8 --tiered --megakernel \
+    --out-dir /tmp/serving_smoke_csv --json-out BENCH_serving.json \
+    || exit $?
+
+exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m deepspeed_tpu.benchmarks.kernels_bench \
+    --json-out BENCH_kernels.json

@@ -16,7 +16,8 @@ python bin/tracelint deepspeed_tpu || exit $?
 # every watched metric path must resolve in the archived BENCH_*.json —
 # a bench schema drift fails here, not after a full bench round. The
 # full gate (seeded regression + live scrape) is bin/obs_smoke.sh.
-for bench in BENCH_serving.json BENCH_frontend.json BENCH_fleet.json; do
+for bench in BENCH_serving.json BENCH_frontend.json BENCH_fleet.json \
+             BENCH_kernels.json; do
     [ -f "$bench" ] && { python bin/benchdiff "$bench" "$bench" \
         --fail-on-missing --quiet || exit $?; }
 done

@@ -76,6 +76,15 @@ INT8_PAGED_DECODE_PROGRAM_BUDGET = 2
 FUSED_DECODE_PROGRAM_BUDGET = 3
 FUSED_PAGED_DECODE_PROGRAM_BUDGET = 4
 
+#: the MEGAKERNEL chunk variants (fused Pallas decode + sort-free
+#: sampling epilogue + tp overlap, serving/engine.py ``megakernel=True``)
+#: inherit their base layouts' retrace physics unchanged — the epilogue
+#: kernel rides inside the same scan body and adds no carry state, so
+#: dense compiles like the dense chunk (3) and paged like the paged
+#: chunk (2). tests/test_tracelint.py pins both.
+MEGA_DECODE_PROGRAM_BUDGET = 3
+MEGA_PAGED_DECODE_PROGRAM_BUDGET = 2
+
 
 def _tiny_model(vocab_size=512, max_seq_len=64):
     """Small enough that per-step host overhead (dispatch + sync + python
@@ -487,6 +496,89 @@ def _fused_case(engine, prompts, max_new_tokens: int, max_batch: int,
     }
 
 
+def _megakernel_case(engine, prompts, max_new_tokens: int, max_batch: int,
+                     prompt_len: int, decode_chunk: int, ck_results,
+                     ck_tps: float, with_paged: bool) -> dict:
+    """Megakernel A/B: the same workload decoded with ``megakernel=True``
+    (fused Pallas decode kernel on TPU, sort-free sampling epilogue,
+    tp overlap on tp meshes) vs the composed engines above. Asserted:
+
+      * greedy outputs BIT-identical to the composed chunked engine —
+        the megakernel correctness contract (dense and, with --paged,
+        through the block pool);
+      * the megakernel chunk programs' compile counts match their pinned
+        budgets, AND the composed variant names compile ZERO times inside
+        the megakernel's audited region — variant-name isolation: the
+        knob must never silently fall back to (or retrace) the composed
+        program family;
+      * wall-clock is reported, not gated, on CPU hosts: the epilogue
+        kernel runs in interpret mode there, so the >= 1.5x composed-vs-
+        fused gate lives in the kernels bench's roofline/TPU measurement
+        (benchmarks/kernels_bench.py, BENCH_kernels.json).
+    """
+    from ..analysis import TraceAuditor
+    from ..serving import ServingEngine
+
+    def one_side(paged: bool):
+        variant = "decode_chunk_megakernel_paged_fn" if paged \
+            else "decode_chunk_megakernel_fn"
+        composed = "decode_chunk_paged_fn" if paged else "decode_chunk_fn"
+        budget = MEGA_PAGED_DECODE_PROGRAM_BUDGET if paged \
+            else MEGA_DECODE_PROGRAM_BUDGET
+        kw = dict(paged=True, prefix_cache=False) if paged else {}
+        auditor = TraceAuditor(budgets={variant: budget},
+                               audit_jaxprs=False)
+        with auditor:
+            mega = ServingEngine(engine=engine, max_batch=max_batch,
+                                 max_prompt_len=prompt_len,
+                                 decode_chunk=decode_chunk,
+                                 max_queue=max(len(prompts), 8),
+                                 megakernel=True, **kw)
+            mg_results, mg_dt, mg_tokens, _ = _timed_serving_run(
+                mega, prompts, max_new_tokens)
+        compiles = auditor.compiles(variant)
+        if compiles != budget:
+            raise RuntimeError(
+                f"{variant} compiled {compiles}x, expected exactly "
+                f"{budget} — the fused epilogue is leaking shape/type "
+                "variation into the chunk program")
+        stray = auditor.compiles(composed)
+        if stray != 0:
+            raise RuntimeError(
+                f"composed variant {composed} compiled {stray}x inside "
+                "the megakernel region — megakernel=True must route "
+                "every chunk through its own program family")
+        if not all(np.array_equal(a.output_ids, b.output_ids)
+                   for a, b in zip(ck_results, mg_results)):
+            raise RuntimeError(
+                f"greedy outputs diverged between the composed and "
+                f"megakernel engines (paged={paged}) — the megakernel "
+                "contract is bit-identical greedy")
+        return mg_dt, mg_tokens / mg_dt, compiles, budget
+
+    mg_dt, mg_tps, compiles, budget = one_side(paged=False)
+    paged_block = None
+    if with_paged:
+        pg_dt, pg_tps, pg_compiles, pg_budget = one_side(paged=True)
+        paged_block = {
+            "greedy_parity": True,
+            "megakernel_paged_s": round(pg_dt, 4),
+            "megakernel_paged_tokens_per_s": round(pg_tps, 2),
+            "decode_chunk_compiles": pg_compiles,
+            "decode_chunk_budget": pg_budget,
+        }
+    return {
+        "greedy_parity": True,
+        "variant_isolation": True,
+        "megakernel_s": round(mg_dt, 4),
+        "megakernel_tokens_per_s": round(mg_tps, 2),
+        "megakernel_vs_chunked": round(mg_tps / ck_tps, 3),
+        "decode_chunk_compiles": compiles,
+        "decode_chunk_budget": budget,
+        "paged": paged_block,
+    }
+
+
 def _tiered_case(engine, n_requests: int = 20, prompt_len: int = 24,
                  max_new_tokens: int = 36, block_size: int = 8,
                  max_batch: int = 2, decode_chunk: int = 8,
@@ -650,6 +742,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               with_speculative: bool = False,
               with_fused: bool = True,
               with_tiered: bool = False,
+              with_megakernel: bool = False,
               spec_k: int = 4,
               kv_dtype: str = "auto",
               trace_out: str = None) -> dict:
@@ -872,6 +965,16 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
     if with_tiered:
         tiered_out = _tiered_case(engine, decode_chunk=decode_chunk)
 
+    # ---- megakernel A/B (--megakernel) ---------------------------------
+    # Same prompts and chunk config; own audited region, strictly after
+    # the others (so its compile counts never share a jit cache round
+    # with the composed engines' pinned budgets).
+    megakernel_out = None
+    if with_megakernel:
+        megakernel_out = _megakernel_case(
+            engine, prompts, max_new_tokens, max_batch, prompt_len,
+            decode_chunk, ck_results, ck_tps, with_paged=with_paged)
+
     ttfts = [r.ttft_s for r in ck_results if r.ttft_s is not None]
     csv_dir = os.path.join(out_dir, "serving_bench")
     out = {
@@ -908,6 +1011,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
         "int8_kv": int8_out,
         "fused": fused_out,
         "tiered": tiered_out,
+        "megakernel": megakernel_out,
         "trace_file": trace_out,
         "csv_files": sorted(os.listdir(csv_dir))
         if os.path.isdir(csv_dir) else [],
@@ -949,6 +1053,14 @@ def main(argv=None):
                     "promoting on re-serve (bit-identical greedy vs an "
                     "all-HBM reference and >= 0.8x its throughput "
                     "asserted; pinned paged compile budget unchanged)")
+    ap.add_argument("--megakernel", action="store_true",
+                    help="also A/B the fused decode megakernel "
+                    "(megakernel=True engine: Pallas decode + sort-free "
+                    "sampling epilogue) against the composed engines — "
+                    "bit-identical greedy asserted dense AND paged, "
+                    "pinned megakernel retrace budgets, and zero "
+                    "composed-variant compiles inside the megakernel "
+                    "region (variant-name isolation)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per speculative step")
     ap.add_argument("--kv-dtype", type=str, default="auto",
@@ -976,6 +1088,7 @@ def main(argv=None):
                        with_speculative=args.speculative,
                        with_fused=args.fused,
                        with_tiered=args.tiered,
+                       with_megakernel=args.megakernel,
                        spec_k=args.spec_k,
                        kv_dtype=args.kv_dtype,
                        trace_out=args.trace_out)

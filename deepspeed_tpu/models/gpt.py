@@ -177,6 +177,14 @@ class GPTConfig:
     rotary: bool = False             # False: learned positions (GPT-2)
     rotary_pct: float = 1.0
     parallel_residual: bool = False  # True for NeoX
+    # Decode-time tp collective/MLP overlap (ops/tp_overlap.py): pin the
+    # attention-branch output hidden-sharded so GSPMD decomposes its
+    # post-projection all-reduce into reduce-scatter + all-gather with the
+    # independent parallel-residual MLP gemm between them. Parallel-
+    # residual only (the sequential block has nothing to hide behind);
+    # inert on meshes without a tp axis. The serving engine's megakernel
+    # mode flips this on when tp > 1.
+    tp_overlap: bool = False
     tie_embeddings: bool = True
     dtype: Any = jnp.bfloat16        # compute dtype
     param_dtype: Any = jnp.float32
@@ -269,6 +277,11 @@ class GPTConfig:
             raise ValueError(
                 f"unknown kv_cache_dtype {self.kv_cache_dtype!r}: "
                 f"use 'auto' or 'int8'")
+        if self.tp_overlap and not self.parallel_residual:
+            raise ValueError(
+                "tp_overlap hides the attention all-reduce behind the "
+                "parallel-residual MLP gemm; it requires "
+                "parallel_residual=True")
 
     @property
     def head_dim(self) -> int:
@@ -496,16 +509,20 @@ class SelfAttention(nn.Module):
                 cv.value = _kv_write(
                     cv.value, v.astype(cfg.dtype).reshape(b, s, h * d), cur)
             idx.value = cur + s
-            from ..ops.pallas.decode_attention import decode_attention
-            if s == 1:
+            from ..ops.pallas.decode_attention import (MAX_SPEC_S,
+                                                       decode_attention)
+            if s == 1 or (s <= MAX_SPEC_S and not cfg.sequence_parallel):
                 # fused prefix-only decode (reference softmax_context):
                 # O(cache_len) compute AND HBM traffic per token — int8
-                # blocks are DMA-streamed and dequantized in VMEM
+                # blocks are DMA-streamed and dequantized in VMEM. s > 1
+                # is the k+1 speculative-verify shape, handled in-kernel
+                # by the s-position qmat, so the spec hot loop never
+                # materializes a dequantized f32 cache view
                 return decode_attention(
                     q, ck.value, cv.value, cur + s, scale=scale,
                     k_scale=ksc.value[..., 0] if int8 else None,
                     v_scale=vsc.value[..., 0] if int8 else None)
-            # prefill / spec-verify: one relayout of the cache view per call
+            # prefill: one relayout of the cache view per call
             if int8:
                 from ..ops.quantizer import dequantize_kv
                 kf = dequantize_kv(ck.value, ksc.value, cfg.dtype)
@@ -526,10 +543,14 @@ class SelfAttention(nn.Module):
             ck.value = _kv_write(ck.value, k.astype(cfg.dtype), cur)
             cv.value = _kv_write(cv.value, v.astype(cfg.dtype), cur)
         idx.value = cur + s
-        if s == 1 and self.window is None and impl == "pallas" and not int8:
-            from ..ops.pallas.decode_attention import decode_attention
-            return decode_attention(q, ck.value, cv.value, cur + s,
-                                    scale=scale)
+        if self.window is None and impl == "pallas" and not int8:
+            from ..ops.pallas.decode_attention import (MAX_SPEC_S,
+                                                       decode_attention)
+            if s == 1 or (s <= MAX_SPEC_S and not cfg.sequence_parallel):
+                # rank-4 cache: decode_attention relayouts the view, but
+                # keeps spec widths on the fused kernel path
+                return decode_attention(q, ck.value, cv.value, cur + s,
+                                        scale=scale)
         if int8:
             from ..ops.quantizer import dequantize_kv
             kf = dequantize_kv(ck.value, ksc.value[..., None], cfg.dtype)
@@ -662,7 +683,14 @@ class Block(nn.Module):
         if cfg.parallel_residual:
             # NeoX: x + attn(ln1(x)) + ffn(ln2(x))
             ffn_out, l_aux = self._ffn(cfg, ln2(x), deterministic)
-            out = x + attn(ln1(x), positions, deterministic) + ffn_out
+            attn_out = attn(ln1(x), positions, deterministic)
+            if (cfg.tp_overlap and not self.is_initializing()
+                    and self.is_mutable_collection("cache")):
+                # decode only: pin the attn branch hidden-sharded so its
+                # tp all-reduce splits into RS/AG around the MLP gemm
+                from ..ops.tp_overlap import defer_attn_allreduce
+                attn_out = defer_attn_allreduce(attn_out)
+            out = x + attn_out + ffn_out
         else:
             h = x + attn(ln1(x), positions, deterministic)
             ffn_out, l_aux = self._ffn(cfg, ln2(h), deterministic)

@@ -52,9 +52,27 @@ else:
 
 NEG_INF = float(np.finfo(np.float32).min)
 
+# Widest speculative-verify query width (k+1 draft positions) the kernels
+# take in-kernel. The qmat lane dim is s*hp, so wider shapes would start
+# eating MXU lanes for masked-out work; past this the wrappers fall back
+# to the gather/einsum path (prefill always does — s there is prompt-len).
+MAX_SPEC_S = 8
+
+
+def _spec_live_mask(pos, fill, s, hp, shape):
+    """[bk, s*hp] causal liveness: query column-group i (lanes i*hp ..
+    (i+1)*hp) sits at absolute position ``fill - s + i``, so key position
+    ``pos`` is visible iff ``pos < fill - (s-1) + i``. For s == 1 this is
+    the plain filled-prefix mask (kept on its scalar form so the
+    single-token hot path's codegen is untouched)."""
+    if s == 1:
+        return pos < fill
+    qidx = jax.lax.broadcasted_iota(jnp.int32, shape, 1) // hp
+    return pos < fill - (s - 1) + qidx
+
 
 def _decode_kernel(meta_ref, qmat_ref, *refs, scale, block_k, b, hp, hd,
-                   quantized=False):
+                   quantized=False, s=1):
     """Single program. k_hbm/v_hbm: full [b, S, h*d] refs in HBM;
     k_buf/v_buf: [2, b, block_k, h*d] VMEM slots — ALL batch rows ride one
     (strided) DMA per block, so the DMA count is O(live blocks), not
@@ -69,7 +87,15 @@ def _decode_kernel(meta_ref, qmat_ref, *refs, scale, block_k, b, hp, hd,
     ``quantized``: the cache rides int8 with per-position f32 dequant
     multipliers ks_hbm/vs_hbm [b, S] — int8 blocks are DMA-streamed
     (half/quarter the HBM bytes) and the scale-multiply happens here in
-    VMEM right before the MXU dot."""
+    VMEM right before the MXU dot.
+
+    ``s``: static query positions per lane (the k+1 speculative-verify
+    shape). The block-diagonal qmat widens to [h*d, s*hp] — column group
+    i is query position i's block-diagonal matrix — so the s-position
+    scores still come out of ONE MXU matmul; the causal mask staggers per
+    column group (:func:`_spec_live_mask`) and the online-softmax carries
+    widen to [b, s*hp]. The DMA window is unchanged: int8 dequant stays
+    fused in VMEM, so the spec path never materializes an f32 cache."""
     if quantized:
         (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
          k_sem, v_sem, ks_sem, vs_sem) = refs
@@ -110,21 +136,22 @@ def _decode_kernel(meta_ref, qmat_ref, *refs, scale, block_k, b, hp, hd,
         for c in block_copies(i, slot):
             c.wait()
         pos = i * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, hp), 0)
+            jnp.int32, (block_k, s * hp), 0)
         ms, ls, accs = [], [], []
         for bi in range(b):                        # static unroll
-            live = pos < meta_ref[1 + bi]          # row bi's filled prefix
+            live = _spec_live_mask(pos, meta_ref[1 + bi], s, hp,
+                                   (block_k, s * hp))
             kbk = k_buf[slot, bi].astype(jnp.float32)   # [bk, h*d]
             vbk = v_buf[slot, bi].astype(jnp.float32)
             if quantized:
                 kbk = kbk * ks_buf[slot, bi][:, None]
                 vbk = vbk * vs_buf[slot, bi][:, None]
-            qmat = qmat_ref[bi].astype(jnp.float32)     # [h*d, hp]
-            s = jax.lax.dot(kbk, qmat,
-                            preferred_element_type=jnp.float32) * scale
-            s = jnp.where(live, s, NEG_INF)
-            m_new = jnp.maximum(m_prev[bi], jnp.max(s, axis=0))
-            p = jnp.exp(s - m_new[None, :])
+            qmat = qmat_ref[bi].astype(jnp.float32)     # [h*d, s*hp]
+            sc = jax.lax.dot(kbk, qmat,
+                             preferred_element_type=jnp.float32) * scale
+            sc = jnp.where(live, sc, NEG_INF)
+            m_new = jnp.maximum(m_prev[bi], jnp.max(sc, axis=0))
+            p = jnp.exp(sc - m_new[None, :])
             corr = jnp.exp(m_prev[bi] - m_new)
             l_new = l_prev[bi] * corr + jnp.sum(p, axis=0)
             # p^T @ v: [hp, h*d]; row g = every segment under head-g weights
@@ -135,9 +162,9 @@ def _decode_kernel(meta_ref, qmat_ref, *refs, scale, block_k, b, hp, hd,
             accs.append(acc[bi] * corr[:, None] + pv)
         return (jnp.stack(ms), jnp.stack(ls), jnp.stack(accs))
 
-    m0 = jnp.full((b, hp), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hp), jnp.float32)
-    a0 = jnp.zeros((b, hp, hd), jnp.float32)
+    m0 = jnp.full((b, s * hp), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, s * hp), jnp.float32)
+    a0 = jnp.zeros((b, s * hp, hd), jnp.float32)
     _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
     l_safe = jnp.where(l == 0, 1.0, l)
     o_ref[...] = (acc / l_safe[:, :, None]).astype(o_ref.dtype)
@@ -179,13 +206,40 @@ def _choose_block(b: int, S: int, h: int, d: int, itemsize: int,
     return bk
 
 
-def pallas_decode_supported(b: int, S: int, h: int, d: int, dtype) -> bool:
+def pallas_decode_supported(b: int, S: int, h: int, d: int, dtype,
+                            s: int = 1) -> bool:
     """Callers choosing a cache LAYOUT (models/gpt.py flat cache) must agree
     with the kernel's own feasibility test — a flat cache whose every decode
-    falls back to the XLA path would pay a full-cache relayout per token."""
+    falls back to the XLA path would pay a full-cache relayout per token.
+    ``s``: query positions per lane (1 = plain decode, 2..MAX_SPEC_S = the
+    speculative-verify shape)."""
+    if not 1 <= s <= MAX_SPEC_S:
+        return False
     if (h * d) % 128 != 0:
         return False
     return _choose_block(b, S, h, d, jnp.dtype(dtype).itemsize) is not None
+
+
+def _spec_qmat(q: jnp.ndarray, hp: int) -> jnp.ndarray:
+    """Block-diagonal query matrix for s query positions:
+    qmat[b, g*d + j, i*hp + g] = q[b, i, g, j] — column group i holds
+    position i's block-diagonal so all s*h per-head dots are one MXU
+    matmul against the flat [bk, h*d] cache block."""
+    b, s, h, d = q.shape
+    eye = jnp.eye(h, hp, dtype=q.dtype)                     # [h, hp]
+    return jnp.einsum("bshd,hg->bhdsg", q, eye).reshape(b, h * d, s * hp)
+
+
+def _slice_block_diagonal(out: jnp.ndarray, s: int, h: int,
+                          d: int) -> jnp.ndarray:
+    """Invert the block-diagonal packing: kernel output row i*hp + g holds
+    every head's segment weighted under (query i, head g); the real output
+    is segment g of that row -> [b, s, h, d]."""
+    b, sp, hd = out.shape
+    hp = sp // s
+    out = out.reshape(b, s, hp, hd)[:, :, :h].reshape(b, s, h, h, d)
+    out = jnp.diagonal(out, axis1=2, axis2=3)               # [b, s, d, h]
+    return out.transpose(0, 1, 3, 2)                        # [b, s, h, d]
 
 
 def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
@@ -209,7 +263,9 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
     ``k_scale``/``v_scale`` [b, S] f32 mark an int8 cache
     (kv_cache_dtype="int8"): per-position dequant multipliers, applied in
     VMEM on the Pallas path and before the masked einsum on the fallback.
-    Returns [b, 1, h, d]."""
+    ``s_q`` in 2..MAX_SPEC_S is the speculative-verify shape and stays on
+    the kernel (s-position qmat); wider s_q (prefill) falls back.
+    Returns [b, s_q, h, d] (so [b, 1, h, d] for plain decode)."""
     b, s_q, h, d = q.shape
     S = cached_key.shape[1]
     cache_len = jnp.minimum(jnp.asarray(cache_len, jnp.int32), S)
@@ -219,7 +275,7 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
                        block_k)
     flat = cached_key.ndim == 3
     quantized = k_scale is not None
-    if s_q != 1 or bk is None or (h * d) % 128 != 0:
+    if not 1 <= s_q <= MAX_SPEC_S or bk is None or (h * d) % 128 != 0:
         if quantized:
             from ..quantizer import dequantize_kv
             sk = k_scale[..., None] if flat else k_scale[..., None, None]
@@ -233,10 +289,8 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
 
     hp = -(-h // 8) * 8
     hd = h * d
-    # block-diagonal query: qmat[g*d + j, g] = q[g, j]
-    qt = q[:, 0]                                            # [b, h, d]
-    eye = jnp.eye(h, hp, dtype=q.dtype)                     # [h, hp]
-    qmat = jnp.einsum("bhd,hg->bhdg", qt, eye).reshape(b, hd, hp)
+    # block-diagonal query: qmat[g*d + j, i*hp + g] = q[i, g, j]
+    qmat = _spec_qmat(q, hp)                                # [b, hd, s*hp]
 
     clen = jnp.broadcast_to(jnp.asarray(cache_len, jnp.int32), (b,))
     # DMA window sized by the deepest row; shallower rows mask in-kernel
@@ -250,9 +304,10 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
         vf = cached_value.reshape(b, S, hd)
 
     kernel = functools.partial(_decode_kernel, scale=scale, block_k=bk,
-                               b=b, hp=hp, hd=hd, quantized=quantized)
+                               b=b, hp=hp, hd=hd, quantized=quantized,
+                               s=s_q)
     in_specs = [
-        pl.BlockSpec((b, hd, hp), lambda g, meta: (0, 0, 0)),
+        pl.BlockSpec((b, hd, s_q * hp), lambda g, meta: (0, 0, 0)),
         # the cache never enters VMEM wholesale: the kernel DMAs only
         # live blocks out of HBM
         pl.BlockSpec(memory_space=_MEM_HBM),
@@ -277,18 +332,16 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
         num_scalar_prefetch=1,
         grid=(1,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((b, hp, hd), lambda g, meta: (0, 0, 0)),
+        out_specs=pl.BlockSpec((b, s_q * hp, hd), lambda g, meta: (0, 0, 0)),
         scratch_shapes=scratch + sems,
     )
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hp, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, s_q * hp, hd), q.dtype),
         interpret=interpret_mode(),
     )(*operands)
-    # block diagonal: head g's output is row g, segment g
-    out = out[:, :h].reshape(b, h, h, d)
-    out = jnp.diagonal(out, axis1=1, axis2=2)               # [b, d, h]
-    return out.transpose(0, 2, 1).reshape(b, 1, h, d)
+    # block diagonal: (query i, head g)'s output is row i*hp+g, segment g
+    return _slice_block_diagonal(out, s_q, h, d)
 
 
 # --------------------------------------------------------------------------
@@ -296,7 +349,7 @@ def decode_attention(q: jnp.ndarray, cached_key: jnp.ndarray,
 # --------------------------------------------------------------------------
 
 def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, *refs, scale, b, hp,
-                         hd, bs, nb_total, quantized=False):
+                         hd, bs, nb_total, quantized=False, s=1):
     """Paged variant of :func:`_decode_kernel`. k_hbm/v_hbm are the FULL
     block pools [nb_total, bs, h*d] in HBM; each fori step DMAs one
     block PER ROW (rows no longer share a contiguous window — that is
@@ -309,7 +362,9 @@ def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, *refs, scale, b, hp,
     into the pool and masked dead by the fill. ``quantized``: int8 pools
     with per-position f32 dequant multiplier pools ks_hbm/vs_hbm
     [nb_total, bs], DMA'd per-(row, block) alongside the payload and
-    applied in VMEM."""
+    applied in VMEM. ``s``: static query positions per lane (the
+    speculative-verify shape — same widened qmat / staggered mask as
+    :func:`_decode_kernel`)."""
     if quantized:
         (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf, vs_buf,
          k_sem, v_sem, ks_sem, vs_sem) = refs
@@ -348,23 +403,24 @@ def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, *refs, scale, b, hp,
                 for c in row_copies(nxt, ns, bi):
                     c.start()
 
-        pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, hp), 0)
+        pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bs, s * hp), 0)
         ms, ls, accs = [], [], []
         for bi in range(b):                    # static unroll
             for c in row_copies(i, slot, bi):
                 c.wait()
-            live = pos < meta_ref[1 + bi]
+            live = _spec_live_mask(pos, meta_ref[1 + bi], s, hp,
+                                   (bs, s * hp))
             kbk = k_buf[slot, bi].astype(jnp.float32)     # [bs, h*d]
             vbk = v_buf[slot, bi].astype(jnp.float32)
             if quantized:
                 kbk = kbk * ks_buf[slot, bi][:, None]
                 vbk = vbk * vs_buf[slot, bi][:, None]
-            qmat = qmat_ref[bi].astype(jnp.float32)       # [h*d, hp]
-            s = jax.lax.dot(kbk, qmat,
-                            preferred_element_type=jnp.float32) * scale
-            s = jnp.where(live, s, NEG_INF)
-            m_new = jnp.maximum(m_prev[bi], jnp.max(s, axis=0))
-            p = jnp.exp(s - m_new[None, :])
+            qmat = qmat_ref[bi].astype(jnp.float32)       # [h*d, s*hp]
+            sc = jax.lax.dot(kbk, qmat,
+                             preferred_element_type=jnp.float32) * scale
+            sc = jnp.where(live, sc, NEG_INF)
+            m_new = jnp.maximum(m_prev[bi], jnp.max(sc, axis=0))
+            p = jnp.exp(sc - m_new[None, :])
             corr = jnp.exp(m_prev[bi] - m_new)
             l_new = l_prev[bi] * corr + jnp.sum(p, axis=0)
             pv = jax.lax.dot_general(p, vbk, (((0,), (0,)), ((), ())),
@@ -374,19 +430,22 @@ def _paged_decode_kernel(meta_ref, bt_ref, qmat_ref, *refs, scale, b, hp,
             accs.append(acc[bi] * corr[:, None] + pv)
         return (jnp.stack(ms), jnp.stack(ls), jnp.stack(accs))
 
-    m0 = jnp.full((b, hp), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hp), jnp.float32)
-    a0 = jnp.zeros((b, hp, hd), jnp.float32)
+    m0 = jnp.full((b, s * hp), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, s * hp), jnp.float32)
+    a0 = jnp.zeros((b, s * hp, hd), jnp.float32)
     _, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, a0))
     l_safe = jnp.where(l == 0, 1.0, l)
     o_ref[...] = (acc / l_safe[:, :, None]).astype(o_ref.dtype)
 
 
 def paged_decode_supported(b: int, block_size: int, h: int, d: int,
-                           dtype) -> bool:
+                           dtype, s: int = 1) -> bool:
     """Kernel feasibility for the paged layout: lane-aligned h*d,
-    sublane-aligned block_size (the DMA unit), and the double-buffered
-    staging window within the VMEM budget."""
+    sublane-aligned block_size (the DMA unit), the double-buffered
+    staging window within the VMEM budget, and the query width s within
+    the in-kernel speculative-verify range (1..MAX_SPEC_S)."""
+    if not 1 <= s <= MAX_SPEC_S:
+        return False
     if (h * d) % 128 != 0:
         return False
     itemsize = jnp.dtype(dtype).itemsize
@@ -443,19 +502,17 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     quantized = k_scale is not None
-    if (impl == "pallas" and s_q == 1
-            and paged_decode_supported(b, bs, h, d, k_pool.dtype)):
+    if (impl == "pallas"
+            and paged_decode_supported(b, bs, h, d, k_pool.dtype, s_q)):
         hp = -(-h // 8) * 8
-        qt = q[:, 0]
-        eye = jnp.eye(h, hp, dtype=q.dtype)
-        qmat = jnp.einsum("bhd,hg->bhdg", qt, eye).reshape(b, hd, hp)
+        qmat = _spec_qmat(q, hp)                        # [b, hd, s*hp]
         nb_live = jnp.clip((jnp.max(clen) + bs - 1) // bs, 1, T)
         meta = jnp.concatenate([nb_live[None], clen])
         kernel = functools.partial(
             _paged_decode_kernel, scale=scale, b=b, hp=hp, hd=hd,
-            bs=bs, nb_total=nb, quantized=quantized)
+            bs=bs, nb_total=nb, quantized=quantized, s=s_q)
         in_specs = [
-            pl.BlockSpec((b, hd, hp), lambda g, meta, bt: (0, 0, 0)),
+            pl.BlockSpec((b, hd, s_q * hp), lambda g, meta, bt: (0, 0, 0)),
             pl.BlockSpec(memory_space=_MEM_HBM),
             pl.BlockSpec(memory_space=_MEM_HBM),
         ]
@@ -480,18 +537,16 @@ def paged_decode_attention(q: jnp.ndarray, k_pool: jnp.ndarray,
             num_scalar_prefetch=2,          # meta + block tables
             grid=(1,),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((b, hp, hd),
+            out_specs=pl.BlockSpec((b, s_q * hp, hd),
                                    lambda g, meta, bt: (0, 0, 0)),
             scratch_shapes=scratch + sems,
         )
         out = pl.pallas_call(
             kernel, grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((b, hp, hd), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((b, s_q * hp, hd), q.dtype),
             interpret=interpret_mode(),
         )(*operands)
-        out = out[:, :h].reshape(b, h, h, d)
-        out = jnp.diagonal(out, axis1=1, axis2=2)           # [b, d, h]
-        return out.transpose(0, 2, 1).reshape(b, 1, h, d)
+        return _slice_block_diagonal(out, s_q, h, d)
     kflat = paged_gather_kv(k_pool, block_tables)
     vflat = paged_gather_kv(v_pool, block_tables)
     if quantized:

@@ -1,0 +1,212 @@
+"""Sort-free fused sampling epilogue: top-k/top-p filter + draw, one kernel.
+
+Reference analogue: DeepSpeed's fused-softmax/sampling epilogues — the last
+ops of every decode step run fused instead of as a separate XLA subgraph.
+The composed path (serving/sampling.filter_logits + sample_tokens) pays a
+``top_k`` partial sort plus a FULL [V] sort for nucleus filtering plus a
+``categorical`` draw — three HBM round-trips over the logits per decode
+step. This kernel keeps the [V] row in VMEM once and replaces both sorts
+with monotonic-int bisections:
+
+  * order keys: an IEEE-754 trick — ``bitcast(f32 -> i32)`` then reflect
+    the negative range (``INT32_MAX - bits``, wraparound intended) gives a
+    SIGNED int32 key that is strictly monotonic in the float order, so
+    "the k-th largest logit" becomes an exact integer bisection (~32
+    count-reductions over the VMEM-resident row), never a sort;
+  * top-k: bisect for the largest key ``t`` with ``count(key >= t) >= k``
+    — exactly ``jax.lax.top_k``'s k-th value, ties kept like the
+    reference's ``logits < kth`` mask;
+  * top-p: bisect on kept probability mass — find the largest key ``T``
+    with ``mass(key > T) >= p``; the cut value is the smallest present
+    key above ``T``. The kept SET matches the reference's minimal-
+    covering-set semantics up to f32 summation rounding on the mass
+    comparison (the reference cumsums post-division, we sum exps and
+    compare against ``p * Z``);
+  * draw: greedy is a first-index argmax (bit-identical to
+    ``jnp.argmax``); temperature sampling is Gumbel-max over the filtered
+    row (``argmax(x + g)`` with caller-supplied gumbel noise), the same
+    distribution ``jax.random.categorical`` draws from.
+
+Greedy outputs are bit-identical to the composed path — the megakernel
+correctness contract. Temperature > 0 draws are distributionally
+identical but consume a different rng stream than ``categorical``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (parity with sibling kernels)
+
+from ._utils import interpret_mode
+
+_NEG_CAP = -1e10                 # the reference filter's masked-logit value
+_INT32_MAX = 2147483647          # python int: jnp arrays here would be
+#                                  closure-captured consts the kernel rejects
+
+# One f32 logits row (+ optional gumbel row) must sit in VMEM next to the
+# kernel's reduction temporaries; cap the vocab well under the arena.
+_MAX_VOCAB = 256 * 1024
+_BISECT_ITERS = 33               # > log2(int32 key range): exact convergence
+
+
+def _order_key(x: jnp.ndarray) -> jnp.ndarray:
+    """Strictly monotonic f32 -> i32 order key. Non-negative floats keep
+    their bit pattern; negative floats reflect (``INT32_MAX - bits``
+    wraps for -0.0 by design) so every negative key < every non-negative
+    key and ordering matches the float order. Finite inputs only."""
+    b = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int32)
+    return jnp.where(b >= 0, b, _INT32_MAX - b)
+
+
+def _mid(lo, hi):
+    # overflow-safe floor((lo + hi) / 2) for int32 of either sign
+    return (lo >> 1) + (hi >> 1) + (lo & hi & 1)
+
+
+def _bisect_kth_key(key: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Exact k-th largest key: the largest t with count(key >= t) >= k.
+    Invariant: count(>= lo) >= k, count(>= hi) < k."""
+    lo = jnp.min(key)
+    hi = jnp.max(key) + 1        # finite floats: max key < INT32_MAX
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = _mid(lo, hi)
+        c = jnp.sum((key >= mid).astype(jnp.int32))
+        take = c >= k
+        return (jnp.where(take, mid, lo), jnp.where(take, hi, mid))
+
+    lo, _ = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
+    return lo
+
+
+def _bisect_top_p_key(key: jnp.ndarray, e: jnp.ndarray,
+                      pz: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus cut key: with e = exp(x - max) and pz = top_p * sum(e),
+    find the largest key T whose strictly-above mass still reaches pz,
+    then cut at the smallest present key above T (the reference's minimal
+    covering set: a token survives iff the mass strictly above it is
+    < top_p). Invariant: mass(> lo) >= pz, mass(> hi) < pz."""
+    lo = jnp.min(key) - 1
+    hi = jnp.max(key)            # mass(> max) == 0 < pz for top_p > 0
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = _mid(lo, hi)
+        mass = jnp.sum(jnp.where(key > mid, e, 0.0))
+        take = mass >= pz
+        return (jnp.where(take, mid, lo), jnp.where(take, hi, mid))
+
+    lo, _ = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
+    return jnp.min(jnp.where(key > lo, key, _INT32_MAX))
+
+
+def _filter_row(x: jnp.ndarray, top_k: Optional[int],
+                top_p: Optional[float]) -> jnp.ndarray:
+    """The shared row transform, semantics of serving.sampling.filter_logits
+    with the sorts replaced by bisections. x: [1, V] f32, ALREADY
+    temperature-scaled by the wrapper — scaling outside the kernel keeps
+    kept values bitwise identical to the reference (the in-kernel divide
+    can round differently from the surrounding program's), and the kernel
+    itself only compares and masks."""
+    v = x.shape[-1]
+    if top_k is not None and top_k < v:
+        key = _order_key(x)
+        kth = _bisect_kth_key(key, top_k)
+        x = jnp.where(key >= kth, x, _NEG_CAP)
+    if top_p is not None and top_p < 1.0:
+        key = _order_key(x)
+        m = jnp.max(x)
+        e = jnp.exp(x - m)       # masked entries underflow to exact zeros
+        pz = jnp.float32(top_p) * jnp.sum(e)
+        kth = _bisect_top_p_key(key, e, pz)
+        x = jnp.where(key >= kth, x, _NEG_CAP)
+    return x
+
+
+def _sampling_kernel(logits_ref, *rest, temperature, top_k, top_p, v,
+                     emit):
+    """Grid programs over rows (logits pre-scaled by temperature).
+    emit='logits' writes the filtered row; emit='tokens' additionally
+    draws (argmax, or Gumbel-max when a gumbel row operand is present)
+    and writes one int32 per row."""
+    if emit == "tokens" and temperature != 0.0:
+        gumbel_ref, out_ref = rest
+    else:
+        (out_ref,) = rest
+    x = logits_ref[...].astype(jnp.float32)            # [1, v]
+    x = _filter_row(x, top_k, top_p)
+    if emit == "logits":
+        out_ref[...] = x
+        return
+    if temperature != 0.0:
+        x = x + gumbel_ref[...]
+    m = jnp.max(x)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (1, v), 1)
+    # first-index argmax: identical tie-break to jnp.argmax
+    out_ref[0, 0] = jnp.min(jnp.where(x == m, idx, jnp.int32(v)))
+
+
+def sampling_supported(b: int, v: int) -> bool:
+    """Kernel feasibility: lane-aligned vocab that fits the VMEM row
+    budget. Callers fall back to the sort-based reference otherwise."""
+    return b >= 1 and v % 128 == 0 and v <= _MAX_VOCAB
+
+
+def threshold_filter_logits(logits: jnp.ndarray, temperature: float,
+                            top_k: Optional[int],
+                            top_p: Optional[float] = None) -> jnp.ndarray:
+    """Fused sort-free filter over [b, V] logits -> filtered f32 [b, V].
+    Same masked-logit contract as serving.sampling.filter_logits (masked
+    entries pinned at -1e10); caller guarantees sampling_supported()."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    if temperature != 0.0:
+        logits = logits / temperature
+    kernel = functools.partial(_sampling_kernel, temperature=temperature,
+                               top_k=top_k, top_p=top_p, v=v, emit="logits")
+    return pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, v), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, v), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, v), jnp.float32),
+        interpret=interpret_mode(),
+    )(logits)
+
+
+def fused_sample(logits: jnp.ndarray, gumbel: Optional[jnp.ndarray],
+                 temperature: float, top_k: Optional[int],
+                 top_p: Optional[float] = None) -> jnp.ndarray:
+    """Fused filter + draw over [b, V] logits -> int32 tokens [b].
+    temperature == 0: first-index argmax, bit-identical to the composed
+    greedy path. temperature > 0: Gumbel-max with the caller's [b, V]
+    gumbel noise. Caller guarantees sampling_supported()."""
+    b, v = logits.shape
+    sample = temperature != 0.0
+    logits = logits.astype(jnp.float32)
+    if sample:
+        logits = logits / temperature
+    kernel = functools.partial(_sampling_kernel, temperature=temperature,
+                               top_k=top_k, top_p=top_p, v=v, emit="tokens")
+    in_specs = [pl.BlockSpec((1, v), lambda i: (i, 0))]
+    operands = [logits]
+    if sample:
+        if gumbel is None:
+            raise ValueError("temperature != 0 needs gumbel noise")
+        in_specs.append(pl.BlockSpec((1, v), lambda i: (i, 0)))
+        operands.append(gumbel.astype(jnp.float32))
+    out = pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 1), jnp.int32),
+        interpret=interpret_mode(),
+    )(*operands)
+    return out[:, 0]

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
@@ -53,55 +54,12 @@ from ..telemetry import core as telemetry
 from ..utils.logging import log_dist
 from .kv_cache import SlotKVCacheManager
 from .metrics import ServingMetrics
+# The sampling policy moved to serving/sampling.py (one reference shared
+# by the engine, the speculative verifier, and the fused Pallas epilogue);
+# re-exported here for API stability.
+from .sampling import (filter_logits, fused_filter_logits,  # noqa: F401
+                       fused_sample_tokens, sample_tokens)
 from .scheduler import ContinuousBatchScheduler, Request
-
-
-def filter_logits(logits, temperature: float, top_k: Optional[int],
-                  top_p: Optional[float] = None):
-    """Temperature / top-k / nucleus (top-p) filtering over [..., V]
-    logits, in f32. The filtered logits DEFINE the sampling distribution:
-    ``sample_tokens`` draws ``categorical(filter_logits(...))``, and the
-    speculative verifier (serving/speculative.verify_rejection) softmaxes
-    the same function — acceptance math matches the sampler exactly
-    because they share this code.
-
-    Every temperature != 0 takes the same path (x / 1.0 is the bitwise
-    identity, so temperature=1.0 no longer skips the scaling branch — the
-    old ``not in (0.0, 1.0)`` guard forked the code path for no numeric
-    effect). top-p keeps the smallest set of tokens whose cumulative
-    probability reaches ``top_p`` (the argmax token always survives);
-    applied after top-k when both are set."""
-    import jax
-    import jax.numpy as jnp
-    logits = logits.astype(jnp.float32)
-    if temperature != 0.0:
-        logits = logits / temperature
-    if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -1e10, logits)
-    if top_p is not None:
-        srt = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-        probs = jax.nn.softmax(srt, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep token i while the mass BEFORE it is < top_p: the first
-        # token is always kept, and the set is the minimal one covering p
-        keep = (cum - probs) < top_p
-        kth = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
-                      keepdims=True)
-        logits = jnp.where(logits < kth, -1e10, logits)
-    return logits
-
-
-def sample_tokens(logits, rng, temperature: float, top_k: Optional[int],
-                  top_p: Optional[float] = None):
-    """Greedy / temperature / top-k / top-p sampling over [b, V] logits —
-    the same policy as InferenceEngine.generate's sampler."""
-    import jax
-    import jax.numpy as jnp
-    logits = filter_logits(logits, temperature, top_k, top_p)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def default_prefill_buckets(max_prompt_len: int) -> List[int]:
@@ -141,6 +99,9 @@ class _InflightChunk:
     # dispatch-complete stamp (profiler clock); 0.0 when no profiler is
     # attached — the chunk timeline lane anchors device spans on it
     launch_t: float = 0.0
+    # unconditional perf_counter stamp at launch: the collective-overlap
+    # gauge accumulates launch->retire wall seconds from it
+    wall_t0: float = 0.0
 
 
 def _load_tuned_config(tuned_config) -> Dict[str, Any]:
@@ -220,6 +181,7 @@ class ServingEngine:
                  tp: int = 1,
                  disaggregate_prefill: bool = False,
                  fused_prefill: bool = False,
+                 megakernel: bool = False,
                  prefill_chunk: int = 16,
                  chunk_token_budget: Optional[int] = None,
                  sp_prefill_threshold: Optional[int] = None,
@@ -287,6 +249,33 @@ class ServingEngine:
             # from this module's eval_shape
             cfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
             self.module = type(self.module)(cfg)
+        # ---- fused decode megakernel ----
+        # One knob flips the decode stack onto the fused fast path: the
+        # Pallas decode kernel (int8 dequant inside the DMA window,
+        # in-kernel k+1 speculative verify — decode_impl "auto" resolves
+        # to it on TPU and to the partition-friendly einsum elsewhere,
+        # so CPU parity gates run the program they always did), the
+        # sort-free sampling epilogue (ops/pallas/sampling.py, swapped in
+        # below), and — when the mesh has a tp axis under a parallel-
+        # residual model — the RS/AG collective/MLP overlap
+        # (ops/tp_overlap.py). Greedy outputs are bit-identical with the
+        # knob on or off (the megakernel contract, gated by tests);
+        # temperature > 0 draws are distributionally identical but
+        # consume the rng as Gumbel noise instead of ``categorical``'s
+        # internal stream.
+        self.megakernel = bool(megakernel)
+        if self.megakernel:
+            rebuild = {}
+            if getattr(cfg, "decode_impl", None) == "xla":
+                rebuild["decode_impl"] = "auto"
+            if (self.tp > 1 and getattr(cfg, "parallel_residual", False)
+                    and hasattr(cfg, "tp_overlap")):
+                rebuild["tp_overlap"] = True
+            if rebuild:
+                cfg = dataclasses.replace(cfg, **rebuild)
+                self.module = type(self.module)(cfg)
+        self._overlap_active = bool(getattr(cfg, "tp_overlap", False))
+        self._overlap_seconds = 0.0
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq)
         self.max_prompt_len = int(max_prompt_len or max_seq)
@@ -508,6 +497,13 @@ class ServingEngine:
         module = self.module
         temperature_, top_k_ = self.temperature, self.top_k
         top_p_ = self.top_p
+        # megakernel: every sampler call in the compiled programs routes
+        # through the fused Pallas epilogue (unsupported vocab shapes
+        # fall back to the reference INSIDE the router, so the program
+        # never forks on shape), and the speculative verifier filters
+        # with the same fused kernel
+        sample_ = fused_sample_tokens if self.megakernel else sample_tokens
+        spec_filter_ = fused_filter_logits if self.megakernel else None
         max_seq_ = self.max_seq_len
         B_ = self.max_batch
         spec_k_ = self.spec_k
@@ -524,7 +520,7 @@ class ServingEngine:
                 logits = logits[0]
             last = jnp.take_along_axis(
                 logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]  # [n,V]
-            tok = sample_tokens(last, rng, temperature_, top_k_, top_p_)
+            tok = sample_(last, rng, temperature_, top_k_, top_p_)
             return tok, vc["cache"]
 
         # sequence-parallel (Ulysses) prefill for very long prompts: the
@@ -553,7 +549,7 @@ class ServingEngine:
                 logits = logits[0]
             last = jnp.take_along_axis(
                 logits, (true_lens - 1)[:, None, None], axis=1)[:, 0]
-            tok = sample_tokens(last, rng, temperature_, top_k_, top_p_)
+            tok = sample_(last, rng, temperature_, top_k_, top_p_)
             return tok, vc["cache"]
 
         def decode(params, cache, tokens, positions, rng):
@@ -568,8 +564,8 @@ class ServingEngine:
                 positions=positions[:, None], mutable=["cache"])
             if isinstance(logits, tuple):
                 logits = logits[0]
-            tok = sample_tokens(logits[:, -1], rng, temperature_, top_k_,
-                                top_p_)
+            tok = sample_(logits[:, -1], rng, temperature_, top_k_,
+                          top_p_)
             return tok, vc["cache"]
 
         def _with_write_index(cache, write_pos):
@@ -599,8 +595,8 @@ class ServingEngine:
                 if isinstance(logits, tuple):
                     logits = logits[0]
                 key, sub = jax.random.split(key)
-                nxt = sample_tokens(logits[:, -1], sub,
-                                    temperature_, top_k_, top_p_)
+                nxt = sample_(logits[:, -1], sub,
+                              temperature_, top_k_, top_p_)
                 nxt = jnp.where(act, nxt, tok)       # frozen lanes hold
                 emitted = act                        # validity of nxt
                 rem = jnp.where(act, rem - 1, rem)
@@ -659,7 +655,8 @@ class ServingEngine:
                 else:
                     key_n, sub = jax.random.split(key)
                     emitted, acc = verify_rejection(
-                        logits, drafts, sub, temperature_, top_k_, top_p_)
+                        logits, drafts, sub, temperature_, top_k_, top_p_,
+                        filter_fn=spec_filter_)
                 # candidate validity: live lane, within the accepted
                 # prefix (+ the correction/bonus at j == acc), within the
                 # remaining token budget
@@ -739,8 +736,8 @@ class ServingEngine:
                 sel = jnp.where(is_pf, jnp.maximum(n_cons - 1, 0), 0)
                 last = jnp.take_along_axis(
                     logits, sel[:, None, None], axis=1)[:, 0]   # [B, V]
-                nxt = sample_tokens(last, sub, temperature_, top_k_,
-                                    top_p_)
+                nxt = sample_(last, sub, temperature_, top_k_,
+                              top_p_)
                 emits = jnp.logical_and(
                     act, jnp.logical_or(completing,
                                         jnp.logical_not(is_pf)))
@@ -889,9 +886,11 @@ class ServingEngine:
         # distinct function name => distinct TraceAuditor budget: every
         # fused / spec / int8 / paged combination is a different compiled
         # program family whose retrace count is pinned separately
-        # ("decode_chunk" + "_fused"? + "_spec"? + "_int8"? + "_paged"?
-        # + "_fn")
+        # ("decode_chunk" + "_megakernel"? + "_fused"? + "_spec"? +
+        # "_int8"? + "_paged"? + "_fn")
         variant = "decode_chunk"
+        if self.megakernel:
+            variant += "_megakernel"
         if self.fused_prefill:
             variant += "_fused"
         if self.speculative:
@@ -1857,7 +1856,8 @@ class ServingEngine:
             self.kv.update(new_cache)
         inflight = _InflightChunk(
             slot_uids={s: r.uid for s, r in self.scheduler.running.items()},
-            tokens=toks, valid=valid, state=carry)
+            tokens=toks, valid=valid, state=carry,
+            wall_t0=time.perf_counter())
         if prof is not None:
             t1 = prof.clock()
             inflight.launch_t = t1
@@ -1876,6 +1876,12 @@ class ServingEngine:
             toks = np.asarray(chunk.tokens)
             valid = np.asarray(chunk.valid)
         rt0 = prof.clock() if prof is not None else 0.0
+        if self._overlap_active and chunk.wall_t0:
+            # cumulative wall seconds of decode chunks served with the
+            # RS/AG collective/MLP overlap decomposition active
+            self._overlap_seconds += time.perf_counter() - chunk.wall_t0
+            telemetry.gauge("serve/collective_overlap_s",
+                            self._overlap_seconds)
         inline_tokens = 0
         n_first = 0
         pf_steps = None

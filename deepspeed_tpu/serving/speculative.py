@@ -116,22 +116,28 @@ def verify_greedy(logits: jnp.ndarray, drafts: jnp.ndarray
 
 
 def verify_rejection(logits: jnp.ndarray, drafts: jnp.ndarray, key,
-                     temperature: float, top_k, top_p
+                     temperature: float, top_k, top_p, filter_fn=None
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Rejection-resampling verification at temperature > 0 against the
     SAME filtered distribution ``sample_tokens`` draws from (temperature /
-    top-k / top-p applied before the softmax — serving/engine.py
+    top-k / top-p applied before the softmax — serving/sampling.py
     filter_logits). Draft j is accepted with probability p_j(d_j) (the
     delta-drafter accept rule); the first rejected position resamples
     from the residual (p_j with the draft index zeroed, renormalized),
     and a fully-accepted chunk samples a bonus token from p_k. Returns
     ``(emitted [B, k+1], acc [B])`` with positions 0..acc real — the
-    emitted tokens are distributed exactly as k+1 sequential draws."""
-    from .engine import filter_logits
+    emitted tokens are distributed exactly as k+1 sequential draws.
+
+    ``filter_fn`` overrides the logit filter (the megakernel engine
+    passes serving/sampling.fused_filter_logits so the filter runs in the
+    sort-free Pallas epilogue); it must implement filter_logits'
+    masked-logit contract."""
+    if filter_fn is None:
+        from .sampling import filter_logits as filter_fn
     B, kp1, _ = logits.shape
     k = kp1 - 1
     probs = jax.nn.softmax(
-        filter_logits(logits, temperature, top_k, top_p), axis=-1)
+        filter_fn(logits, temperature, top_k, top_p), axis=-1)
     ukey, rkey, bkey = jax.random.split(key, 3)
     p_draft = jnp.take_along_axis(
         probs[:, :k], drafts[..., None], axis=-1)[..., 0]    # [B, k]

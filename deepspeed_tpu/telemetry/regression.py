@@ -149,6 +149,20 @@ SERVING_SPECS: List[MetricSpec] = [
     MetricSpec(("tiered", "promote_failures"), SHIFT, abs_tol=0.0,
                note="a failed promotion degrades that request to a "
                     "re-prefill — zero on the pinned workload"),
+    # ---- fused decode megakernel (--megakernel A/B vs composed) ----
+    MetricSpec(("megakernel", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="megakernel vs composed greedy bit-exactness is "
+                    "binary — the fused epilogue must not move a ulp"),
+    MetricSpec(("megakernel", "variant_isolation"), SHIFT, abs_tol=0.0,
+               note="the _megakernel variant must never compile under "
+                    "the composed variant's name (cache isolation)"),
+    MetricSpec(("megakernel", "decode_chunk_compiles"), SHIFT,
+               abs_tol=0.0, note="pinned megakernel retrace budget"),
+    MetricSpec(("megakernel", "paged", "greedy_parity"), SHIFT,
+               abs_tol=0.0),
+    MetricSpec(("megakernel", "paged", "decode_chunk_compiles"), SHIFT,
+               abs_tol=0.0, note="pinned paged megakernel retrace "
+                                 "budget"),
 ]
 
 FRONTEND_SPECS: List[MetricSpec] = [
@@ -334,10 +348,39 @@ FLEET_SPECS: List[MetricSpec] = [
                     "the hard bound is asserted inside the bench"),
 ]
 
+KERNELS_SPECS: List[MetricSpec] = [
+    # ---- BENCH_kernels.json (benchmarks/kernels_bench.py) ----
+    MetricSpec(("megakernel", "greedy_parity"), SHIFT, abs_tol=0.0,
+               note="composed-vs-fused spec int8 paged decode "
+                    "bit-exactness is binary"),
+    MetricSpec(("megakernel", "filter_bitwise"), SHIFT, abs_tol=0.0,
+               note="sort-free filter output is bitwise vs the sorted "
+                    "reference"),
+    MetricSpec(("megakernel", "greedy_token_bitwise"), SHIFT,
+               abs_tol=0.0),
+    MetricSpec(("megakernel", "speedup_spec_int8_paged"), HIGHER, 0.25,
+               note="fused over composed; the >= 1.5x floor is asserted "
+                    "inside the bench (roofline proxy on CPU, measured "
+                    "on TPU)"),
+    MetricSpec(("megakernel", "traffic_ratio"), HIGHER, 0.10,
+               note="HBM bytes composed/fused is deterministic "
+                    "geometry"),
+    MetricSpec(("tp_overlap", "tp2_overlapped_vs_tp1_unhidden"), LOWER,
+               0.10, note="overlapped tp=2 step over tp=1; the <= 0.6 "
+                          "ceiling is asserted inside the bench "
+                          "(analytic step model)"),
+    MetricSpec(("tp_overlap", "tp2_overlap_gain"), HIGHER, 0.10,
+               note="unhidden over overlapped tp=2 step"),
+    MetricSpec(("decode_microbench", "value"), HIGHER, 0.30,
+               note="op-level Pallas-vs-XLA decode speedup (bench.py "
+                    "case); null (skipped) on CPU hosts"),
+]
+
 SPEC_SETS: Dict[str, List[MetricSpec]] = {
     "serving": SERVING_SPECS,
     "frontend": FRONTEND_SPECS,
     "fleet": FLEET_SPECS,
+    "kernels": KERNELS_SPECS,
 }
 
 
@@ -348,6 +391,8 @@ def detect_kind(doc: Dict[str, Any]) -> Optional[str]:
         return "frontend"
     if "replica_scaling" in doc:
         return "fleet"
+    if "decode_microbench" in doc:
+        return "kernels"
     return None
 
 
@@ -416,7 +461,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("baseline", help="baseline BENCH_*.json")
     p.add_argument("current", help="current BENCH_*.json")
     p.add_argument("--kind",
-                   choices=["auto", "serving", "frontend", "fleet"],
+                   choices=["auto", "serving", "frontend", "fleet",
+                            "kernels"],
                    default="auto")
     p.add_argument("--fail-on-missing", action="store_true",
                    help="exit 1 when a watched metric is absent from "

@@ -460,6 +460,13 @@ def _serving_doc(**over):
             "decode_chunk_compiles": 3,
             "promote_failures": 0,
         },
+        "megakernel": {
+            "greedy_parity": True,
+            "variant_isolation": True,
+            "decode_chunk_compiles": 3,
+            "paged": {"greedy_parity": True,
+                      "decode_chunk_compiles": 2},
+        },
     }
     doc.update(over)
     return doc
@@ -511,7 +518,25 @@ class TestBenchdiff:
     def test_detect_kind(self):
         assert reg.detect_kind(_serving_doc()) == "serving"
         assert reg.detect_kind({"capacity_tokens_per_s": 1}) == "frontend"
+        assert reg.detect_kind({"decode_microbench": {"value": None}}) \
+            == "kernels"
         assert reg.detect_kind({}) is None
+
+    def test_kernels_baseline_self_diff(self):
+        """The committed BENCH_kernels.json resolves every KERNELS_SPECS
+        path (the TPU-only microbench value is null -> skipped, never
+        missing) — the bin/tier1.sh self-diff, as a unit test."""
+        import json
+        import os
+        root = os.path.join(os.path.dirname(__file__), "..")
+        path = os.path.join(root, "BENCH_kernels.json")
+        with open(path) as f:
+            doc = json.load(f)
+        assert reg.detect_kind(doc) == "kernels"
+        out = reg.diff_benchmarks(doc, doc, reg.KERNELS_SPECS)
+        assert out["ok"] and not out["missing"]
+        assert doc["megakernel"]["speedup_spec_int8_paged"] >= 1.5
+        assert doc["tp_overlap"]["tp2_overlapped_vs_tp1_unhidden"] <= 0.6
 
     def test_cli_exit_codes(self, tmp_path):
         base = tmp_path / "base.json"

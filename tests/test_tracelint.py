@@ -613,6 +613,60 @@ def test_fused_decode_paths_at_declared_budgets():
             == FUSED_PAGED_DECODE_PROGRAM_BUDGET)
 
 
+def test_megakernel_decode_paths_at_declared_budgets():
+    """The megakernel chunk programs (decode_chunk_megakernel_fn /
+    decode_chunk_megakernel_paged_fn): the fused sampling epilogue rides
+    inside the same scan body and adds no carry state, so the variants
+    inherit the base layouts' retrace physics exactly — dense at the
+    arena-metadata count (3), paged at the single carry retrace (2, see
+    benchmarks/serving_bench.MEGA_*_PROGRAM_BUDGET). The variant rename
+    also isolates the jit cache: the composed families must show ZERO
+    compiles while the megakernel engine runs."""
+    import numpy as np
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.benchmarks.serving_bench import (
+        MEGA_DECODE_PROGRAM_BUDGET, MEGA_PAGED_DECODE_PROGRAM_BUDGET,
+        _tiny_model)
+
+    model, params = _tiny_model()
+    engine = ds.init_inference(model, model_parameters=params,
+                               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, (int(n),)).astype(np.int32)
+               for n in (16, 7, 12, 4)]
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_megakernel_fn":
+                 MEGA_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4, megakernel=True)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert (aud.compiles("decode_chunk_megakernel_fn")
+            == MEGA_DECODE_PROGRAM_BUDGET)
+    assert aud.compiles("decode_chunk_fn") == 0     # cache isolation
+
+    aud = TraceAuditor(
+        budgets={"decode_chunk_megakernel_paged_fn":
+                 MEGA_PAGED_DECODE_PROGRAM_BUDGET},
+        audit_jaxprs=False)
+    with aud:
+        serving = ServingEngine(engine=engine, max_batch=4,
+                                max_prompt_len=16, decode_chunk=4,
+                                max_queue=4, megakernel=True, paged=True,
+                                prefix_cache=False)
+        for _ in range(3):
+            serving.run([p.copy() for p in prompts], max_new_tokens=8)
+    assert (aud.compiles("decode_chunk_megakernel_paged_fn")
+            == MEGA_PAGED_DECODE_PROGRAM_BUDGET)
+    assert aud.compiles("decode_chunk_paged_fn") == 0
+
+
 def test_sp_prefill_path_at_declared_budget():
     """The sequence-parallel prefill program (prefill_sp_fn) compiles
     ONCE per prefill bucket: the Ulysses-sharded forward takes the
