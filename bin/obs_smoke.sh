@@ -88,12 +88,22 @@ assert fm["greedy_parity"] is True, fm
 assert fm["tpot_p99_improvement"] >= 2.0, fm
 assert fm["profile"]["prefill"]["stall_s"] == 0.0, fm
 assert fm["bucketed_stall_s"] > 0.0, fm
+# the bench must have run under the LockAuditor (runtime half of
+# lockcheck) and observed ZERO lock-order violations across the
+# serving window — a deadlockable ordering in frontend/fleet/telemetry
+# locks fails the smoke even if no thread happened to interleave
+la = d["lock_audit"]
+assert la["enabled"] is True and la["strict"] is True, la
+assert la["order_violations"] == 0, la
+assert la["n_locks"] >= 5 and la["n_acquisitions"] > 0, la
 print("obs_smoke: live /metrics scrape ok "
       f"({s['n_families']} families, ttft p99="
       f"{s['ttft_quantiles_s'].get('0.99')}s, /slo "
       f"{slo['n_slos']} objectives over {slo['n_samples']} samples, "
       f"{tg['n_tenants']} tenants, fused p99 TPOT "
-      f"{fm['tpot_p99_improvement']}x)")
+      f"{fm['tpot_p99_improvement']}x, lock audit "
+      f"{la['n_locks']} locks/{la['n_acquisitions']} acquisitions, "
+      "0 order violations)")
 EOF
     [ $? -ne 0 ] && fail=1
     # chunk-timeline attribution gate: the bench's profile block must

@@ -12,6 +12,13 @@ cd "$(dirname "$0")/.." || exit 1
 # collection spends minutes. See docs/analysis.md.
 python bin/tracelint deepspeed_tpu || exit $?
 
+# lockcheck second: pure-AST concurrency-discipline gate (same no-JAX
+# fast path) — unguarded shared state, blocking calls under locks, and
+# predicate-less condition waits fail before pytest spends minutes. The
+# runtime half (LockAuditor lock-order graph) runs inside the frontend
+# bench via bin/obs_smoke.sh. See docs/analysis.md.
+python bin/lockcheck deepspeed_tpu || exit $?
+
 # benchdiff self-diff on the committed baselines (stdlib-only, <1 s):
 # every watched metric path must resolve in the archived BENCH_*.json —
 # a bench schema drift fails here, not after a full bench round. The

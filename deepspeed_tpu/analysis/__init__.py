@@ -1,17 +1,33 @@
-"""tracelint: JAX/TPU tracer-safety static analysis.
+"""Static + runtime analysis for the serving stack: tracelint & lockcheck.
 
-Two engines:
+Two tools, two engines each:
 
-* **Engine 1** (``astlint`` + ``baseline`` + ``cli``): a pure-AST linter
+**tracelint** — JAX/TPU tracer-safety:
+
+* Engine 1 (``astlint`` + ``baseline`` + ``cli``): a pure-AST linter
   — no JAX import — enforcing host-sync, nondeterminism, captured-state
   mutation, and weak-typed-jit-arg rules inside hot contexts, with a
   committed suppression baseline. CLI wrapper: ``bin/tracelint``.
-* **Engine 2** (``auditor``): :class:`TraceAuditor`, a context manager
+* Engine 2 (``auditor``): :class:`TraceAuditor`, a context manager
   wrapping ``jax.jit`` to enforce per-program retrace budgets, catch
   donation-after-use, and audit jaxprs for large baked-in constants and
   unexpected host callbacks.
 
-See docs/analysis.md for the rule catalogue and workflows.
+**lockcheck** — concurrency discipline:
+
+* Engine 1 (``lockcheck`` + ``lockcli``): a pure-AST linter inferring
+  per-class guarded-field sets and flagging unguarded access, blocking
+  calls under locks, predicate-less condition waits, and locks in
+  finalizers/signal handlers, with its own baseline
+  (``lockcheck_baseline.txt``). CLI wrapper: ``bin/lockcheck``.
+* Engine 2 (``locks``): :class:`LockAuditor`, a lockdep-style runtime
+  lock-order graph — the ``make_lock``/``make_rlock``/``make_condition``
+  factories adopted across the stack instrument every acquisition when
+  an auditor is installed, raising :class:`LockOrderError` on
+  inversions *before* they deadlock and exporting hold-time gauges.
+
+Shared AST helpers live in ``astutil``. See docs/analysis.md for the
+rule catalogues and workflows.
 """
 
 from .rules import RULES, Finding
@@ -20,6 +36,12 @@ from .baseline import (BaselineEntry, BaselineFormatError, apply_baseline,
                        format_baseline, load_baseline, parse_baseline)
 from .auditor import (DonationError, ProgramRecord, RetraceBudgetError,
                       TraceAuditError, TraceAuditor)
+from .lockcheck import (LOCK_RULES, lint_file as lock_lint_file,
+                        lint_paths as lock_lint_paths,
+                        lint_source as lock_lint_source)
+from .locks import (LockAuditor, LockOrderError, auditing, get_auditor,
+                    install_auditor, make_condition, make_lock, make_rlock,
+                    uninstall_auditor)
 
 __all__ = [
     "RULES", "Finding", "lint_file", "lint_paths", "lint_source",
@@ -27,4 +49,8 @@ __all__ = [
     "format_baseline", "load_baseline", "parse_baseline",
     "TraceAuditor", "TraceAuditError", "RetraceBudgetError",
     "DonationError", "ProgramRecord",
+    "LOCK_RULES", "lock_lint_file", "lock_lint_paths", "lock_lint_source",
+    "LockAuditor", "LockOrderError", "auditing", "get_auditor",
+    "install_auditor", "uninstall_auditor",
+    "make_lock", "make_rlock", "make_condition",
 ]

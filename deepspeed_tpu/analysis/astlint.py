@@ -38,9 +38,12 @@ from __future__ import annotations
 
 import ast
 import os
-import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from .astutil import (arg_names as _arg_names_of, binding_names,
+                      disable_matcher, dotted as _dotted, is_disabled,
+                      iter_py_files, iter_scoped as _iter_scoped,
+                      local_names as _local_names_of)
 from .rules import Finding, RULES, normalize_code
 
 _JIT_NAMES = {"jax.jit", "jit"}
@@ -86,33 +89,7 @@ _MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
 
 _STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
 
-_DISABLE_RE = re.compile(r"#\s*tracelint:\s*disable=([\w\-, ]+)")
-
-
-def _dotted(node) -> Optional[str]:
-    """'a.b.c' for Name/Attribute chains, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _iter_scoped(node, *, skip_defs=True):
-    """Walk a function/module body without crossing nested def/class/
-    lambda boundaries (their bodies are separate lint scopes)."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        child = stack.pop()
-        yield child
-        if skip_defs and isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                        ast.Lambda, ast.ClassDef)):
-            continue
-        stack.extend(ast.iter_child_nodes(child))
+_DISABLE_RE = disable_matcher("tracelint")
 
 
 def _dec_is_jit(dec) -> Tuple[bool, bool]:
@@ -329,12 +306,8 @@ class _ModuleLint:
     def _emit(self, node, rule: str, message: str, func: str) -> None:
         line = getattr(node, "lineno", 1)
         src = self.lines[line - 1] if line <= len(self.lines) else ""
-        for probe in (src, self.lines[line - 2] if line >= 2 else ""):
-            m = _DISABLE_RE.search(probe)
-            if m:
-                names = {s.strip() for s in m.group(1).split(",")}
-                if rule in names or "all" in names:
-                    return
+        if is_disabled(self.lines, line, rule, _DISABLE_RE):
+            return
         self.findings.append(Finding(
             path=self.relpath, line=line,
             col=getattr(node, "col_offset", 0) + 1, rule=rule,
@@ -343,36 +316,10 @@ class _ModuleLint:
     # ------------------------------------------------- in-trace rules
     @staticmethod
     def _binding_names(t):
-        """Names BOUND by an assignment target. A Subscript/Attribute
-        target's base name is being mutated, not bound — walking into it
-        would hide captured-state mutation behind a fake 'local'."""
-        if isinstance(t, ast.Name):
-            yield t.id
-        elif isinstance(t, ast.Starred):
-            yield from _ModuleLint._binding_names(t.value)
-        elif isinstance(t, (ast.Tuple, ast.List)):
-            for e in t.elts:
-                yield from _ModuleLint._binding_names(e)
+        return binding_names(t)
 
     def _local_names(self, fn) -> Set[str]:
-        names: Set[str] = set()
-        args = fn.args
-        for a in (args.posonlyargs + args.args + args.kwonlyargs +
-                  ([args.vararg] if args.vararg else []) +
-                  ([args.kwarg] if args.kwarg else [])):
-            names.add(a.arg)
-        for node in _iter_scoped(fn, skip_defs=False):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                names.add(node.name)
-            elif isinstance(node, ast.Assign):
-                for t in node.targets:
-                    names.update(self._binding_names(t))
-            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
-                                   ast.For, ast.comprehension)):
-                names.update(self._binding_names(node.target))
-            elif isinstance(node, ast.withitem) and node.optional_vars:
-                names.update(self._binding_names(node.optional_vars))
-        return names
+        return _local_names_of(fn)
 
     def _mentions_any(self, node, names: Set[str]) -> bool:
         return any(isinstance(s, ast.Name) and s.id in names
@@ -388,11 +335,7 @@ class _ModuleLint:
         return False
 
     def _arg_names(self, fn) -> Set[str]:
-        args = fn.args
-        return {a.arg for a in (
-            args.posonlyargs + args.args + args.kwonlyargs +
-            ([args.vararg] if args.vararg else []) +
-            ([args.kwarg] if args.kwarg else []))}
+        return _arg_names_of(fn)
 
     def _lint_traced(self, fn, qual: str) -> None:
         # traced inputs (for concretization checks) vs anything locally
@@ -596,19 +539,6 @@ def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
     rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
     with open(path, "r", encoding="utf-8") as f:
         return lint_source(f.read(), rel)
-
-
-def iter_py_files(paths: Iterable[str]):
-    for p in paths:
-        if os.path.isdir(p):
-            for dirpath, dirnames, filenames in os.walk(p):
-                dirnames[:] = sorted(d for d in dirnames
-                                     if d != "__pycache__")
-                for fname in sorted(filenames):
-                    if fname.endswith(".py"):
-                        yield os.path.join(dirpath, fname)
-        elif p.endswith(".py"):
-            yield p
 
 
 def lint_paths(paths: Iterable[str],

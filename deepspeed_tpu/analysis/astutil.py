@@ -1,0 +1,122 @@
+"""Shared pure-AST helpers for the analysis linters (no JAX import).
+
+tracelint (astlint.py) and lockcheck (lockcheck.py) walk the same
+package with the same primitives: dotted-name extraction, scope-bounded
+traversal, binding-target enumeration, local-name collection, ``.py``
+discovery, and per-tool ``# <tool>: disable=<rule>`` suppression
+comments. Factoring them here keeps the two engines byte-identical on
+the mechanics so a fix in one (e.g. Starred targets in
+:func:`binding_names`) is a fix in both.
+
+Everything in this module is stdlib-only — the ``bin/tracelint`` /
+``bin/lockcheck`` launchers import the analysis package through
+synthetic parent modules precisely so that no JAX ever loads; keep it
+that way.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Iterator, Optional, Set
+
+
+def dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def iter_scoped(node, *, skip_defs=True) -> Iterator[ast.AST]:
+    """Walk a function/module body without crossing nested def/class/
+    lambda boundaries (their bodies are separate lint scopes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if skip_defs and isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def binding_names(t) -> Iterator[str]:
+    """Names BOUND by an assignment target. A Subscript/Attribute
+    target's base name is being mutated, not bound — walking into it
+    would hide captured-state mutation behind a fake 'local'."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, ast.Starred):
+        yield from binding_names(t.value)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from binding_names(e)
+
+
+def arg_names(fn) -> Set[str]:
+    """Every parameter name of a FunctionDef/Lambda."""
+    args = fn.args
+    return {a.arg for a in (
+        args.posonlyargs + args.args + args.kwonlyargs +
+        ([args.vararg] if args.vararg else []) +
+        ([args.kwarg] if args.kwarg else []))}
+
+
+def local_names(fn) -> Set[str]:
+    """Every name bound inside ``fn``: parameters, assignment targets,
+    loop/with/comprehension targets, and nested def names."""
+    names: Set[str] = set(arg_names(fn))
+    for node in iter_scoped(fn, skip_defs=False):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                names.update(binding_names(t))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.For, ast.comprehension)):
+            names.update(binding_names(node.target))
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            names.update(binding_names(node.optional_vars))
+    return names
+
+
+def disable_matcher(tool: str):
+    """Compiled regex matching ``# <tool>: disable=<rule>[,<rule>...]``
+    suppression comments (trailing or preceding-line)."""
+    return re.compile(rf"#\s*{re.escape(tool)}:\s*disable=([\w\-, ]+)")
+
+
+def is_disabled(lines, lineno: int, rule: str, matcher) -> bool:
+    """True if a ``disable=`` comment on the flagged line or the line
+    above names ``rule`` (or ``all``)."""
+    src = lines[lineno - 1] if lineno <= len(lines) else ""
+    for probe in (src, lines[lineno - 2] if lineno >= 2 else ""):
+        m = matcher.search(probe)
+        if m:
+            names = {s.strip() for s in m.group(1).split(",")}
+            if rule in names or "all" in names:
+                return True
+    return False
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    """Every ``.py`` under ``paths`` (files or directory trees), in a
+    deterministic order, skipping ``__pycache__``."""
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        yield os.path.join(dirpath, fname)
+        elif p.endswith(".py"):
+            yield p

@@ -77,12 +77,13 @@ def load_baseline(path: str) -> List[BaselineEntry]:
 
 
 def format_baseline(findings: Sequence[Finding],
-                    reasons: Dict[str, str] = None) -> str:
+                    reasons: Dict[str, str] = None,
+                    tool: str = "tracelint") -> str:
     """Render findings as baseline lines (used by --write-baseline; the
     operator then replaces the TODO reasons with real ones)."""
     reasons = reasons or {}
     seen = set()
-    lines = ["# tracelint suppression baseline — one justified finding "
+    lines = [f"# {tool} suppression baseline — one justified finding "
              "per line:",
              "#   <path>::<rule>::<func>::<code>  # <reason>",
              "# Stale entries (no longer firing) fail CI: delete them."]
@@ -96,13 +97,15 @@ def format_baseline(findings: Sequence[Finding],
 
 
 def apply_baseline(findings: Sequence[Finding],
-                   entries: Sequence[BaselineEntry]
+                   entries: Sequence[BaselineEntry],
+                   baseline_name: str = "tracelint_baseline.txt"
                    ) -> Tuple[List[Finding], List[Finding], int]:
     """Split findings against the baseline.
 
     Returns ``(unsuppressed, stale, suppressed_count)`` where ``stale``
     are synthetic ``stale-suppression`` findings pointing at baseline
-    entries that matched nothing.
+    entries that matched nothing. ``baseline_name`` is the path stamped
+    on those synthetic findings (lockcheck passes its own file).
     """
     by_fp: Dict[str, BaselineEntry] = {e.fingerprint: e for e in entries}
     matched = set()
@@ -115,7 +118,7 @@ def apply_baseline(findings: Sequence[Finding],
         else:
             unsuppressed.append(f)
     stale = [
-        Finding(path="tracelint_baseline.txt", line=e.line, col=1,
+        Finding(path=baseline_name, line=e.line, col=1,
                 rule="stale-suppression",
                 message="remove stale suppression — no current finding "
                         f"matches '{e.fingerprint}' (the issue it "

@@ -1,0 +1,541 @@
+"""lockcheck Engine 1: pure-AST concurrency-discipline linter.
+
+tracelint (astlint.py) checks what code does to the *device* hot path;
+lockcheck checks what threads do to each other. The serving stack is a
+real concurrent system — frontend driver thread, fleet router re-home
+paths, elastic controller poll loop, kv-tier promotion worker,
+watchdogs, stdlib HTTP handler threads — all sharing state behind
+hand-maintained ``Lock``/``RLock``/``Condition`` discipline. This
+module makes that discipline machine-checked, statically, with no JAX
+import and no import of the linted code, so the whole package lints in
+under a second and gates CI before pytest collects (bin/tier1.sh).
+
+What it knows
+-------------
+Locks are discovered structurally: ``self._x = threading.Lock()`` /
+``RLock()`` / ``Condition()`` (or the instrumented
+``locks.make_lock/make_rlock/make_condition`` factories from Engine 2)
+make ``_x`` a *lock attribute* of the class; module-level
+``NAME = threading.Lock()`` makes a module lock. A *lock region* is the
+lexical body of ``with self._x:`` (or ``with NAME:``). Methods whose
+every intra-class call site sits inside a lock region are classified
+*locked-context* to a fixpoint — their whole bodies count as held, so
+``_spill``-style helpers called only under the map lock are analyzed as
+such (property accesses count as call sites).
+
+Rules
+-----
+* ``unguarded-access`` — a field whose accesses are majority-inside
+  lock regions (>=2 locked sites, strictly more locked than not) is
+  *guarded*; reading or writing it outside any lock region (outside
+  ``__init__``, where the object is not yet shared) is a data race
+  until proven benign.
+* ``blocking-under-lock`` — a call that can block the thread while a
+  lock region is held: ``time.sleep``, ``jax.device_get`` /
+  ``.block_until_ready()``, thread ``.join()``, file/socket IO
+  (``open``/``.read``/``.write``/``.flush``/``.fsync``/``.recv``/
+  ``.send``/``.sendall``/``.accept``/``.connect``/aio submits), and
+  jitted-program dispatch (``_jit*`` callables — one dispatch can hide
+  a device sync). Every waiter on that lock stalls behind the IO.
+* ``wait-no-predicate`` — an untimed ``Condition.wait()`` not enclosed
+  in a ``while`` loop: wakeups are spurious and racy by spec, so a bare
+  ``if``-guarded (or unguarded) wait is a lost-wakeup/liveness bug.
+  Timed waits (idle backoff) and ``wait_for`` (predicate built in) are
+  exempt.
+* ``lock-in-finalizer`` — acquiring a lock inside ``__del__`` or a
+  ``signal.signal`` handler. GC and signals preempt arbitrary code —
+  including the holder of that very lock — so these acquisitions
+  deadlock nondeterministically. Calls to same-class methods that
+  acquire locks are flagged one level deep (``self.close()`` from
+  ``__del__``).
+
+Suppression mirrors tracelint exactly: inline ``# lockcheck:
+disable=<rule>[,...]`` on the flagged line or the line above, or a
+committed ``lockcheck_baseline.txt`` entry with a mandatory reason
+(baseline.py — stale entries fail CI as ``stale-suppression``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import (disable_matcher, dotted, is_disabled, iter_py_files,
+                      iter_scoped)
+from .rules import Finding, normalize_code
+
+#: rule id -> one-line description (bin/lockcheck --list-rules)
+LOCK_RULES = {
+    "unguarded-access":
+        "read/write of a majority-lock-guarded field outside any lock "
+        "region (outside __init__) — a data race until proven benign",
+    "blocking-under-lock":
+        "blocking call while holding a lock: time.sleep, device_get / "
+        ".block_until_ready(), thread .join(), file/socket IO, or "
+        "jitted-program dispatch — every waiter stalls behind it",
+    "wait-no-predicate":
+        "untimed Condition.wait() not wrapped in a while-predicate "
+        "loop — spurious wakeups and lost-wakeup races are spec "
+        "behavior, not edge cases",
+    "lock-in-finalizer":
+        "lock acquisition inside __del__ or a signal handler — GC and "
+        "signals preempt arbitrary code, including the lock's current "
+        "holder, so this deadlocks nondeterministically",
+    "stale-suppression":
+        "baseline entry no longer matched by any finding — remove the "
+        "stale suppression (emitted by the baseline checker, not the "
+        "AST walk)",
+}
+
+BASELINE_FILE = "lockcheck_baseline.txt"
+
+_DISABLE_RE = disable_matcher("lockcheck")
+
+_LOCK_CTORS = set()
+for _m in ("threading.", ""):
+    _LOCK_CTORS.update({f"{_m}Lock", f"{_m}RLock", f"{_m}Condition"})
+for _m in ("locks.", ""):
+    _LOCK_CTORS.update({f"{_m}make_lock", f"{_m}make_rlock",
+                        f"{_m}make_condition"})
+_COND_CTORS = {"threading.Condition", "Condition", "locks.make_condition",
+               "make_condition"}
+
+# blocking callees by dotted name
+_BLOCKING_NAMES = {
+    "time.sleep": "time.sleep",
+    "jax.device_get": "jax.device_get",
+    "device_get": "device_get",
+    "open": "open()",
+    "os.fsync": "os.fsync",
+    "os.pwrite": "os.pwrite",
+    "os.pread": "os.pread",
+    "socket.create_connection": "socket connect",
+    "urllib.request.urlopen": "urlopen",
+    "urlopen": "urlopen",
+}
+# blocking callees by method name (receiver-independent)
+_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync",
+    "recv": "socket IO", "recv_into": "socket IO",
+    "send": "socket IO", "sendall": "socket IO",
+    "accept": "socket IO", "connect": "socket IO",
+    "makefile": "socket IO",
+    "read": "file IO", "readline": "file IO", "readinto": "file IO",
+    "write": "file IO", "flush": "file IO", "fsync": "file IO",
+    "async_pwrite": "aio submit", "async_pread": "aio submit",
+}
+# method receivers whose .read/.write are in-memory, not IO
+_MEMORY_RECEIVERS = {"buf", "buffer", "sio", "bio", "stream", "out", "s"}
+
+# container methods that mutate their receiver in place — a field only
+# touched through these still counts as *written* for the race census
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update", "pop",
+                    "popitem", "remove", "discard", "clear", "setdefault",
+                    "sort", "reverse", "appendleft", "popleft",
+                    "move_to_end", "put"}
+
+
+def _lock_ctor_kind(value) -> Optional[str]:
+    """'cond' / 'lock' if this expression constructs a lock primitive."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = dotted(value.func)
+    if d in _COND_CTORS:
+        return "cond"
+    if d in _LOCK_CTORS:
+        return "lock"
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    """'x' for a ``self.x`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _FunctionScan:
+    """Lock-region geometry of one function body."""
+
+    def __init__(self, fn, lock_names: Set[str], module_locks: Set[str]):
+        self.fn = fn
+        # node ids lexically inside a ``with <lock>:`` body
+        self.region: Set[int] = set()
+        # the with-statements that opened regions (for nesting checks)
+        self.lock_withs: List[ast.With] = []
+        # names bound from lock ctors locally (with c: ... for locals)
+        self.local_locks: Set[str] = set()
+        self.local_conds: Set[str] = set()
+        for node in iter_scoped(fn):
+            if isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.local_locks.add(t.id)
+                            if kind == "cond":
+                                self.local_conds.add(t.id)
+        for node in iter_scoped(fn):
+            if not isinstance(node, ast.With):
+                continue
+            if any(self._is_lock_expr(i.context_expr, lock_names,
+                                      module_locks)
+                   for i in node.items):
+                self.lock_withs.append(node)
+                for sub in node.body:
+                    self.region.add(id(sub))
+                    for inner in iter_scoped(sub):
+                        self.region.add(id(inner))
+        # enclosing-while membership: node id -> inside some While body
+        self.in_while: Set[int] = set()
+        for node in iter_scoped(fn):
+            if isinstance(node, ast.While):
+                for sub in node.body:
+                    self.in_while.add(id(sub))
+                    for inner in iter_scoped(sub):
+                        self.in_while.add(id(inner))
+
+    def _is_lock_expr(self, expr, lock_names: Set[str],
+                      module_locks: Set[str]) -> bool:
+        attr = _self_attr(expr)
+        if attr is not None:
+            return attr in lock_names
+        if isinstance(expr, ast.Name):
+            return expr.id in module_locks or expr.id in self.local_locks
+        return False
+
+
+class _ModuleLockLint:
+    """One linted module: class-level lock inference + rule passes."""
+
+    def __init__(self, relpath: str, tree: ast.Module, lines: List[str]):
+        self.relpath = relpath
+        self.tree = tree
+        self.lines = lines
+        self.findings: List[Finding] = []
+        # module-level locks: NAME = threading.Lock() at module scope
+        self.module_locks: Set[str] = set()
+        self.module_conds: Set[str] = set()
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(t.id)
+                            if kind == "cond":
+                                self.module_conds.add(t.id)
+        # signal handlers registered anywhere in the module
+        self.signal_handlers: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    dotted(node.func) == "signal.signal" and \
+                    len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Name):
+                self.signal_handlers.add(node.args[1].id)
+
+    # ------------------------------------------------------------ emit
+    def _emit(self, node, rule: str, message: str, func: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if is_disabled(self.lines, line, rule, _DISABLE_RE):
+            return
+        src = self.lines[line - 1] if line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            path=self.relpath, line=line,
+            col=getattr(node, "col_offset", 0) + 1, rule=rule,
+            message=message, func=func, code=normalize_code(src)))
+
+    # ------------------------------------------------------------- run
+    def run(self) -> List[Finding]:
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._lint_class(node)
+        # module-level functions using module locks
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _FunctionScan(node, set(), self.module_locks)
+                self._lint_blocking(node, scan, node.name,
+                                    whole_body_locked=False)
+                self._lint_waits(node, scan, node.name, set())
+                if node.name in self.signal_handlers:
+                    self._lint_finalizer(node, node.name, set(), {})
+        return self.findings
+
+    # ----------------------------------------------------- class pass
+    def _lint_class(self, cls: ast.ClassDef) -> None:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        method_names = {m.name for m in methods}
+        lock_attrs: Set[str] = set()
+        cond_attrs: Set[str] = set()
+        for m in methods:
+            for node in iter_scoped(m):
+                if isinstance(node, ast.Assign):
+                    kind = _lock_ctor_kind(node.value)
+                    if not kind:
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            lock_attrs.add(attr)
+                            if kind == "cond":
+                                cond_attrs.add(attr)
+        if not lock_attrs:
+            # still check finalizers/waits on locally-built conditions
+            for m in methods:
+                scan = _FunctionScan(m, set(), self.module_locks)
+                self._lint_waits(m, scan, f"{cls.name}.{m.name}",
+                                 cond_attrs)
+            return
+
+        scans: Dict[str, _FunctionScan] = {
+            m.name: _FunctionScan(m, lock_attrs, self.module_locks)
+            for m in methods}
+        locked_ctx = self._locked_context_fixpoint(
+            cls, methods, method_names, scans)
+
+        # ---- write census: fields mutated after construction ----
+        # a field only ever READ outside __init__ (immutable config like
+        # self.clock) cannot race no matter how often locked code happens
+        # to touch it; the guarded-field rule applies to written fields
+        written: Set[str] = set()
+        for m in methods:
+            if m.name == "__init__":
+                continue
+            for node in iter_scoped(m):
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        base = t
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        attr = _self_attr(base)
+                        if attr:
+                            written.add(attr)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATOR_METHODS:
+                    base = node.func.value
+                    while isinstance(base, ast.Subscript):
+                        base = base.value
+                    attr = _self_attr(base)
+                    if attr:
+                        written.add(attr)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        base = t
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        attr = _self_attr(base)
+                        if attr:
+                            written.add(attr)
+
+        # ---- field access census: (locked, unlocked) site counts ----
+        locked_n: Dict[str, int] = {}
+        unlocked_sites: Dict[str, List[Tuple[ast.AST, str]]] = {}
+        for m in methods:
+            qual = f"{cls.name}.{m.name}"
+            scan = scans[m.name]
+            body_locked = m.name in locked_ctx
+            for node in iter_scoped(m):
+                attr = _self_attr(node)
+                if attr is None or attr in lock_attrs or \
+                        attr in method_names:
+                    continue
+                if body_locked or id(node) in scan.region:
+                    locked_n[attr] = locked_n.get(attr, 0) + 1
+                elif m.name not in ("__init__", "__del__"):
+                    unlocked_sites.setdefault(attr, []).append(
+                        (node, qual))
+        for attr, sites in unlocked_sites.items():
+            n_locked = locked_n.get(attr, 0)
+            if attr in written and n_locked >= 2 and \
+                    n_locked > len(sites):
+                for node, qual in sites:
+                    self._emit(
+                        node, "unguarded-access",
+                        f"'self.{attr}' is guarded by a lock at "
+                        f"{n_locked} site(s) but accessed here with no "
+                        "lock held — take the lock or justify why this "
+                        "read/write is race-free", qual)
+
+        # ---- blocking / waits / finalizer rules ----
+        for m in methods:
+            qual = f"{cls.name}.{m.name}"
+            scan = scans[m.name]
+            self._lint_blocking(m, scan, qual,
+                                whole_body_locked=m.name in locked_ctx)
+            self._lint_waits(m, scan, qual, cond_attrs)
+            if m.name == "__del__" or m.name in self.signal_handlers:
+                self._lint_finalizer(m, qual, lock_attrs, scans)
+
+    def _locked_context_fixpoint(self, cls, methods, method_names,
+                                 scans) -> Set[str]:
+        """Methods whose every intra-class call/property site is inside
+        a lock region (or inside another locked-context method)."""
+        # callee -> list of (caller_name, node) sites
+        sites: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for m in methods:
+            for node in iter_scoped(m):
+                target = None
+                if isinstance(node, ast.Call):
+                    target = _self_attr(node.func)
+                attr = _self_attr(node)
+                if target is None and attr in method_names:
+                    target = attr          # property access counts
+                if target in method_names:
+                    sites.setdefault(target, []).append((m.name, node))
+        locked: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, call_sites in sites.items():
+                if name in locked or name in ("__init__", "__del__"):
+                    continue
+                ok = all(
+                    caller in locked or
+                    id(node) in scans[caller].region
+                    for caller, node in call_sites
+                    if caller != name)     # ignore self-recursion
+                if ok and any(c != name for c, _ in call_sites):
+                    locked.add(name)
+                    changed = True
+        return locked
+
+    # ------------------------------------------------- blocking rules
+    def _lint_blocking(self, fn, scan: _FunctionScan, qual: str,
+                       whole_body_locked: bool) -> None:
+        for node in iter_scoped(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (whole_body_locked or id(node) in scan.region):
+                continue
+            label = self._blocking_label(node)
+            if label:
+                self._emit(
+                    node, "blocking-under-lock",
+                    f"{label} while holding a lock — every thread "
+                    "waiting on that lock stalls behind it; move the "
+                    "slow call outside the critical section", qual)
+
+    def _blocking_label(self, call: ast.Call) -> Optional[str]:
+        d = dotted(call.func)
+        if d in _BLOCKING_NAMES:
+            return _BLOCKING_NAMES[d]
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr == "join":
+                # thread join: no args, a timeout kw, or one numeric
+                # positional. One non-numeric positional is str.join.
+                if (not call.args and not call.keywords) or \
+                        any(kw.arg == "timeout" for kw in call.keywords):
+                    return "thread .join()"
+                if len(call.args) == 1 and \
+                        isinstance(call.args[0], ast.Constant) and \
+                        isinstance(call.args[0].value, (int, float)):
+                    return "thread .join()"
+                return None
+            if attr in _BLOCKING_ATTRS:
+                base = call.func.value
+                base_name = base.attr if isinstance(base, ast.Attribute) \
+                    else (base.id if isinstance(base, ast.Name) else "")
+                if attr in ("read", "write", "flush") and \
+                        base_name.lstrip("_") in _MEMORY_RECEIVERS:
+                    return None            # StringIO/BytesIO builders
+                return f"{_BLOCKING_ATTRS[attr]} (.{attr}())"
+        name = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else (call.func.id if isinstance(call.func, ast.Name)
+                  else None)
+        if name and name.startswith("_jit"):
+            return f"jitted-program dispatch ('{name}')"
+        return None
+
+    # ----------------------------------------------------- wait rules
+    def _lint_waits(self, fn, scan: _FunctionScan, qual: str,
+                    cond_attrs: Set[str]) -> None:
+        for node in iter_scoped(fn):
+            if not isinstance(node, ast.Call) or node.args or \
+                    node.keywords:
+                continue                   # timed waits are backoff
+            if not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr != "wait":
+                continue
+            base = node.func.value
+            attr = _self_attr(base)
+            is_cond = (attr in cond_attrs) or (
+                isinstance(base, ast.Name) and
+                (base.id in scan.local_conds or
+                 base.id in self.module_conds))
+            if not is_cond:
+                continue
+            if id(node) not in scan.in_while:
+                self._emit(
+                    node, "wait-no-predicate",
+                    "untimed Condition.wait() outside a while-predicate "
+                    "loop — spurious wakeups are spec behavior; use "
+                    "'while not pred: cond.wait()' or wait_for()", qual)
+
+    # ------------------------------------------------ finalizer rules
+    def _lint_finalizer(self, fn, qual: str, lock_attrs: Set[str],
+                        scans) -> None:
+        acquirers = {name for name, s in scans.items()
+                     if s.lock_withs} if scans else set()
+        for node in iter_scoped(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    name = item.context_expr.id \
+                        if isinstance(item.context_expr, ast.Name) \
+                        else None
+                    if (attr in lock_attrs) or \
+                            (name in self.module_locks):
+                        self._emit(
+                            item.context_expr, "lock-in-finalizer",
+                            "lock acquired inside a finalizer/signal "
+                            "handler — GC/signals can preempt the "
+                            "current holder of this very lock", qual)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    self._emit(
+                        node, "lock-in-finalizer",
+                        ".acquire() inside a finalizer/signal handler "
+                        "— GC/signals can preempt the current holder",
+                        qual)
+                    continue
+                target = _self_attr(node.func)
+                if target in acquirers:
+                    self._emit(
+                        node, "lock-in-finalizer",
+                        f"'self.{target}()' acquires a lock and is "
+                        "called from a finalizer/signal handler — "
+                        "GC/signals can preempt the lock's current "
+                        "holder; make the finalizer lock-free", qual)
+
+
+def lint_source(source: str, relpath: str) -> List[Finding]:
+    """Lint one module's source text (the unit the tests drive)."""
+    tree = ast.parse(source, filename=relpath)
+    return _ModuleLockLint(relpath, tree, source.splitlines()).run()
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    root = root or os.getcwd()
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+def lint_paths(paths: Iterable[str],
+               root: Optional[str] = None) -> List[Finding]:
+    """Lint every ``.py`` under ``paths`` (files or directory trees)."""
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, root))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,87 @@
+"""lockcheck CLI (Engine 1 driver).
+
+``bin/lockcheck [paths...]`` — findings print as ``file:line:col: rule
+[func] message``, suitable for editor jump-to. Exit status mirrors
+tracelint: 0 clean (all findings baselined), 1 lint violations, 2
+baseline problems (stale suppressions or format errors). Engine 1 only:
+this process never imports JAX or the linted code, so the whole-package
+pass stays under a second and gates CI before pytest collection starts
+(bin/tier1.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from . import baseline as baseline_mod, lockcheck
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lockcheck",
+        description="concurrency-discipline static analysis (AST pass)")
+    ap.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                    help="files or package directories to lint "
+                         "(default: deepspeed_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="path findings are reported relative to "
+                         "(default: cwd)")
+    ap.add_argument("--baseline", default=lockcheck.BASELINE_FILE,
+                    help="suppression baseline file "
+                         f"(default: {lockcheck.BASELINE_FILE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file "
+                         "with TODO reasons, then exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in lockcheck.LOCK_RULES.items():
+            print(f"{rule}: {desc}")
+        return 0
+
+    t0 = time.perf_counter()
+    root = args.root or os.getcwd()
+    paths = args.paths or ["deepspeed_tpu"]
+    findings = lockcheck.lint_paths(paths, root=root)
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write(baseline_mod.format_baseline(findings,
+                                                 tool="lockcheck"))
+        print(f"lockcheck: wrote {len(findings)} finding(s) to "
+              f"{args.baseline} — replace the TODO reasons")
+        return 0
+
+    stale = []
+    suppressed = 0
+    if not args.no_baseline:
+        try:
+            entries = baseline_mod.load_baseline(args.baseline)
+        except baseline_mod.BaselineFormatError as e:
+            print(f"lockcheck: {e}", file=sys.stderr)
+            return 2
+        findings, stale, suppressed = baseline_mod.apply_baseline(
+            findings, entries, baseline_name=args.baseline)
+
+    for f in findings + stale:
+        print(f.render())
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    status = "clean" if not (findings or stale) else "FAILED"
+    print(f"lockcheck: {status} — {len(findings)} finding(s), "
+          f"{len(stale)} stale suppression(s), {suppressed} baselined, "
+          f"{dt_ms:.0f} ms")
+    if findings:
+        return 1
+    if stale:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,454 @@
+"""lockcheck Engine 2: runtime lock-order auditor (lockdep-style).
+
+Engine 1 (lockcheck.py) proves lexical discipline; this module proves
+the *dynamic* property static analysis cannot: that no two threads ever
+acquire the same locks in opposite orders. The design is the Linux
+kernel's lockdep, scaled to this process: every instrumented lock is a
+node in a process-wide directed graph, every first-observed "acquired B
+while holding A" adds edge A→B with the acquisition stack that created
+it, and an acquisition that would close a cycle raises
+:class:`LockOrderError` **before blocking on the lock** — naming both
+stacks (the one that established the forward order and the one
+attempting the reversal) — so the seeded inversion tests catch the
+deadlock instead of hanging in it.
+
+Opt-in and zero-cost when off: the adopted modules (frontend,
+fleet/router, fleet/elastic, fleet/transport, kv_tiers, telemetry,
+monitor) construct their locks through :func:`make_lock` /
+:func:`make_rlock` / :func:`make_condition`, which return **plain**
+``threading`` primitives unless an auditor is installed — no wrapper,
+no indirection, not one extra attribute lookup on the hot path. Tests
+and benches install one around construction::
+
+    with locks.auditing() as auditor:
+        frontend = ServingFrontend(engine)   # locks become audited
+        ... drive load ...
+    report = auditor.report()                # order_violations == 0
+
+Beyond ordering, the auditor keeps per-lock max/total hold times
+(exported as ``lock/hold_max_s|lock=<name>`` telemetry gauges via
+:meth:`LockAuditor.export_gauges`) so a creeping critical section shows
+up on dashboards before it becomes a stall.
+
+Host-only: imports no JAX (analysis package contract).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition would close a cycle in the lock-order graph.
+
+    ``edge`` is the attempted ``(held, acquiring)`` pair;
+    ``established_stack`` is the stack that first acquired these locks
+    in the opposite order; ``current_stack`` is the stack attempting
+    the reversal. Both are embedded in ``str(e)``.
+    """
+
+    def __init__(self, message: str, *,
+                 edge: Tuple[str, str],
+                 established_stack: str,
+                 current_stack: str):
+        super().__init__(message)
+        self.edge = edge
+        self.established_stack = established_stack
+        self.current_stack = current_stack
+
+
+def _stack() -> str:
+    return "".join(traceback.format_stack(limit=16)[:-2])
+
+
+class LockAuditor:
+    """Process-wide lock-order graph + hold-time accounting.
+
+    All bookkeeping runs under one private (uninstrumented) mutex;
+    held-lock stacks are thread-local. ``strict=True`` (default) raises
+    :class:`LockOrderError` at the violating acquisition; either way the
+    violation is recorded in :attr:`order_violations` for
+    :meth:`report`.
+    """
+
+    def __init__(self, *, strict: bool = True,
+                 clock=time.perf_counter):
+        self.strict = strict
+        self.clock = clock
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # first-observed edges: (a, b) -> (thread name, stack) proving
+        # "b acquired while holding a"
+        self._edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        self._adj: Dict[str, Set[str]] = {}
+        self._names: Set[str] = set()
+        self.order_violations: List[LockOrderError] = []
+        self.n_acquisitions = 0
+        self._hold_max: Dict[str, float] = {}
+        self._hold_total: Dict[str, float] = {}
+        self._hold_n: Dict[str, int] = {}
+
+    # ------------------------------------------------------ held stacks
+    def _held(self) -> List[List]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st                    # entries: [name, t_acquired, depth]
+
+    # ------------------------------------------------------ graph logic
+    def register(self, name: str) -> None:
+        with self._mu:
+            self._names.add(name)
+
+    def _path_exists(self, src: str, dst: str) -> bool:
+        """BFS reachability src -> dst in the order graph (_mu held)."""
+        seen = {src}
+        frontier = [src]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                for m in self._adj.get(n, ()):
+                    if m == dst:
+                        return True
+                    if m not in seen:
+                        seen.add(m)
+                        nxt.append(m)
+            frontier = nxt
+        return False
+
+    def before_acquire(self, name: str, *, reentrant: bool) -> bool:
+        """Order-check ``name`` against this thread's held set — BEFORE
+        blocking on the lock, so an inversion raises instead of
+        deadlocking. Returns True if this is a reentrant re-acquire
+        (the caller skips hold accounting for it)."""
+        held = self._held()
+        for entry in held:
+            if entry[0] == name:
+                if reentrant:
+                    return True
+                err = self._violation(
+                    (name, name),
+                    "self-deadlock: non-reentrant lock "
+                    f"'{name}' re-acquired by its holder",
+                    established=("<same thread>", "<first acquisition "
+                                 "on this thread>"))
+                if err is not None:
+                    raise err
+                return False
+        # stack capture is deferred until a NEW edge (first observation
+        # of this ordering) or a violation: format_stack costs ~ms and
+        # the steady state — re-walking known edges — must stay cheap
+        # enough to sit on the decode hot path without skewing it
+        current = None
+        tname = threading.current_thread().name
+        with self._mu:
+            self.n_acquisitions += 1
+            self._names.add(name)
+            for entry in held:
+                edge = (entry[0], name)
+                if edge in self._edges:
+                    continue
+                if current is None:
+                    current = _stack()
+                # would (held -> name) close a cycle? i.e. does the
+                # graph already order name (transitively) before held?
+                if self._path_exists(name, entry[0]):
+                    first = self._edges.get((name, entry[0]))
+                    if first is None:          # indirect cycle: find the
+                        for (a, b), rec in self._edges.items():  # witness
+                            if a == name:
+                                first = rec
+                                break
+                    err = self._violation_locked(
+                        edge, current, tname,
+                        established=first or ("<unknown>", "<indirect>"))
+                    if err is not None:
+                        raise err
+                    continue
+                self._edges[edge] = (tname, current)
+                self._adj.setdefault(entry[0], set()).add(name)
+        return False
+
+    def _violation(self, edge, message, *, established):
+        with self._mu:
+            return self._violation_locked(
+                edge, _stack(), threading.current_thread().name,
+                established=established, message=message)
+
+    def _violation_locked(self, edge, current, tname, *, established,
+                          message: Optional[str] = None):
+        est_thread, est_stack = established
+        msg = message or (
+            f"lock order violation: thread '{tname}' acquiring "
+            f"'{edge[1]}' while holding '{edge[0]}', but thread "
+            f"'{est_thread}' established the opposite order "
+            f"('{edge[1]}' before '{edge[0]}')")
+        msg += (f"\n--- order established by thread '{est_thread}':\n"
+                f"{est_stack}"
+                f"--- reversal attempted by thread '{tname}':\n"
+                f"{current}")
+        err = LockOrderError(msg, edge=edge,
+                             established_stack=est_stack,
+                             current_stack=current)
+        self.order_violations.append(err)
+        return err if self.strict else None
+
+    def after_acquire(self, name: str, *, reentrant_hit: bool) -> None:
+        held = self._held()
+        if reentrant_hit:
+            for entry in held:
+                if entry[0] == name:
+                    entry[2] += 1
+                    return
+        held.append([name, self.clock(), 1])
+
+    def on_release(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name:
+                held[i][2] -= 1
+                if held[i][2] > 0:
+                    return
+                _, t0, _ = held.pop(i)
+                dt = self.clock() - t0
+                with self._mu:
+                    if dt > self._hold_max.get(name, 0.0):
+                        self._hold_max[name] = dt
+                    self._hold_total[name] = \
+                        self._hold_total.get(name, 0.0) + dt
+                    self._hold_n[name] = self._hold_n.get(name, 0) + 1
+                return
+
+    # -------------------------------------------------------- reporting
+    def report(self) -> Dict:
+        """JSON-able audit summary (the frontend bench embeds this as
+        its ``lock_audit`` block; obs_smoke gates on it)."""
+        with self._mu:
+            return {
+                "enabled": True,
+                "strict": self.strict,
+                "locks": sorted(self._names),
+                "n_locks": len(self._names),
+                "n_edges": len(self._edges),
+                "n_acquisitions": self.n_acquisitions,
+                "order_violations": len(self.order_violations),
+                "hold_max_s": dict(self._hold_max),
+                "hold_mean_s": {
+                    n: self._hold_total[n] / self._hold_n[n]
+                    for n in self._hold_total if self._hold_n.get(n)},
+            }
+
+    def export_gauges(self) -> None:
+        """Publish per-lock hold-time gauges through the telemetry
+        runtime (``lock/hold_max_s|lock=<name>`` etc. — see
+        docs/observability.md). Lazy import: the analysis package stays
+        importable with no telemetry/JAX on the path."""
+        from ..telemetry import core as telemetry
+        with self._mu:
+            hold_max = dict(self._hold_max)
+            means = {n: self._hold_total[n] / self._hold_n[n]
+                     for n in self._hold_total if self._hold_n.get(n)}
+            violations = len(self.order_violations)
+        for name, v in hold_max.items():
+            telemetry.gauge(f"lock/hold_max_s|lock={name}", float(v))
+        for name, v in means.items():
+            telemetry.gauge(f"lock/hold_mean_s|lock={name}", float(v))
+        telemetry.gauge("lock/order_violations", float(violations))
+
+
+# ------------------------------------------------------- audited shims
+class _AuditedLock:
+    """``threading.Lock`` shim reporting to the auditor. Not reentrant
+    (re-acquire by the holder is itself reported as a deadlock)."""
+
+    _REENTRANT = False
+
+    def __init__(self, name: str, auditor: LockAuditor):
+        self.name = name
+        self._auditor = auditor
+        self._inner = self._make_inner()
+        auditor.register(name)
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        re_hit = self._auditor.before_acquire(
+            self.name, reentrant=self._REENTRANT)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._auditor.after_acquire(self.name, reentrant_hit=re_hit)
+        return ok
+
+    def release(self) -> None:
+        self._auditor.on_release(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<audited {type(self._inner).__name__} {self.name!r}>"
+
+
+class _AuditedRLock(_AuditedLock):
+    """``threading.RLock`` shim: reentrant re-acquires skip the order
+    check (no new edges from a lock to itself) and only the outermost
+    acquire/release pair is hold-timed."""
+
+    _REENTRANT = True
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    def locked(self) -> bool:          # RLock has no .locked()
+        raise AttributeError("RLock has no locked()")
+
+    # Condition-compat hooks so threading.Condition(audited_rlock)
+    # would release fully around a wait (we keep our accounting in
+    # _AuditedCondition instead, but the protocol must not break)
+    def _release_save(self):
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+class _AuditedCondition:
+    """``threading.Condition`` shim. The condition's lock participates
+    in the order graph like any other; ``wait``/``wait_for`` pop it
+    from the held set for the blocking interval (other threads hold it
+    then) and re-run the order check on re-acquire."""
+
+    def __init__(self, name: str, auditor: LockAuditor, lock=None):
+        self.name = name
+        self._auditor = auditor
+        self._inner = threading.Condition(lock)
+        auditor.register(name)
+
+    def acquire(self, *args):
+        re_hit = self._auditor.before_acquire(self.name, reentrant=True)
+        ok = self._inner.acquire(*args)
+        if ok:
+            self._auditor.after_acquire(self.name, reentrant_hit=re_hit)
+        return ok
+
+    def release(self) -> None:
+        self._auditor.on_release(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None):
+        self._auditor.on_release(self.name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            re_hit = self._auditor.before_acquire(self.name,
+                                                  reentrant=True)
+            self._auditor.after_acquire(self.name, reentrant_hit=re_hit)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # delegate to wait() so the held-set bookkeeping wraps every
+        # blocking interval individually
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait(None)
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<audited Condition {self.name!r}>"
+
+
+# ------------------------------------------------------------ factories
+_auditor: Optional[LockAuditor] = None
+_install_mu = threading.Lock()
+
+
+def install_auditor(auditor: LockAuditor) -> LockAuditor:
+    """Make ``auditor`` the process-wide auditor. Locks constructed by
+    the ``make_*`` factories AFTER this point are instrumented; locks
+    that already exist stay plain (install before construction)."""
+    global _auditor
+    with _install_mu:
+        if _auditor is not None:
+            raise RuntimeError("a LockAuditor is already installed")
+        _auditor = auditor
+    return auditor
+
+
+def uninstall_auditor() -> None:
+    global _auditor
+    with _install_mu:
+        _auditor = None
+
+
+def get_auditor() -> Optional[LockAuditor]:
+    return _auditor
+
+
+@contextlib.contextmanager
+def auditing(*, strict: bool = True, clock=time.perf_counter):
+    """Install a fresh :class:`LockAuditor` for the scope (construct the
+    audited objects INSIDE the with-block), uninstalling on exit."""
+    auditor = install_auditor(LockAuditor(strict=strict, clock=clock))
+    try:
+        yield auditor
+    finally:
+        uninstall_auditor()
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — audited iff an auditor is installed."""
+    a = _auditor
+    return _AuditedLock(name, a) if a is not None else threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — audited iff an auditor is installed."""
+    a = _auditor
+    return _AuditedRLock(name, a) if a is not None else threading.RLock()
+
+
+def make_condition(name: str, lock=None):
+    """A ``threading.Condition`` — audited iff an auditor is
+    installed. ``lock`` (optional) is the underlying raw lock."""
+    a = _auditor
+    if a is not None:
+        return _AuditedCondition(name, a, lock)
+    return threading.Condition(lock)

@@ -648,17 +648,29 @@ def main(argv=None):
                     "path (inspect with bin/tputrace)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
-    result = run_bench(n_requests=args.n_requests,
-                       overload_factor=args.overload_factor,
-                       max_new_tokens=args.max_new_tokens,
-                       max_batch=args.max_batch,
-                       prompt_len=args.prompt_len,
-                       decode_chunk=args.decode_chunk,
-                       high_fraction=args.high_fraction,
-                       ttft_bound_s=args.ttft_bound_s,
-                       seed=args.seed, trace_out=args.trace_out,
-                       metrics_port=args.metrics_port, slo=args.slo,
-                       fused_mixed=args.fused_mixed)
+    # the whole bench runs under a strict LockAuditor: every lock the
+    # serving stack constructs during the window is order-graphed, an
+    # inversion raises LockOrderError mid-bench, and the report lands in
+    # the JSON as `lock_audit` (obs_smoke gates enabled + zero
+    # violations; deliberately NOT a watched benchdiff metric)
+    from ..analysis import locks
+    auditor = locks.install_auditor(locks.LockAuditor(strict=True))
+    try:
+        result = run_bench(n_requests=args.n_requests,
+                           overload_factor=args.overload_factor,
+                           max_new_tokens=args.max_new_tokens,
+                           max_batch=args.max_batch,
+                           prompt_len=args.prompt_len,
+                           decode_chunk=args.decode_chunk,
+                           high_fraction=args.high_fraction,
+                           ttft_bound_s=args.ttft_bound_s,
+                           seed=args.seed, trace_out=args.trace_out,
+                           metrics_port=args.metrics_port, slo=args.slo,
+                           fused_mixed=args.fused_mixed)
+    finally:
+        locks.uninstall_auditor()
+    auditor.export_gauges()
+    result["lock_audit"] = auditor.report()
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -24,6 +24,7 @@ from typing import List, Optional, Tuple
 
 import jax
 
+from ..analysis import locks
 from ..utils.logging import logger
 
 
@@ -48,7 +49,7 @@ class CsvWriter(_BaseWriter):
         os.makedirs(self.out_dir, exist_ok=True)
         self._files = {}         # label -> (file handle, csv writer)
         self._claimed = {}       # sanitized filename -> owning label
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("monitor.csv_writer")
 
     def _filename(self, label):
         # "/" -> "_" is lossy: labels "a/b" and "a_b" used to land in
@@ -126,7 +127,7 @@ class MonitorMaster:
     def __init__(self, ds_config):
         self.writers: List[_BaseWriter] = []
         self.enabled = False
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("monitor.master")
         if jax.process_index() != 0:
             return
         for cfg, cls in ((ds_config.tensorboard, TensorBoardWriter),

@@ -43,6 +43,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from ...analysis import locks
 from ...telemetry import core as telemetry
 from ...telemetry.slo import SLOEngine, SLOSpec
 from ...utils.logging import logger
@@ -99,7 +100,7 @@ class ElasticController:
         self._slos = list(slos) if slos is not None else None
         self._windows_s = tuple(windows_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("fleet.elastic")
         self._sensors: Dict[int, SLOEngine] = {}
         self.target: Optional[int] = self.config.target_replicas
         self._last_action_t: Optional[float] = None
@@ -141,7 +142,8 @@ class ElasticController:
                 for rep in list(self.router.replicas) if rep.routable}
 
     def sensor(self, rid: int) -> Optional[SLOEngine]:
-        return self._sensors.get(rid)
+        with self._lock:
+            return self._sensors.get(rid)
 
     # ------------------------------------------------------ control loop
     def step(self, now: Optional[float] = None) -> Dict[str, Any]:

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ...analysis import locks
 from ...utils.logging import logger
 from ..engine import MigrationError
 from ..frontend.admission import PRIORITY_NORMAL, REJECT_FRONTEND_CLOSED
@@ -95,7 +96,7 @@ class RemoteReplica:
         self.on_crash = None
         self.tracing = _RemoteTracing(self)
         self.n_submitted = 0
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("fleet.remote")
         self._handles: Dict[int, StreamHandle] = {}  # remote uid -> handle
         self._readers: List[threading.Thread] = []
         self._closed = False

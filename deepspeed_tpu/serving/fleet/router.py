@@ -82,6 +82,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ...analysis import locks
 from ...telemetry import core as telemetry
 from ...telemetry.journey import journey_trace_events, new_trace_id
 from ...utils.logging import logger
@@ -145,7 +146,7 @@ class FleetRouter:
                              "or remote replica")
         self._clock = clock
         self.affinity = bool(affinity)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("fleet.router")
         # per-replica frontend construction knobs, kept so add_replica()
         # builds elastically grown replicas exactly like the originals
         self._admission = admission

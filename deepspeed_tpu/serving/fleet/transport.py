@@ -65,6 +65,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ...analysis import locks
 from ...telemetry.exposition import ReusableThreadingHTTPServer
 from ...utils.logging import logger
 from ..engine import MigrationError
@@ -406,7 +407,7 @@ class ReplicaServer:
         self.frontend = frontend
         self.heartbeat_s = float(heartbeat_s)
         self.verb_timeout_s = float(verb_timeout_s)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("fleet.transport")
         self._streams: Dict[int, StreamHandle] = {}
         self._stream_conns: Dict[int, Any] = {}  # uid -> raw socket
         self._httpd = ReusableThreadingHTTPServer((host, port),

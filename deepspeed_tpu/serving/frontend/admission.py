@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ...analysis import locks
 from ...telemetry import core as telemetry
 
 # machine-readable rejection reasons (the scheduler's REJECT_* constants
@@ -94,7 +95,7 @@ class ChunkThroughputEstimator:
 
     def __init__(self, alpha: float = 0.3):
         self.alpha = float(alpha)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("frontend.rate_estimator")
         self._rate: Optional[float] = None
         self.n_samples = 0
 
@@ -232,7 +233,7 @@ class AdmissionController:
                  clock: Callable[[], float] = time.monotonic):
         self.config = config or AdmissionConfig()
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("frontend.admission")
         self._heap: List[Tuple[int, int, Ticket]] = []
         self._pending = 0                    # live (non-tombstone) tickets
         self._pending_kv_tokens = 0          # their summed KV demand
@@ -375,7 +376,9 @@ class AdmissionController:
         """One locked, allocation-cheap read of every placement signal a
         fleet router needs: pending depth + bound, decision counters, and
         per-tenant rate-limit state (current bucket tokens / rate /
-        burst). No heap walk beyond the bucket dict — O(tenants)."""
+        burst). No heap walk beyond the bucket dict — O(tenants).
+        Copy-out only under the lock (scalars + one bounded dict, no
+        JSON rendering): lockcheck-audited snapshot discipline."""
         with self._lock:
             return {
                 "pending": self._pending,

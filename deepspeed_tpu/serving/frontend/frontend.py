@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ...analysis import locks
 from ...telemetry import core as telemetry
 from ...telemetry.flight_recorder import FlightRecorder
 from ...telemetry.journey import new_trace_id
@@ -88,7 +89,7 @@ class StreamHandle:
         self.slo_ttft_s = slo_ttft_s
         self.submit_t = submit_t
         self.trace_id = trace_id       # distributed journey id (immutable)
-        self._cond = threading.Condition()
+        self._cond = locks.make_condition("frontend.stream_handle")
         self._tokens: List[int] = []
         self._cursor = 0               # poll()/iterator read position
         self._status: Optional[str] = None
@@ -298,7 +299,7 @@ class ServingFrontend:
         self._emit_every_s = float(emit_every_s)
         self._last_emit_t = clock()
 
-        self._wake = threading.Condition()
+        self._wake = locks.make_condition("frontend.wake")
         self._cancel_requests: List[StreamHandle] = []
         # (kind, payload, box) migration events the driver thread
         # executes at its next iteration; callers block on box["done"]
@@ -835,11 +836,16 @@ class ServingFrontend:
             # long-running server grows without bound
             eng.scheduler.finished.clear()
         self._maybe_emit()
-        if closing and not (self._controller.pending
-                            or eng.scheduler.has_work()
-                            or eng.chunk_in_flight
-                            or self._cancel_requests or self._handles):
-            return False
+        if closing:
+            # a caller may have appended a cancel since the drain above
+            # dropped the wake lock — re-check under it before exiting
+            with self._wake:
+                cancels_drained = not self._cancel_requests
+            if cancels_drained and not (self._controller.pending
+                                        or eng.scheduler.has_work()
+                                        or eng.chunk_in_flight
+                                        or self._handles):
+                return False
         return True
 
     def _feed(self) -> None:

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ...analysis import locks
 from ...telemetry import core as telemetry
 
 _HEARTBEAT_FN = None
@@ -75,7 +76,7 @@ class BackendWatchdog:
                 and getattr(flight_recorder, "watchdog", None) is None:
             flight_recorder.watchdog = self
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("frontend.health")
         self._ok = True                  # optimistic until a probe fails
         self._consecutive_failures = 0
         self.n_beats = 0
@@ -144,10 +145,11 @@ class BackendWatchdog:
                 if self._consecutive_failures >= self.max_failures:
                     flipped_unhealthy = self._ok
                     self._ok = False
+            consecutive = self._consecutive_failures
         fr = self.flight_recorder
         if fr is not None and not ok:
             fr.record("watchdog_failure", error=error, took_s=took,
-                      consecutive=self._consecutive_failures)
+                      consecutive=consecutive)
             if flipped_unhealthy:
                 # once per healthy->unhealthy transition, not per beat
                 try:

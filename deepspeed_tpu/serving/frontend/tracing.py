@@ -36,6 +36,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Callable, Deque, Dict, List, Optional
 
+from ...analysis import locks
 from ..metrics import Reservoir
 from ...telemetry.core import count as _telemetry_count
 from ...telemetry.core import gauge as _telemetry_gauge
@@ -231,7 +232,7 @@ class TraceLog:
         self.monitor = monitor
         self.clock = clock
         self.keep_last = int(keep_last)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("frontend.tracelog")
         self._live: "OrderedDict[int, RequestTrace]" = OrderedDict()
         self._done: Deque[RequestTrace] = deque(maxlen=self.keep_last)
         self.histograms: Dict[str, Reservoir] = {
@@ -378,6 +379,9 @@ class TraceLog:
         """Per-tenant goodput accounting (the ``/tenants`` endpoint
         payload): terminal counts, tokens delivered within SLO vs
         total, and TTFT/TPOT reservoir percentiles per tenant."""
+        # per-tenant stats keep mutating under finish(): rendering
+        # INSIDE the lock is what makes each tenant row self-consistent
+        # (lockcheck-audited; the row count is small and bounded)
         with self._lock:
             tenants = {t: s.to_dict()
                        for t, s in sorted(self._tenants.items())}
@@ -400,19 +404,29 @@ class TraceLog:
         return snap
 
     def to_json(self) -> Dict[str, Any]:
+        # copy-out under the lock, render outside it: ``_done`` traces
+        # are terminal (finish() moved them here and nothing mutates
+        # them again), so their to_dict() — the bulk of this payload —
+        # must not hold up every concurrent finish()/start(). Only the
+        # still-mutating pieces (histograms, counters, _live) serialize
+        # under the lock, where rendering IS the consistency guarantee.
         with self._lock:
-            return {
-                "histograms": {
-                    name: {
-                        "p50": res.percentile(50),
-                        "p95": res.percentile(95),
-                        "p99": res.percentile(99),
-                        "n": res.n_seen,
-                    } for name, res in self.histograms.items()},
-                "counters": dict(self.counters),
-                "requests": [t.to_dict() for t in self._done],
-                "live": [t.to_dict() for t in self._live.values()],
-            }
+            done = list(self._done)
+            histograms = {
+                name: {
+                    "p50": res.percentile(50),
+                    "p95": res.percentile(95),
+                    "p99": res.percentile(99),
+                    "n": res.n_seen,
+                } for name, res in self.histograms.items()}
+            counters = dict(self.counters)
+            live = [t.to_dict() for t in self._live.values()]
+        return {
+            "histograms": histograms,
+            "counters": counters,
+            "requests": [t.to_dict() for t in done],
+            "live": live,
+        }
 
     def dump(self, path: str) -> None:
         with open(path, "w") as f:

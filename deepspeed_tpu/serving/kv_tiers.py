@@ -36,10 +36,16 @@ Thread model (the invariants the race tests pin down):
     closes EVERYTHING in flight, so an unserialized spill racing an
     unspill could complete the other thread's ops and hand back an
     uninitialized read buffer;
-  * NVMe reads run with the map lock DROPPED (only the I/O mutex held)
-    so a peer fetch of a spilled entry never stalls the engine
-    thread's admit path; the spill file is pinned for the read and a
-    concurrent promotion defers its unlink until the pin releases;
+  * NVMe reads AND writes run with the map lock DROPPED (only the I/O
+    mutex held) so neither a peer fetch of a spilled entry nor a
+    watermark spill ever stalls the engine thread's holds()/admit
+    path. A spill-in-progress entry parks in ``_spilling`` (in-memory,
+    claimable): ``holds()`` keeps answering True, a promotion or peer
+    fetch can claim/serve the payload straight from memory, and the
+    writer detects the claim when it re-acquires the map lock and
+    discards its now-orphaned file. Spilled-entry reads pin the file
+    so a concurrent promotion defers its unlink until the pin
+    releases;
   * a promotion in flight keeps the entry OUT of the tier maps (no
     double-promote) but :meth:`holds` still answers True so the
     allocator keeps deferring the request until the payload lands.
@@ -62,6 +68,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis import locks
 from ..ops.aio import AsyncIOHandle
 
 # schema tag stamped on every report() / wire bundle this module emits,
@@ -118,15 +125,20 @@ class KVTierManager:
         self._own_spill_dir = spill_dir is None
         self._spill_dir = spill_dir
         self._aio = aio if aio is not None else AsyncIOHandle()
-        self._lock = threading.RLock()
+        self._lock = locks.make_rlock("kv_tiers.map")
         # the shared AsyncIOHandle is NOT thread-safe (wait() drains and
         # closes every op/fd in flight, whoever submitted it): all aio
         # use — spill writes and unspill reads, from any thread — runs
         # under this mutex, which nests INSIDE the map lock (never take
         # the map lock while holding it)
-        self._io_lock = threading.Lock()
+        self._io_lock = locks.make_lock("kv_tiers.io")
         self._dram: "OrderedDict[bytes, _DramEntry]" = OrderedDict()
         self._nvme: "OrderedDict[bytes, _NvmeEntry]" = OrderedDict()
+        # entries mid-spill: still in host memory, owned by the thread
+        # writing them out with the map lock DROPPED. holds() counts
+        # them; promotions/fetches may claim/serve them from memory —
+        # the writer notices the claim at finalize and drops its file.
+        self._spilling: Dict[bytes, _DramEntry] = {}
         # spill files a peer fetch is reading with the map lock dropped:
         # key -> reader count; an unlink that lands mid-read parks in
         # _unlink_deferred and the last unpin performs it
@@ -156,12 +168,22 @@ class KVTierManager:
         """Admit a demoted prefix entry into the DRAM tier (called from
         the prefix cache's eviction hook — engine thread — or from
         :meth:`install_bundle` — transport thread). Overflow cascades:
-        coldest DRAM entries spill to NVMe, coldest NVMe entries drop."""
+        coldest DRAM entries spill to NVMe, coldest NVMe entries drop.
+
+        The spill WRITES run with the map lock dropped (lockcheck:
+        file IO under the map lock would stall holds()/fetch on every
+        other thread behind a disk write): overflow entries are parked
+        in ``_spilling`` under the lock, written out lock-free, and
+        published to the NVMe map — or discarded, if a concurrent
+        promotion claimed the in-memory payload mid-write — when the
+        writer re-acquires the lock."""
+        to_spill: List[Tuple[bytes, _DramEntry]] = []
         with self._lock:
             if self._closed:
                 return False
             if (key in self._dram or key in self._nvme
-                    or key in self._inflight or key in self._ready):
+                    or key in self._inflight or key in self._ready
+                    or key in self._spilling):
                 return False                 # already tiered somewhere
             leaves = {k: np.ascontiguousarray(a)
                       for k, a in leaves.items()}
@@ -170,39 +192,62 @@ class KVTierManager:
             if entry.nbytes > self.dram_capacity:
                 # an entry no empty DRAM tier could hold goes straight
                 # to NVMe (or drops if that is also too small)
-                if not self._spill(key, entry):
+                if self.nvme_capacity is not None \
+                        and entry.nbytes > self.nvme_capacity:
                     self.dropped += 1
                     return False
-                self.demotions_dram += 1
-                self._enforce_watermarks()
-                return True
-            self._dram[key] = entry
+                self._spilling[key] = entry
+                to_spill.append((key, entry))
+            else:
+                self._dram[key] = entry
             self.demotions_dram += 1
-            self._enforce_watermarks()
-            return True
+            to_spill.extend(self._collect_overflow_locked())
+        admitted = True
+        for k, e in to_spill:
+            survived = self._spill(k, e)
+            if k == key:
+                admitted = survived
+        if to_spill:
+            self._enforce_nvme_watermark()
+        return admitted
 
-    def _enforce_watermarks(self) -> None:
+    def _collect_overflow_locked(self) -> List[Tuple[bytes, _DramEntry]]:
+        """Pop DRAM overflow (coldest first) into the ``_spilling`` map.
+        Caller holds the map lock and performs the writes AFTER dropping
+        it; entries too big for the NVMe cap drop here."""
+        out: List[Tuple[bytes, _DramEntry]] = []
         while self.dram_bytes > self.dram_capacity and self._dram:
-            key, entry = self._dram.popitem(last=False)
-            if not self._spill(key, entry):
+            k, e = self._dram.popitem(last=False)
+            if self.nvme_capacity is not None \
+                    and e.nbytes > self.nvme_capacity:
                 self.dropped += 1
-        while (self.nvme_capacity is not None
-               and self.nvme_bytes > self.nvme_capacity and self._nvme):
-            key, spilled = self._nvme.popitem(last=False)
-            self._unlink_entry(key, spilled.path)
-            self.dropped += 1
+                continue
+            self._spilling[k] = e
+            out.append((k, e))
+        return out
+
+    def _enforce_nvme_watermark(self) -> None:
+        with self._lock:
+            while (self.nvme_capacity is not None
+                   and self.nvme_bytes > self.nvme_capacity
+                   and self._nvme):
+                key, spilled = self._nvme.popitem(last=False)
+                self._unlink_entry(key, spilled.path)
+                self.dropped += 1
 
     def _spill(self, key: bytes, entry: _DramEntry) -> bool:
-        """DRAM -> NVMe: one spill file per entry, the leaves' raw bytes
-        concatenated in sorted-key order, written through the aio
-        handle. Caller holds the map lock."""
-        if self.nvme_capacity is not None \
-                and entry.nbytes > self.nvme_capacity:
-            return False
+        """DRAM -> NVMe for an entry parked in ``_spilling``: one spill
+        file per entry, the leaves' raw bytes concatenated in sorted-key
+        order, written through the aio handle. Runs with the map lock
+        DROPPED — only the I/O mutex guards the write. Returns True if
+        the payload survives: published to the NVMe map, or claimed out
+        of ``_spilling`` by a concurrent promotion/close mid-write (the
+        file is then an orphan and is unlinked here)."""
         path = os.path.join(self.spill_dir,
                             f"prefix-{next(_spill_seq):08d}.kv")
         meta: List[Tuple[str, Any, Tuple[int, ...], int]] = []
         offset = 0
+        failed = False
         try:
             with self._io_lock:
                 for name in sorted(entry.leaves):
@@ -215,12 +260,19 @@ class KVTierManager:
                     offset += int(a.nbytes)
                 self._aio.wait()
         except OSError:
-            self._unlink(path)
-            return False
-        self._nvme[key] = _NvmeEntry(entry.prompt_len, entry.first_token,
-                                     path, meta, entry.nbytes)
-        self.demotions_nvme += 1
-        return True
+            failed = True
+        with self._lock:
+            still_ours = self._spilling.pop(key, None) is not None
+            if still_ours and not failed:
+                self._nvme[key] = _NvmeEntry(
+                    entry.prompt_len, entry.first_token, path, meta,
+                    entry.nbytes)
+                self.demotions_nvme += 1
+                return True
+            if still_ours:       # write failed with the data unclaimed
+                self.dropped += 1
+        self._unlink(path)       # failed write, or orphaned by a claim
+        return not still_ours
 
     def _unspill(self, spilled: _NvmeEntry) -> _DramEntry:
         """NVMe -> host numpy. Runs WITHOUT the map lock (worker or
@@ -271,7 +323,8 @@ class KVTierManager:
         entry mid-promotion must keep answering."""
         with self._lock:
             return (key in self._dram or key in self._nvme
-                    or key in self._inflight or key in self._ready)
+                    or key in self._inflight or key in self._ready
+                    or key in self._spilling)
 
     def request_promotion(self, key: bytes) -> bool:
         """Queue an async promotion (engine thread; returns immediately).
@@ -280,7 +333,8 @@ class KVTierManager:
         with self._lock:
             if self._closed or key in self._inflight or key in self._ready:
                 return False
-            if key not in self._dram and key not in self._nvme:
+            if key not in self._dram and key not in self._nvme \
+                    and key not in self._spilling:
                 return False
             self._inflight[key] = time.monotonic()
         self._queue.put(key)
@@ -316,6 +370,7 @@ class KVTierManager:
                 with self._lock:
                     self._inflight.pop(key, None)
                     self._dram.pop(key, None)
+                    self._spilling.pop(key, None)
                     spilled = self._nvme.pop(key, None)
                     if spilled is not None:
                         self._unlink_entry(key, spilled.path)
@@ -325,6 +380,10 @@ class KVTierManager:
         with self._lock:
             t0 = self._inflight.get(key)
             entry = self._dram.pop(key, None)
+            if entry is None:
+                # claim a mid-spill payload straight from memory — the
+                # writer sees the claim at finalize and drops its file
+                entry = self._spilling.pop(key, None)
             spilled = None if entry is not None \
                 else self._nvme.pop(key, None)
         if entry is None and spilled is None:
@@ -378,18 +437,21 @@ class KVTierManager:
             entry = self._dram.get(key)
             if entry is not None:
                 self._dram.move_to_end(key)
+            else:
+                # a mid-spill entry's payload is still in host memory —
+                # serve it from there (non-destructively: the writer
+                # keeps publishing it to NVMe)
+                entry = self._spilling.get(key)
+                if entry is None:
+                    entry = self._ready.get(key)
+            if entry is not None:
                 payload = (dict(entry.leaves), entry.prompt_len,
                            entry.first_token)
             else:
-                ready = self._ready.get(key)
-                if ready is not None:
-                    payload = (dict(ready.leaves), ready.prompt_len,
-                               ready.first_token)
-                else:
-                    spilled = self._nvme.get(key)
-                    if spilled is None:
-                        return None
-                    self._pins[key] = self._pins.get(key, 0) + 1
+                spilled = self._nvme.get(key)
+                if spilled is None:
+                    return None
+                self._pins[key] = self._pins.get(key, 0) + 1
         if payload is None:
             try:
                 entry = self._unspill(spilled)
@@ -406,7 +468,8 @@ class KVTierManager:
                 # an in-memory tier via a concurrent promotion — retry
                 # those once before reporting a miss
                 with self._lock:
-                    entry = self._dram.get(key) or self._ready.get(key)
+                    entry = (self._dram.get(key) or self._ready.get(key)
+                             or self._spilling.get(key))
                     if entry is None:
                         return None
                     payload = (dict(entry.leaves), entry.prompt_len,
@@ -470,7 +533,8 @@ class KVTierManager:
         with self._lock:
             return {
                 "schema": TIERS_SCHEMA,
-                "dram_entries": len(self._dram) + len(self._ready),
+                "dram_entries": (len(self._dram) + len(self._ready)
+                                 + len(self._spilling)),
                 "dram_bytes": self.dram_bytes,
                 "dram_capacity_bytes": self.dram_capacity,
                 "nvme_entries": len(self._nvme),
@@ -507,6 +571,9 @@ class KVTierManager:
             self._dram.clear()
             self._ready.clear()
             self._inflight.clear()
+            # in-flight spill writers see their claim vanish at
+            # finalize and unlink their own orphaned files
+            self._spilling.clear()
         if self._own_spill_dir and self._spill_dir is not None:
             shutil.rmtree(self._spill_dir, ignore_errors=True)
             self._spill_dir = None
@@ -519,6 +586,11 @@ class KVTierManager:
 
     def __del__(self):
         try:
+            # best-effort spill-dir cleanup at GC: the map RLock is
+            # reentrant and close() is idempotent, so a same-thread GC
+            # cannot self-deadlock; a cross-thread holder delays, never
+            # wedges, this finalizer
+            # lockcheck: disable=lock-in-finalizer
             self.close()
         except Exception:
             pass

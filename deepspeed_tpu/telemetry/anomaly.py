@@ -38,6 +38,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from ..analysis import locks
 from .core import gauge as _telemetry_gauge
 
 SCHEMA = "dstpu-anomaly-v1"
@@ -120,7 +121,7 @@ class AnomalyDetector:
         self.export_gauges = export_gauges
         self._states: Dict[str, _MetricState] = {
             m: _MetricState() for m in self.specs}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.anomaly")
         self._tripped = False
         self.n_trips = 0
         self.n_observed = 0

@@ -44,6 +44,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis import locks
+
 _US = 1e6
 
 # ---------------------------------------------------------------------------
@@ -168,7 +170,7 @@ class TelemetryRuntime:
         self.capacity = int(capacity)
         self.enabled = bool(enabled)
         self._reservoir_capacity = int(reservoir_capacity)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.runtime")
         self._events: deque = deque(maxlen=self.capacity)
         self._span_aggs: Dict[str, _SpanAgg] = {}
         self._counters: Dict[str, float] = {}

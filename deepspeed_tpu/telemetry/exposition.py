@@ -228,8 +228,24 @@ class ReusableThreadingHTTPServer(ThreadingHTTPServer):
     still in TIME_WAIT back-to-back, daemon request threads so a wedged
     handler never blocks interpreter exit. Bind with ``port=0`` for an
     ephemeral port and read the kernel's choice back from
-    ``.server_address[1]``."""
+    ``.server_address[1]``.
 
+    Lockcheck audit (handler-thread concurrency): the per-request
+    threads this mixin spawns synchronize through locks OWNED BY THE
+    STDLIB — socketserver's ``__shutdown_request`` event,
+    ``ThreadingMixIn``'s thread bookkeeping, and http.server's
+    per-connection state — none of which lockcheck's AST pass can see
+    into, and none of which our code may reach around. The audited
+    contract for code RUNNING on these threads (fleet/transport.py
+    handlers, the metrics scrape paths) is the normal one: take the
+    owning object's lock for shared maps (``ReplicaServer._lock``),
+    never block under it, and hand sockets to ``close()`` for severing
+    rather than joining handler threads. ``daemon_threads = True`` is
+    the deliberate escape hatch for the one stdlib hold we cannot
+    bound: a handler wedged in a blocking socket write would otherwise
+    block interpreter exit behind stdlib-internal joins."""
+
+    # lockcheck: disable=all — stdlib-owned locking (see audit above)
     allow_reuse_address = True
     daemon_threads = True
 

@@ -55,12 +55,14 @@ import weakref
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from ..analysis import locks
+
 SCHEMA = "dstpu-postmortem-v2"
 
 #: every live recorder, for the SIGTERM sweep (weak: recorders die with
 #: their frontends, the registry must not keep them alive)
 _REGISTRY: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = locks.make_lock("telemetry.flight_registry")
 _dump_seq = itertools.count()
 
 
@@ -82,7 +84,7 @@ class FlightRecorder:
         self.clock = clock
         self.watchdog: Any = None
         self._events: deque = deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.flight_recorder")
         self.n_recorded = 0
         self.n_dumps = 0
         self.last_postmortem_path: Optional[str] = None

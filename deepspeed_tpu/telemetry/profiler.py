@@ -52,6 +52,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis import locks
 from .core import gauge as _telemetry_gauge
 
 SCHEMA = "dstpu-profile-v1"
@@ -96,7 +97,7 @@ class ChunkProfiler:
         self._gauge = gauge_fn if gauge_fn is not None \
             else _telemetry_gauge
         self.gauge_every = max(1, int(gauge_every))
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.profiler")
         self._records: deque = deque(maxlen=int(keep_last))
         self._prefill_records: deque = deque(maxlen=int(keep_last))
         self._rolling: deque = deque(maxlen=int(window))
@@ -136,6 +137,8 @@ class ChunkProfiler:
     def on_launch(self, t0: float, t1: float, n_slots: int = 0) -> None:
         """One chunk dispatch window (the ``serve/chunk_launch``
         span). Folded into the iteration that retires next."""
+        # single-writer engine thread; GIL-atomic append (see above)
+        # lockcheck: disable=unguarded-access
         self._pending_launches.append((t0, t1, n_slots))
 
     def on_prefill(self, t0: float, t1: float, *, n: int = 0,

@@ -36,6 +36,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
+from ..analysis import locks
 from .core import gauge as _telemetry_gauge
 
 SCHEMA = "dstpu-slo-v1"
@@ -144,7 +145,7 @@ class SLOEngine:
         self._gauge = gauge_fn if gauge_fn is not None \
             else _telemetry_gauge
         self._samples: deque = deque(maxlen=int(capacity))
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("telemetry.slo")
         self.n_observed = 0
 
     # ---------------------------------------------------------- ingestion

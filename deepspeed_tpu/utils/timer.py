@@ -11,6 +11,7 @@ from typing import Dict, List, Optional
 
 import jax
 
+from ..analysis import locks
 from .logging import log_dist
 
 #: A serving run records one value per step forever; keep the rolling
@@ -65,7 +66,7 @@ class SynchronizedWallClockTimer:
         # threads (serving frontend) share one registry, and the
         # unlocked check-then-insert could hand two threads different
         # _Timer objects for the same name (one silently dropped)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("utils.timer_registry")
 
     def __call__(self, name: str) -> _Timer:
         timer = self.timers.get(name)

@@ -1,0 +1,611 @@
+"""CI gate + unit tests for the lockcheck concurrency subsystem
+(deepspeed_tpu/analysis/): Engine 1 (pure-AST lock-discipline lint +
+suppression baseline) over the whole package and per-rule seeded
+violations, Engine 2 (LockAuditor runtime lock-order graph) inversion /
+hold-time / factory semantics, the auditor over the real serving
+frontend under load, and regressions for the true positives the linter
+caught (kv_tiers spill-outside-lock, health consecutive-failure capture,
+elastic sensor locking)."""
+
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+pytestmark = pytest.mark.lockcheck
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "deepspeed_tpu")
+BASELINE = os.path.join(REPO_ROOT, "lockcheck_baseline.txt")
+
+from deepspeed_tpu.analysis import (  # noqa: E402
+    LockAuditor, LockOrderError, apply_baseline, auditing, load_baseline,
+    lockcheck, locks, make_condition, make_lock, make_rlock)
+from deepspeed_tpu.analysis import lockcli  # noqa: E402
+
+
+def _lint(src):
+    return lockcheck.lint_source(textwrap.dedent(src), "synthetic/mod.py")
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ===================================================== Engine 1: CI gate
+
+def test_package_lints_clean_against_baseline():
+    """THE gate: zero non-baselined findings and zero stale suppressions
+    over the whole package — the same ratchet tracelint runs, for lock
+    discipline. A new blocking-call-under-lock fails here; a fixed one
+    left in the baseline fails here too."""
+    findings = lockcheck.lint_paths([PKG_DIR], root=REPO_ROOT)
+    entries = load_baseline(BASELINE)
+    unsuppressed, stale, suppressed = apply_baseline(
+        findings, entries, baseline_name=lockcheck.BASELINE_FILE)
+    assert not unsuppressed, "\n".join(f.render() for f in unsuppressed)
+    assert not stale, "\n".join(f.render() for f in stale)
+    assert suppressed > 0      # the baseline is load-bearing, not empty
+
+
+def test_baseline_is_small_and_justified():
+    entries = load_baseline(BASELINE)
+    assert 1 <= len(entries) <= 25
+    for e in entries:
+        assert e.reason.strip(), e.fingerprint
+
+
+def test_cli_exit_zero_on_package(capsys):
+    rc = lockcli.main([PKG_DIR, "--root", REPO_ROOT,
+                       "--baseline", BASELINE])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "clean" in out
+
+
+# ========================================== Engine 1: per-rule seeding
+
+def test_rule_unguarded_access():
+    fs = _lint("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def drain(self):
+                with self._lock:
+                    out, self._items = self._items, []
+                return out
+
+            def peek_racy(self):
+                return self._items[-1]      # no lock: the data race
+        """)
+    assert _rules(fs) == ["unguarded-access"]
+    assert fs[0].func.endswith("peek_racy")
+
+
+def test_readonly_config_field_not_flagged():
+    """Fields never written outside __init__ are immutable config —
+    reading them unlocked is fine even if other readers hold the lock."""
+    fs = _lint("""
+        import threading
+
+        class C:
+            def __init__(self, cap):
+                self._lock = threading.Lock()
+                self.cap = cap
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    if self._n < self.cap:
+                        self._n += 1
+
+            def shrink(self):
+                with self._lock:
+                    self._n -= self.cap
+
+            def capacity(self):
+                return self.cap             # read-only: not a race
+        """)
+    assert fs == []
+
+
+def test_locked_context_helper_not_flagged():
+    """A helper called only from inside lock regions is locked-context
+    to a fixpoint: its unlocked-looking accesses are actually guarded."""
+    fs = _lint("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def bump2(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def read(self):
+                with self._lock:
+                    return self._n
+
+            def _bump_locked(self):
+                self._n += 1
+        """)
+    assert fs == []
+
+
+def test_rule_blocking_sleep_and_join_under_lock():
+    fs = _lint("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(target=lambda: None)
+
+            def bad_backoff(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def bad_shutdown(self):
+                with self._lock:
+                    self._thread.join(5.0)
+
+            def good_shutdown(self):
+                t = self._thread
+                t.join(5.0)
+        """)
+    assert _rules(fs) == ["blocking-under-lock"]
+    assert len(fs) == 2
+
+
+def test_rule_blocking_device_and_file_io_under_lock():
+    fs = _lint("""
+        import threading
+        import jax
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sync(self, x):
+                with self._lock:
+                    return jax.device_get(x)
+
+            def bad_io(self, path):
+                with self._lock:
+                    with open(path) as f:
+                        return f.read()
+        """)
+    assert _rules(fs) == ["blocking-under-lock"]
+    assert len(fs) >= 2
+
+
+def test_str_join_and_memory_io_not_flagged():
+    """`", ".join(...)` is not Thread.join; StringIO-ish writes are
+    memory, not IO — neither blocks."""
+    fs = _lint("""
+        import io
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+
+            def render(self):
+                with self._lock:
+                    buf = io.StringIO()
+                    buf.write("x")
+                    return ", ".join(self._rows) + buf.getvalue()
+        """)
+    assert fs == []
+
+
+def test_rule_wait_no_predicate():
+    fs = _lint("""
+        import threading
+
+        class P:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def bad_wait(self):
+                with self._cond:
+                    if not self._ready:
+                        self._cond.wait()      # spurious wakeup: lost
+
+            def good_wait(self):
+                with self._cond:
+                    while not self._ready:
+                        self._cond.wait()
+
+            def good_timed_idle(self):
+                with self._cond:
+                    self._cond.wait(0.05)      # timed backoff: exempt
+        """)
+    assert _rules(fs) == ["wait-no-predicate"]
+    assert len(fs) == 1 and fs[0].func.endswith("bad_wait")
+
+
+def test_rule_lock_in_finalizer():
+    fs = _lint("""
+        import threading
+
+        class F:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._open = True
+
+            def close(self):
+                with self._lock:
+                    self._open = False
+
+            def __del__(self):
+                self.close()                   # acquires via close()
+        """)
+    assert "lock-in-finalizer" in _rules(fs)
+
+
+def test_rule_lock_in_signal_handler():
+    fs = _lint("""
+        import signal
+        import threading
+
+        _LOCK = threading.Lock()
+        _hits = []
+
+        def _on_term(signum, frame):
+            with _LOCK:
+                _hits.append(signum)
+
+        signal.signal(signal.SIGTERM, _on_term)
+        """)
+    assert "lock-in-finalizer" in _rules(fs)
+
+
+def test_inline_disable_comment_honored():
+    fs = _lint("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def settle(self):
+                with self._lock:
+                    # lockcheck: disable=blocking-under-lock
+                    time.sleep(0.01)
+        """)
+    assert fs == []
+
+
+def test_cli_violation_exit_one_and_baseline_exit_zero(tmp_path, capsys):
+    bad = tmp_path / "pkg" / "mod.py"
+    bad.parent.mkdir()
+    bad.write_text(textwrap.dedent("""
+        import threading
+        import time
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spin(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """))
+    rc = lockcli.main([str(bad.parent), "--root", str(tmp_path),
+                       "--no-baseline"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "blocking-under-lock" in out
+
+    # baselined with a reason -> clean exit 0
+    base = tmp_path / "lockcheck_baseline.txt"
+    findings = lockcheck.lint_paths([str(bad.parent)], root=str(tmp_path))
+    from deepspeed_tpu.analysis import format_baseline
+    base.write_text(format_baseline(
+        findings, reasons={f.fingerprint: "test hold" for f in findings},
+        tool="lockcheck"))
+    rc = lockcli.main([str(bad.parent), "--root", str(tmp_path),
+                       "--baseline", str(base)])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_stale_suppression_exit_two(tmp_path, capsys):
+    good = tmp_path / "pkg" / "mod.py"
+    good.parent.mkdir()
+    good.write_text("x = 1\n")
+    base = tmp_path / "lockcheck_baseline.txt"
+    base.write_text("pkg/mod.py::blocking-under-lock::W.spin::"
+                    "time.sleep(1.0)  # fixed long ago\n")
+    rc = lockcli.main([str(good.parent), "--root", str(tmp_path),
+                      "--baseline", str(base)])
+    assert rc == 2
+    assert "stale" in capsys.readouterr().out
+
+
+# ================================================ Engine 2: LockAuditor
+
+def test_factories_plain_without_auditor():
+    assert locks.get_auditor() is None
+    lk, rlk = make_lock("t.plain"), make_rlock("t.plain_r")
+    assert type(lk) is type(threading.Lock())
+    assert type(rlk) is type(threading.RLock())
+    assert isinstance(make_condition("t.plain_c"), threading.Condition)
+
+
+def test_inversion_raises_with_both_stacks_no_deadlock():
+    """The headline property: the seeded A->B / B->A inversion raises
+    LockOrderError (naming both acquisition stacks) BEFORE blocking on
+    the inner lock — the test completes instead of hanging."""
+    with auditing() as aud:
+        a, b = make_lock("t.A"), make_lock("t.B")
+        with a:
+            with b:
+                pass
+        caught = []
+
+        def reversed_order():
+            try:
+                with b:
+                    with a:                      # pragma: no cover
+                        pass
+            except LockOrderError as e:
+                caught.append(e)
+
+        th = threading.Thread(target=reversed_order, daemon=True)
+        th.start()
+        th.join(5.0)
+        assert not th.is_alive(), "auditor failed open: deadlocked"
+        assert len(caught) == 1
+        err = caught[0]
+        assert err.edge == ("t.B", "t.A")
+        assert "order established" in str(err)
+        assert "reversal attempted" in str(err)
+        assert err.established_stack and err.current_stack
+        assert aud.report()["order_violations"] == 1
+
+
+def test_indirect_cycle_detected():
+    """A->B and B->C established; C->A closes the 3-cycle."""
+    with auditing() as aud:
+        a, b, c = make_lock("t.a"), make_lock("t.b"), make_lock("t.c")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(LockOrderError):
+            with c:
+                with a:                          # pragma: no cover
+                    pass
+        assert aud.report()["order_violations"] == 1
+
+
+def test_self_reacquire_plain_lock_is_reported():
+    with auditing():
+        lk = make_lock("t.self")
+        with lk:
+            with pytest.raises(LockOrderError, match="self-deadlock"):
+                lk.acquire()
+
+
+def test_rlock_reentrant_and_condition_wait():
+    with auditing() as aud:
+        r = make_rlock("t.re")
+        with r:
+            with r:                              # no self-deadlock
+                pass
+        c = make_condition("t.cond")
+        with c:
+            woke = c.wait(0.01)                  # timed idle wait
+            assert woke is False
+            c.notify_all()
+        rep = aud.report()
+        assert rep["order_violations"] == 0
+        # outermost release recorded exactly one hold for the RLock
+        assert rep["hold_mean_s"]["t.re"] >= 0.0
+
+
+def test_hold_time_accounting_with_fake_clock():
+    t = [0.0]
+    with auditing(clock=lambda: t[0]) as aud:
+        lk = make_lock("t.held")
+        lk.acquire()
+        t[0] += 2.5
+        lk.release()
+        lk.acquire()
+        t[0] += 0.5
+        lk.release()
+        rep = aud.report()
+        assert rep["hold_max_s"]["t.held"] == pytest.approx(2.5)
+        assert rep["hold_mean_s"]["t.held"] == pytest.approx(1.5)
+        assert rep["n_acquisitions"] >= 2
+
+
+def test_condition_wait_releases_order_state():
+    """While wait() blocks, the condition's lock is NOT held by the
+    waiter — the notifier acquiring (other_lock -> cond) must not be
+    read as an inversion against the waiter's (cond -> ...) stack."""
+    with auditing() as aud:
+        cond = make_condition("t.wake")
+        other = make_lock("t.state")
+        done = threading.Event()
+
+        def waiter():
+            with cond:
+                cond.wait(2.0)
+            done.set()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        with other:                 # notifier holds state lock...
+            with cond:              # ...then the condition: an order
+                cond.notify_all()   # the waiter must not contradict
+        assert done.wait(2.0)
+        th.join(2.0)
+        assert aud.report()["order_violations"] == 0
+
+
+def test_export_gauges_publishes_hold_metrics():
+    from deepspeed_tpu.telemetry import core as telemetry
+    runtime = telemetry.get_runtime()
+    was_enabled = runtime.enabled
+    runtime.enabled = True
+    try:
+        with auditing() as aud:
+            lk = make_lock("t.gauged")
+            with lk:
+                pass
+            aud.export_gauges()
+        gauges = runtime.gauge_values()
+    finally:
+        runtime.enabled = was_enabled
+    assert any(n.startswith("lock/hold_max_s") and "t.gauged" in n
+               for n in gauges), sorted(gauges)
+    assert gauges.get("lock/order_violations") == 0.0
+
+
+def test_install_is_exclusive():
+    with auditing():
+        with pytest.raises(RuntimeError):
+            locks.install_auditor(LockAuditor())
+    assert locks.get_auditor() is None
+
+
+# ===================== Engine 2 over the real stack (no false positives)
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.benchmarks.serving_bench import _tiny_model
+    model, params = _tiny_model()
+    return ds.init_inference(model, model_parameters=params,
+                             dtype=jnp.float32)
+
+
+def test_frontend_under_auditor_no_violations(tiny_engine):
+    """Construct the real ServingEngine + ServingFrontend inside a
+    strict auditor and stream real requests through the driver thread:
+    the production lock orderings must produce ZERO violations (this is
+    the no-false-positive gate for the runtime half)."""
+    from deepspeed_tpu.serving import ServingEngine
+    from deepspeed_tpu.serving.frontend import ServingFrontend
+    with auditing() as aud:
+        eng = ServingEngine(engine=tiny_engine, max_batch=2,
+                            max_prompt_len=16, decode_chunk=4,
+                            max_queue=8)
+        fe = ServingFrontend(eng)
+        try:
+            handles = [fe.submit([1, 2, 3, i + 4], max_new_tokens=4)
+                       for i in range(4)]
+            for h in handles:
+                list(h)
+                assert h.status == "done"
+        finally:
+            fe.close()
+        rep = aud.report()
+    assert rep["order_violations"] == 0, rep
+    assert rep["n_acquisitions"] > 0
+    assert any(n.startswith("frontend.") for n in rep["locks"]), rep
+
+
+# ======================= regressions for the fixed lockcheck positives
+
+def test_kv_tiers_spill_write_happens_outside_map_lock(tmp_path):
+    """The tentpole true positive: the NVMe spill write must run with
+    the map lock DROPPED (only the io mutex held) — holds()/fetch keep
+    serving the parked `_spilling` payload from memory mid-write."""
+    from deepspeed_tpu.serving.kv_tiers import KVTierManager
+    import numpy as np
+    mgr = KVTierManager(dram_bytes=1, spill_dir=str(tmp_path))
+    try:
+        during_write = []
+        orig_pwrite = mgr._aio.async_pwrite
+
+        def spy(flat, path, offset):
+            # probe from a FOREIGN thread: the map RLock must be free
+            # during the NVMe write (the io mutex alone serializes it),
+            # and the payload must be parked claimable in _spilling
+            free = []
+            t = threading.Thread(target=lambda: free.append(
+                mgr._lock.acquire(blocking=False) and
+                (mgr._lock.release() or True)))
+            t.start()
+            t.join(2.0)
+            during_write.append((bool(free and free[0]),
+                                 len(mgr._spilling) > 0,
+                                 mgr.holds(b"k1")))
+            return orig_pwrite(flat, path, offset)
+
+        mgr._aio.async_pwrite = spy
+        leaves = {"layer0/k": np.arange(64, dtype=np.float32)}
+        assert mgr.admit(b"k1", 8, 0, leaves) is True  # oversize -> spill
+        assert during_write, "spill write never happened"
+        for map_lock_free, parked, visible in during_write:
+            assert map_lock_free, "map lock held across the NVMe write"
+            assert parked and visible
+        assert mgr.holds(b"k1")
+        rep = mgr.report()
+        assert rep["demotions_nvme"] >= 1
+        assert not mgr._spilling                    # published + cleaned
+    finally:
+        mgr.close()
+
+
+def test_health_records_consecutive_failures_from_locked_snapshot():
+    """Regression for the unguarded `_consecutive_failures` read: the
+    flight-recorder annotation must carry the count captured INSIDE the
+    lock, consistent with the status transition it describes."""
+    from deepspeed_tpu.serving.frontend.health import BackendWatchdog
+
+    class _Rec:
+        watchdog = None
+
+        def __init__(self):
+            self.events = []
+
+        def record(self, kind, **fields):
+            self.events.append((kind, fields))
+
+    rec = _Rec()
+    wd = BackendWatchdog(heartbeat_fn=lambda: None, max_failures=10,
+                         flight_recorder=rec)
+    for _ in range(3):
+        wd._record(False, 0.01, "probe timeout")
+    consec = [f.get("consecutive") for _, f in rec.events
+              if "consecutive" in f]
+    assert consec == [1, 2, 3]          # captured inside the lock
+    wd._record(True, 0.01, None)        # recovery resets the streak
+    assert wd.state()["consecutive_failures"] == 0
+
+
+def test_elastic_sensor_lookup_is_locked():
+    """Regression: ElasticController.sensor() reads `_sensors` under the
+    controller lock (it races add/remove from the poll thread)."""
+    import inspect
+    from deepspeed_tpu.serving.fleet import elastic
+    src = inspect.getsource(elastic.ElasticController.sensor)
+    assert "with self._lock" in src
