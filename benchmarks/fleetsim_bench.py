@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Repo-root entry point for the fleet simulator benchmark.
+
+Thin wrapper over ``deepspeed_tpu.benchmarks.fleetsim_bench`` so the
+canonical invocation from a checkout is simply::
+
+    JAX_PLATFORMS=cpu python benchmarks/fleetsim_bench.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.benchmarks.fleetsim_bench import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Fleetsim smoke: the hierarchical control plane at 1000 sim replicas
+# on the discrete-event clock. Three asserted cases: RootRouter.submit
+# wall p99 at 1000 replicas within 2x the p99 at 10 (same pod size —
+# placement must stay flat in fleet size); a hot-prefix storm's
+# hierarchical prefix hit rate within 10% of the flat-router oracle
+# probing all 1000 replicas; and a chaos schedule (pod loss mid-stream,
+# zombie, healed + unhealed partitions, clock skew, slowdown) with ZERO
+# lost and ZERO duplicated streams by exact token-oracle audit, exactly
+# two watchdog kills (the zombie and the unhealed partition — the
+# skewed replica must survive), at least one cross-pod failover, and a
+# byte-for-byte reproducible event log under the same seed (sha256
+# compared across two full runs; a third run on a different seed must
+# diverge). Writes BENCH_fleetsim.json at the repo root and exits
+# nonzero on any bound/determinism failure. Host-side only — the
+# simulator never imports JAX — and runs in seconds, fast enough for
+# tier-1.
+#
+# Usage: bin/fleetsim_smoke.sh        (from the repo root, or anywhere)
+
+cd "$(dirname "$0")/.." || exit 1
+
+exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m deepspeed_tpu.benchmarks.fleetsim_bench \
+    --json-out BENCH_fleetsim.json

@@ -24,7 +24,7 @@ python bin/lockcheck deepspeed_tpu || exit $?
 # a bench schema drift fails here, not after a full bench round. The
 # full gate (seeded regression + live scrape) is bin/obs_smoke.sh.
 for bench in BENCH_serving.json BENCH_frontend.json BENCH_fleet.json \
-             BENCH_kernels.json; do
+             BENCH_kernels.json BENCH_fleetsim.json; do
     [ -f "$bench" ] && { python bin/benchdiff "$bench" "$bench" \
         --fail-on-missing --quiet || exit $?; }
 done

@@ -15,10 +15,17 @@ Two generations under one heritage surface:
   ``retire_replica`` from per-replica SLO burn rates and drain-time
   estimates, with graceful drain and in-flight replay of prefilled
   requests on crash. See docs/serving.md "Elastic fleet".
+
+:func:`~deepspeed_tpu.serving.fleet.elastic
+.elastic_config_from_elasticity` bridges the two: the training-side
+min/max-replica schedule (the ``elasticity`` config block's valid world
+sizes) parses into the per-pod serving :class:`ElasticConfig` a
+hierarchical fleet's pod controllers run.
 """
 
 from ..serving.fleet.elastic import (ElasticConfig,  # noqa: F401
-                                     ElasticController)
+                                     ElasticController,
+                                     elastic_config_from_elasticity)
 from .elasticity import (ElasticityConfig, ElasticityConfigError,
                          ElasticityError, ElasticityIncompatibleWorldSize,
                          compute_elastic_config, elasticity_enabled,
@@ -29,4 +36,5 @@ __all__ = ["compute_elastic_config", "elasticity_enabled",
            "ensure_immutable_elastic_config", "ElasticityConfig",
            "ElasticityError", "ElasticityConfigError",
            "ElasticityIncompatibleWorldSize", "highly_composite_numbers",
-           "ElasticController", "ElasticConfig"]
+           "ElasticController", "ElasticConfig",
+           "elastic_config_from_elasticity"]

@@ -10,17 +10,40 @@ the process: :class:`ReplicaServer` exposes one frontend over the
 ``dstpu-fleet-v1`` streaming HTTP transport and :class:`RemoteReplica`
 drives it from the router's side (``FleetRouter.add_remote``), with
 live KV-block migration (``FleetRouter.migrate`` / ``rebalance``)
-re-homing running requests across the wire mid-decode. See
-docs/serving.md.
+re-homing running requests across the wire mid-decode.
+
+Beyond one flat router, :mod:`.hierarchy` scales placement two-level:
+:class:`LeafRouter` pods behind one :class:`RootRouter` placing by
+consistent-hash prefix→pod over cached pod aggregates, with cross-pod
+migration/failover and per-pod elastic policy. :mod:`.sim` is the
+deterministic discrete-event simulator that validates the whole
+control plane at 1000 replicas (chaos injection included) without an
+engine in sight. See docs/serving.md.
 """
 
-from .elastic import ElasticConfig, ElasticController  # noqa: F401
+from .elastic import (ElasticConfig, ElasticController,  # noqa: F401
+                      elastic_config_from_elasticity)
+from .hierarchy import (ConsistentHashRing, LeafRouter,  # noqa: F401
+                        REJECT_POD_OVERLOADED, RootConfig, RootRouter)
 from .router import FleetReplica, FleetRouter  # noqa: F401
 from .transport import (FLEET_SCHEMA, ReplicaServer,  # noqa: F401
                         decode_bundle, encode_bundle)
 from .remote import RemoteReplica  # noqa: F401
+from .sim import (ChaosInjector, FleetWatchdog, SimClock,  # noqa: F401
+                  SimReplica, SimReplicaConfig, SimWorld,
+                  build_sim_fleet, diurnal_trace, hot_prefix_storm,
+                  multi_turn_trace, run_trace, sim_expected,
+                  tenant_skew_trace, verify_streams)
 
 __all__ = ["FleetRouter", "FleetReplica",
            "ElasticController", "ElasticConfig",
+           "elastic_config_from_elasticity",
            "ReplicaServer", "RemoteReplica", "FLEET_SCHEMA",
-           "encode_bundle", "decode_bundle"]
+           "encode_bundle", "decode_bundle",
+           "ConsistentHashRing", "LeafRouter", "RootRouter",
+           "RootConfig", "REJECT_POD_OVERLOADED",
+           "SimClock", "SimWorld", "SimReplica", "SimReplicaConfig",
+           "FleetWatchdog", "ChaosInjector", "build_sim_fleet",
+           "run_trace", "verify_streams", "sim_expected",
+           "diurnal_trace", "tenant_skew_trace", "hot_prefix_storm",
+           "multi_turn_trace"]

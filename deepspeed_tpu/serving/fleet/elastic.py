@@ -255,3 +255,61 @@ class ElasticController:
                 "actions": [dict(a) for a in self.actions],
                 "sensors": sorted(self._sensors),
             }
+
+
+def elastic_config_from_elasticity(ds_config: dict, *, n_pods: int = 1,
+                                   **overrides) -> ElasticConfig:
+    """Parse a DeepSpeed ``elasticity`` config block into a per-pod
+    serving :class:`ElasticConfig` — the heritage surface wired to the
+    fleet instead of lying dormant.
+
+    The training-side schedule constrains which WORLD SIZES (device
+    counts) the resource scheduler may run the job at:
+    ``compute_elastic_config`` picks the batch size admitting the most
+    valid worlds, and min/max of that valid set are the schedule's
+    hard replica bounds. Serving maps those fleet-wide bounds onto
+    ``n_pods`` equal pods (ceil-divided, so the pods together can
+    always reach the fleet-wide max), and the smallest valid world is
+    the steady-state target:
+
+    * ``min_replicas``  = max(1, min(valid_worlds) // n_pods)
+    * ``max_replicas``  = ceil(max(valid_worlds) / n_pods)
+    * ``target_replicas`` defaults to ``min_replicas`` (grow on burn)
+
+    ``min_time`` and ``ignore_non_elastic_batch_info`` are parsed by
+    :class:`~...elasticity.elasticity.ElasticityConfig` for schema
+    compatibility but have no serving-side behavior (there is no train
+    loop to time and no non-elastic batch block to ignore) — they are
+    accepted and logged, never silently load-bearing. Keyword
+    ``overrides`` pass through to :class:`ElasticConfig` (burn
+    thresholds, cooldown, ...) after the schedule-derived fields."""
+    # function-local import: ``elasticity/__init__`` re-exports THIS
+    # module's classes, so a top-level import would be circular
+    from ...elasticity.elasticity import (ElasticityConfig,
+                                          compute_elastic_config)
+    if n_pods < 1:
+        raise ValueError(f"n_pods must be >= 1, got {n_pods}")
+    ec = ElasticityConfig(ds_config.get("elasticity", {}))
+    if ec.min_time:
+        logger.info("elasticity.min_time has no serving-side effect "
+                    "(no train loop to time); ignoring")
+    if ec.ignore_non_elastic_batch_info:
+        logger.info("elasticity.ignore_non_elastic_batch_info has no "
+                    "serving-side effect; ignoring")
+    _, valid_worlds = compute_elastic_config(ds_config)[:2]
+    if not valid_worlds:
+        raise ValueError("elasticity schedule admits no valid world "
+                         "sizes — nothing to scale between")
+    lo, hi = min(valid_worlds), max(valid_worlds)
+    fields = {
+        "min_replicas": max(1, lo // n_pods),
+        "max_replicas": max(1, -(-hi // n_pods)),
+        "target_replicas": max(1, lo // n_pods),
+    }
+    fields.update(overrides)
+    cfg = ElasticConfig(**fields)
+    logger.info(f"elasticity schedule -> per-pod ElasticConfig: worlds "
+                f"{lo}..{hi} over {n_pods} pod(s) -> "
+                f"min={cfg.min_replicas} max={cfg.max_replicas} "
+                f"target={cfg.target_replicas}")
+    return cfg

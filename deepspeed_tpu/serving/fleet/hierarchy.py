@@ -1,0 +1,846 @@
+"""Hierarchical fleet routing: pods of replicas behind one root.
+
+One flat :class:`~.router.FleetRouter` scores every replica per submit
+— O(N) probes from one process's view, the structural ceiling on the
+ROADMAP's millions-of-users north star. This module splits placement
+into two levels:
+
+* **LeafRouter** — a ``FleetRouter`` that owns one *pod* of replicas.
+  Per-pod placement (health → prefix affinity → least-loaded) is
+  exactly today's policy, unchanged; the leaf additionally publishes a
+  cached pod-level aggregate (``pod_snapshot``) and, when a crash or
+  drain leaves the pod with no routable survivor, escalates the
+  re-home to the root instead of erroring the stream.
+* **RootRouter** — places by pod-level aggregates only. A consistent-
+  hash ring (stable blake2b digest, virtual nodes) maps the prompt's
+  prefix key to a pod, so prefix affinity survives WITHOUT probing
+  every replica's cache: all repeats of a hot prompt land in one pod
+  and the leaf's existing affinity probe finds the holder among a
+  bounded pod-sized candidate set. Pod join/leave moves only the
+  minimal key range (the ring property), adapter/tenant pins override
+  the ring, and global admission sheds at the edge — an overloaded pod
+  rejects the request up front (``pod_overloaded``) instead of
+  queueing it into a doomed backlog.
+
+``migrate()``/``rebalance()`` generalize the flat router's live
+KV-block migration to cross-pod moves: the bundle exports from the
+source pod's replica and imports into the destination pod's over the
+same ``dstpu-fleet-v1`` surface (in-process or remote — the frontends
+are interchangeable). The ``elasticity/`` heritage wires in as
+*per-pod* policy: each pod gets its own
+:class:`~.elastic.ElasticController` scaling off that pod's own
+sensors, while the root only adds/retires whole pods
+(``add_pod``/``retire_pod``).
+
+Telemetry: pod-labelled gauges ride the embedded-label mechanism the
+tenant/replica series use (``fleet/pod_drain_s|pod=<id>``); root-level
+counters (``fleet/pod_shed``, ``fleet/pod_spill``,
+``fleet/pod_failover``, ``fleet/cross_pod_migrated``,
+``fleet/pod_lost``, ``fleet/pod_retired``) are fleet-wide. Journey
+hops are pod-qualified (``<pod>/<rid>``) in the merged journal.
+
+Host-side only — this module never imports JAX.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import hashlib
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ...analysis import locks
+from ...telemetry import core as telemetry
+from ...utils.logging import logger
+from ..engine import MigrationError
+from ..frontend.admission import PRIORITY_NORMAL
+from ..frontend.frontend import StreamHandle
+from ..paged_kv import PrefixCache
+from ..scheduler import Request
+from .elastic import ElasticConfig, ElasticController
+from .router import FleetReplica, FleetRouter
+
+#: machine-readable rejection reason for edge shedding: every pod the
+#: ring (plus spill) offered was over its admission bar, so the root
+#: rejected at the edge instead of queueing into a doomed backlog.
+REJECT_POD_OVERLOADED = "pod_overloaded"
+
+
+class ConsistentHashRing:
+    """Consistent hashing with virtual nodes over a stable digest.
+
+    Points come from blake2b — never Python ``hash()``, whose
+    per-process randomization (PYTHONHASHSEED) would scatter a fleet's
+    placement across restarts and processes. Each pod contributes
+    ``vnodes`` points; a key maps to the first pod point at or after
+    its own point (wrapping). Adding/removing one pod therefore moves
+    only the key ranges adjacent to that pod's points — about
+    ``1/pods`` of the keyspace — and nothing else.
+    """
+
+    def __init__(self, *, vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = int(vnodes)
+        self._points: List[int] = []      # sorted vnode points
+        self._owners: List[str] = []      # _owners[i] owns _points[i]
+        self._pods: Dict[str, List[int]] = {}
+
+    @staticmethod
+    def point(data: bytes) -> int:
+        """Stable 64-bit ring point for arbitrary bytes."""
+        return int.from_bytes(
+            hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+    def __len__(self) -> int:
+        return len(self._pods)
+
+    def __contains__(self, pod_id: str) -> bool:
+        return pod_id in self._pods
+
+    @property
+    def pods(self) -> List[str]:
+        return sorted(self._pods)
+
+    def add_pod(self, pod_id: str) -> None:
+        pod_id = str(pod_id)
+        if pod_id in self._pods:
+            return
+        pts = []
+        for i in range(self.vnodes):
+            p = self.point(f"{pod_id}#{i}".encode("utf-8"))
+            idx = bisect.bisect_left(self._points, p)
+            # digest collisions across distinct vnode labels are
+            # ~2^-64; skip rather than silently double-own a point
+            if idx < len(self._points) and self._points[idx] == p:
+                continue
+            self._points.insert(idx, p)
+            self._owners.insert(idx, pod_id)
+            pts.append(p)
+        self._pods[pod_id] = pts
+
+    def remove_pod(self, pod_id: str) -> None:
+        pts = self._pods.pop(str(pod_id), None)
+        if pts is None:
+            return
+        for p in pts:
+            idx = bisect.bisect_left(self._points, p)
+            if idx < len(self._points) and self._points[idx] == p:
+                del self._points[idx]
+                del self._owners[idx]
+
+    def pod_for(self, key: bytes) -> Optional[str]:
+        """Owner pod of ``key``, or None on an empty ring."""
+        if not self._points:
+            return None
+        idx = bisect.bisect_right(self._points, self.point(key))
+        if idx == len(self._points):
+            idx = 0
+        return self._owners[idx]
+
+    def pods_for(self, key: bytes, n: int) -> List[str]:
+        """First ``n`` DISTINCT pods walking the ring clockwise from
+        ``key`` — the primary owner first, then spill candidates in
+        deterministic ring order."""
+        if not self._points or n < 1:
+            return []
+        out: List[str] = []
+        start = bisect.bisect_right(self._points, self.point(key))
+        for off in range(len(self._points)):
+            owner = self._owners[(start + off) % len(self._points)]
+            if owner not in out:
+                out.append(owner)
+                if len(out) >= n:
+                    break
+        return out
+
+
+class LeafRouter(FleetRouter):
+    """One pod's ``FleetRouter``: flat placement within the pod,
+    plus the pod-aggregate surface the root places by.
+
+    ``pod_snapshot()`` is cached for ``agg_ttl_s`` (on the router's
+    injectable clock, so simulators stay deterministic): the root's
+    per-submit overload check costs O(1) amortized instead of
+    re-probing the pod. ``add_replica`` additionally accepts a factory
+    that yields a frontend-surface object (``submit``/``driver_alive``)
+    — a ``RemoteReplica`` or a sim replica — joining it via the remote
+    path, so per-pod elastic growth works for every replica flavor.
+    """
+
+    def __init__(self, pod_id: str, engines: Sequence[Any] = (), *,
+                 agg_ttl_s: float = 0.05, **kwargs):
+        self.pod_id = str(pod_id)
+        self.agg_ttl_s = float(agg_ttl_s)
+        self._root: Optional["RootRouter"] = None
+        self._agg_lock = locks.make_lock("fleet.leaf_agg")
+        self._agg: Optional[Dict[str, Any]] = None
+        self._agg_t: float = float("-inf")
+        super().__init__(engines, **kwargs)
+
+    # ----------------------------------------------------- pod aggregate
+    def pod_snapshot(self, *,
+                     max_age_s: Optional[float] = None) -> Dict[str, Any]:
+        """Pod-level placement aggregate: routable count, admission
+        pending, outstanding engine tokens, summed throughput, and the
+        derived drain-time estimate. Cached for ``agg_ttl_s`` (override
+        with ``max_age_s``; 0 forces a fresh probe)."""
+        ttl = self.agg_ttl_s if max_age_s is None else float(max_age_s)
+        now = self._clock()
+        with self._agg_lock:
+            if self._agg is not None and now - self._agg_t < ttl:
+                return self._agg
+        reps = [r for r in self.replicas if r.routable]
+        pending = 0
+        backlog = 0.0
+        rate = 0.0
+        for r in reps:
+            snap = r.frontend.load_snapshot()
+            pending += int(snap["admission"]["pending"])
+            backlog += float(snap["engine_backlog_tokens"])
+            tps = snap["throughput"]["tokens_per_s"]
+            if tps:
+                rate += float(tps)
+        outstanding = backlog + pending
+        agg = {
+            "pod": self.pod_id,
+            "routable": len(reps),
+            "pending": pending,
+            "backlog_tokens": backlog,
+            "tokens_per_s": rate or None,
+            "drain_s": outstanding / rate if rate else outstanding,
+        }
+        with self._agg_lock:
+            self._agg = agg
+            self._agg_t = now
+        telemetry.gauge(f"fleet/pod_routable|pod={self.pod_id}",
+                        float(agg["routable"]))
+        telemetry.gauge(f"fleet/pod_drain_s|pod={self.pod_id}",
+                        float(agg["drain_s"]))
+        telemetry.gauge(f"fleet/pod_backlog_tokens|pod={self.pod_id}",
+                        float(agg["backlog_tokens"]))
+        return agg
+
+    # ------------------------------------------------------- elasticity
+    def add_replica(self, engine: Any = None, *,
+                    warm_start: bool = True) -> FleetReplica:
+        if engine is None:
+            if self.replica_factory is None:
+                raise ValueError(
+                    "add_replica() needs an engine or a replica_factory")
+            engine = self.replica_factory()
+        if hasattr(engine, "submit") and hasattr(engine, "driver_alive"):
+            # frontend-surface product (RemoteReplica / SimReplica):
+            # join it on the remote path — no in-process driver thread
+            return self.add_remote(engine)
+        return super().add_replica(engine, warm_start=warm_start)
+
+    # ------------------------------------------------------ crash drain
+    def _reroute(self, handle: StreamHandle,
+                 exc: Optional[BaseException] = None,
+                 src_rid: Any = None,
+                 postmortem: Optional[str] = None) -> None:
+        """Pod-local re-home first; when the whole pod is down (pod
+        loss), escalate to the root so a survivor pod adopts the
+        stream instead of erroring it."""
+        if (self._root is not None
+                and not any(r.routable for r in self.replicas)):
+            if self._root._adopt_foreign(handle, src_pod=self.pod_id,
+                                         src_rid=src_rid, exc=exc):
+                return
+        super()._reroute(handle, exc, src_rid=src_rid,
+                         postmortem=postmortem)
+
+
+@dataclasses.dataclass
+class RootConfig:
+    """Root placement policy knobs.
+
+    ``shed_drain_s``/``shed_pending`` arm global admission: a pod whose
+    estimated drain time (or admission-pending count) exceeds the bar
+    is *overloaded* and the root spills to the next ``spill`` distinct
+    pods on the ring before shedding at the edge. Both None (default)
+    means never shed on load — only a pod with zero routable replicas
+    is skipped. ``agg_ttl_s`` is the default pod-aggregate cache age a
+    newly added ``LeafRouter`` is built with (pre-built leaves keep
+    their own)."""
+    vnodes: int = 64
+    spill: int = 2
+    shed_drain_s: Optional[float] = None
+    shed_pending: Optional[int] = None
+    agg_ttl_s: float = 0.05
+
+
+class RootRouter:
+    """Two-level fleet placement: consistent-hash prefix→pod, then the
+    pod's own ``LeafRouter`` picks the replica.
+
+    The root never probes individual replicas: its per-submit work is
+    one ring lookup plus O(spill) cached pod aggregates — flat in
+    fleet size. ``submit`` matches ``FleetRouter.submit`` (plus
+    ``adapter=``); the returned handle is the leaf replica's ordinary
+    ``StreamHandle``, or an edge-rejected one (``pod_overloaded``)
+    when global admission sheds."""
+
+    def __init__(self, *, config: Optional[RootConfig] = None,
+                 elastic: Optional[ElasticConfig] = None,
+                 clock=time.monotonic):
+        self.config = config or RootConfig()
+        self._clock = clock
+        self._elastic = elastic
+        self._lock = locks.make_lock("fleet.hierarchy")
+        self._ring = ConsistentHashRing(vnodes=self.config.vnodes)
+        self.pods: Dict[str, LeafRouter] = {}
+        self.controllers: Dict[str, ElasticController] = {}
+        # adapter/tenant affinity pins: a pinned id overrides the ring
+        # (LoRA adapters resident in one pod; a tenant's dedicated pod)
+        self._tenant_pins: Dict[str, str] = {}
+        self._adapter_pins: Dict[str, str] = {}
+        self._retiring: set = set()
+        self._lost: set = set()
+        self.n_routed = 0
+        self.n_shed = 0
+        self.n_spilled = 0
+        self.n_pod_failover = 0
+        self.n_cross_migrated = 0
+        self.n_cross_migrate_failed = 0
+        self.cross_migrate_bytes = 0
+        self.n_pods_lost = 0
+        self.n_pods_retired = 0
+        self._placements: deque = deque(maxlen=4096)
+        self._reroutes: deque = deque(maxlen=1024)
+        self._migrations: deque = deque(maxlen=1024)
+
+    # ------------------------------------------------------ pod lifecycle
+    def add_pod(self, pod_id: str, *, engines: Sequence[Any] = (),
+                remotes: Optional[Sequence[Any]] = None,
+                leaf: Optional[LeafRouter] = None,
+                **leaf_kwargs) -> LeafRouter:
+        """Join one pod: either a pre-built ``LeafRouter`` (``leaf=``)
+        or one constructed here from ``engines``/``remotes``. The ring
+        gains the pod's virtual nodes (moving ~1/pods of the keyspace
+        onto it); with an ``elastic`` template the pod gets its own
+        ``ElasticController`` stepping off its own sensors."""
+        pod_id = str(pod_id)
+        if pod_id in self.pods:
+            raise ValueError(f"pod {pod_id!r} already joined")
+        if leaf is None:
+            leaf = LeafRouter(pod_id, engines, remotes=remotes,
+                              agg_ttl_s=self.config.agg_ttl_s,
+                              clock=self._clock, **leaf_kwargs)
+        leaf._root = self
+        self.pods[pod_id] = leaf
+        self._ring.add_pod(pod_id)
+        with self._lock:
+            self._lost.discard(pod_id)
+        if self._elastic is not None:
+            self.controllers[pod_id] = ElasticController(
+                leaf, dataclasses.replace(self._elastic),
+                clock=self._clock)
+        telemetry.count("fleet/pod_join")
+        telemetry.gauge("fleet/pods", float(len(self.pods)))
+        logger.info(f"fleet pod {pod_id} joined "
+                    f"({len(leaf.replicas)} replicas)")
+        return leaf
+
+    def retire_pod(self, pod_id: str) -> bool:
+        """Gracefully drain one pod out of the fleet: its key range
+        redistributes to the survivors (minimal movement), every
+        replica drains, and admission tails re-home cross-pod through
+        the failover path. ``poll_retiring()`` finalizes."""
+        pod_id = str(pod_id)
+        leaf = self.pods.get(pod_id)
+        with self._lock:
+            if leaf is None or pod_id in self._retiring:
+                return False
+            self._retiring.add(pod_id)
+        self._ring.remove_pod(pod_id)
+        self.controllers.pop(pod_id, None)
+        for rep in list(leaf.replicas):
+            if rep.routable:
+                leaf.retire_replica(rep.rid, min_routable=0)
+        telemetry.count("fleet/pod_retiring")
+        logger.info(f"fleet pod {pod_id} retiring")
+        return True
+
+    def poll_retiring(self) -> List[str]:
+        """Finalize pod retirements whose replicas have all drained;
+        returns the pod ids removed by this call."""
+        done: List[str] = []
+        with self._lock:
+            retiring = list(self._retiring)
+        for pod_id in retiring:
+            leaf = self.pods.get(pod_id)
+            if leaf is None:
+                with self._lock:
+                    self._retiring.discard(pod_id)
+                continue
+            leaf.poll_draining()
+            if any(r.alive and not r.retired for r in leaf.replicas):
+                continue
+            leaf.close(timeout=5.0)
+            del self.pods[pod_id]
+            with self._lock:
+                self._retiring.discard(pod_id)
+                self.n_pods_retired += 1
+            telemetry.count("fleet/pod_retired")
+            telemetry.gauge("fleet/pods", float(len(self.pods)))
+            logger.info(f"fleet pod {pod_id} retired")
+            done.append(pod_id)
+        return done
+
+    def mark_pod_lost(self, pod_id: str) -> bool:
+        """Abrupt pod loss (chaos, rack failure): the pod leaves the
+        ring immediately so fresh placements stop landing on it;
+        in-flight streams re-home through the crash-salvage path."""
+        pod_id = str(pod_id)
+        with self._lock:
+            if pod_id not in self.pods or pod_id in self._lost:
+                return False
+            self._lost.add(pod_id)
+            self.n_pods_lost += 1
+            placeable = len(self.pods) - len(self._lost)
+        self._ring.remove_pod(pod_id)
+        self.controllers.pop(pod_id, None)
+        telemetry.count("fleet/pod_lost")
+        telemetry.gauge("fleet/pods", float(placeable))
+        logger.error(f"fleet pod {pod_id} lost")
+        return True
+
+    def step(self) -> Dict[str, Any]:
+        """One root control tick: step every pod's elastic controller,
+        finalize pod retirements, and auto-detect lost pods (a pod
+        with zero alive replicas leaves the ring)."""
+        for pod_id, leaf in list(self.pods.items()):
+            with self._lock:
+                skip = pod_id in self._lost or pod_id in self._retiring
+            if skip:
+                continue
+            if not any(r.alive for r in leaf.replicas):
+                self.mark_pod_lost(pod_id)
+        records = {pod_id: ctrl.step()
+                   for pod_id, ctrl in list(self.controllers.items())}
+        retired = self.poll_retiring()
+        with self._lock:
+            lost = sorted(self._lost)
+        return {"pods": len(self.pods), "lost": lost,
+                "retired": retired, "elastic": records}
+
+    # --------------------------------------------------- affinity pins
+    def pin_tenant(self, tenant: str, pod_id: Optional[str]) -> None:
+        """Pin (or with None, unpin) a tenant's placements to one pod."""
+        if pod_id is None:
+            self._tenant_pins.pop(tenant, None)
+        else:
+            self._tenant_pins[tenant] = str(pod_id)
+
+    def pin_adapter(self, adapter: str, pod_id: Optional[str]) -> None:
+        """Pin (or with None, unpin) an adapter's placements to one pod
+        — LoRA-style adapters resident in one pod's HBM route there."""
+        if pod_id is None:
+            self._adapter_pins.pop(adapter, None)
+        else:
+            self._adapter_pins[adapter] = str(pod_id)
+
+    # --------------------------------------------------------- placement
+    def _placeable(self, pod_id: str) -> Optional[LeafRouter]:
+        with self._lock:
+            if pod_id in self._lost or pod_id in self._retiring:
+                return None
+        return self.pods.get(pod_id)
+
+    def _overloaded(self, leaf: LeafRouter) -> bool:
+        snap = leaf.pod_snapshot()
+        if snap["routable"] == 0:
+            return True
+        cfg = self.config
+        if cfg.shed_pending is not None \
+                and snap["pending"] >= cfg.shed_pending:
+            return True
+        if cfg.shed_drain_s is not None \
+                and snap["drain_s"] > cfg.shed_drain_s:
+            return True
+        return False
+
+    def _pod_order(self, prompt, tenant: str,
+                   adapter: Optional[str]) -> List[str]:
+        """Candidate pods in preference order: adapter pin, tenant pin,
+        then ring order from the prompt's prefix key (primary + spill
+        successors)."""
+        order: List[str] = []
+        pin = self._adapter_pins.get(adapter) if adapter else None
+        if pin is None:
+            pin = self._tenant_pins.get(tenant)
+        if pin is not None:
+            order.append(pin)
+        key = PrefixCache.key_for(prompt)
+        for pod_id in self._ring.pods_for(key, 1 + self.config.spill):
+            if pod_id not in order:
+                order.append(pod_id)
+        return order
+
+    def submit(self, prompt: Union[Sequence[int], np.ndarray], *,
+               priority: int = PRIORITY_NORMAL,
+               tenant: str = "default",
+               adapter: Optional[str] = None,
+               slo_ttft_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> StreamHandle:
+        """Place one request through the hierarchy. Never raises: with
+        every candidate pod overloaded (or no pod at all) the handle
+        resolves ``rejected`` (``pod_overloaded``) at the edge."""
+        t0 = self._clock()
+        order = self._pod_order(prompt, tenant, adapter)
+        chosen: Optional[LeafRouter] = None
+        spilled = False
+        for i, pod_id in enumerate(order):
+            leaf = self._placeable(pod_id)
+            if leaf is None:
+                continue
+            if self._overloaded(leaf):
+                continue
+            chosen, spilled = leaf, i > 0
+            break
+        if chosen is None:
+            return self._shed(prompt, tenant=tenant, priority=priority,
+                              slo_ttft_s=slo_ttft_s,
+                              max_new_tokens=max_new_tokens, t0=t0,
+                              tried=order)
+        handle = chosen.submit(
+            prompt, priority=priority, tenant=tenant,
+            slo_ttft_s=slo_ttft_s, deadline_s=deadline_s,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id)
+        t1 = self._clock()
+        telemetry.count(f"fleet/pod_routed|pod={chosen.pod_id}")
+        if spilled:
+            telemetry.count("fleet/pod_spill")
+        with self._lock:
+            self.n_routed += 1
+            if spilled:
+                self.n_spilled += 1
+            self._placements.append({
+                "trace_id": handle.trace_id, "uid": handle.uid,
+                "t": t0, "dur_s": t1 - t0, "pod": chosen.pod_id,
+                "spilled": spilled})
+        return handle
+
+    def _shed(self, prompt, *, tenant: str, priority: int,
+              slo_ttft_s: Optional[float], max_new_tokens: int,
+              t0: float, tried: List[str]) -> StreamHandle:
+        req = Request(prompt=np.asarray(prompt, np.int32),
+                      max_new_tokens=int(max_new_tokens),
+                      eos_token_id=None, deadline_s=None,
+                      trace_id=None, tenant=tenant)
+        handle = StreamHandle(req, self, tenant=tenant,
+                              priority=priority, slo_ttft_s=slo_ttft_s,
+                              submit_t=t0, trace_id=None)
+        handle._resolve("rejected",
+                        reject_reason=REJECT_POD_OVERLOADED)
+        telemetry.count("fleet/pod_shed")
+        with self._lock:
+            self.n_shed += 1
+            self._placements.append({
+                "trace_id": None, "uid": handle.uid, "t": t0,
+                "dur_s": self._clock() - t0, "pod": None,
+                "shed": True, "tried": list(tried)})
+        return handle
+
+    def cancel(self, handle: StreamHandle) -> None:
+        """Edge-rejected handles name the root as their frontend; they
+        are already terminal, so cancel is a no-op."""
+
+    # ------------------------------------------------------ pod failover
+    def _adopt_foreign(self, handle: StreamHandle, *, src_pod: str,
+                       src_rid: Any = None,
+                       exc: Optional[BaseException] = None) -> bool:
+        """Cross-pod crash/drain failover: a pod with no routable
+        survivor hands its salvaged handles here; the root re-homes
+        each on the ring's next live pod (prefix-ordered, so replays
+        land where the prompt's twins live)."""
+        prompt = handle._request.prompt
+        key = PrefixCache.key_for(prompt)
+        n_emitted = len(handle.tokens)
+        for pod_id in self._ring.pods_for(key, max(1, len(self.pods))):
+            if pod_id == src_pod:
+                continue
+            leaf = self._placeable(pod_id)
+            if leaf is None:
+                continue
+            target = leaf._place(prompt)
+            src = f"{src_pod}/{src_rid}" if src_rid is not None \
+                else src_pod
+            if target.routable and target.frontend.adopt(
+                    handle, rerouted_from=src):
+                telemetry.count("fleet/pod_failover")
+                telemetry.count("fleet/rerouted")
+                if n_emitted:
+                    telemetry.count("fleet/replayed")
+                telemetry.instant(
+                    "fleet/reroute", trace_id=handle.trace_id,
+                    rerouted_from=src,
+                    rerouted_to=f"{pod_id}/{target.rid}",
+                    replayed_tokens=n_emitted)
+                with self._lock:
+                    self.n_pod_failover += 1
+                    self._reroutes.append({
+                        "trace_id": handle.trace_id,
+                        "uid": handle.uid, "t": self._clock(),
+                        "from_pod": src_pod, "from_replica": src,
+                        "to_pod": pod_id,
+                        "to_replica": f"{pod_id}/{target.rid}",
+                        "replayed_tokens": n_emitted})
+                logger.info(f"fleet pod failover: uid={handle.uid} "
+                            f"{src} -> {pod_id}/{target.rid}")
+                return True
+        return False
+
+    # --------------------------------------------------------- migration
+    def _find_source(self, leaf: LeafRouter, uid: int,
+                     src_rid: Optional[int]) -> FleetReplica:
+        if src_rid is not None:
+            return leaf._resolve_replica(src_rid)
+        for rep in leaf.replicas:
+            if not rep.alive:
+                continue
+            try:
+                if int(uid) in rep.frontend.migration_candidates():
+                    return rep
+            except Exception:  # noqa: BLE001 — probe is best-effort
+                continue
+        raise MigrationError(
+            f"uid {uid} not migratable from pod {leaf.pod_id}")
+
+    def migrate(self, uid: int, src_pod: str, dst_pod: str, *,
+                src_rid: Optional[int] = None,
+                dst_rid: Optional[int] = None) -> bool:
+        """Cross-pod live migration: export the running request from
+        the source pod's replica and import it into the destination
+        pod's, same non-lossy semantics as ``FleetRouter.migrate`` —
+        a destination failure restores the request at the source."""
+        sleaf = self.pods.get(str(src_pod))
+        dleaf = self.pods.get(str(dst_pod))
+        if sleaf is None or dleaf is None:
+            raise MigrationError(
+                f"unknown pod in {src_pod!r} -> {dst_pod!r}")
+        t0 = self._clock()
+        try:
+            src = self._find_source(sleaf, uid, src_rid)
+        except MigrationError as e:
+            self._record_cross_failure(uid, src_pod, dst_pod, str(e))
+            return False
+        if dst_rid is not None:
+            dst = dleaf._resolve_replica(dst_rid)
+        else:
+            routable = [r for r in dleaf.replicas if r.routable]
+            if not routable:
+                self._record_cross_failure(uid, src_pod, dst_pod,
+                                           "no routable destination")
+                return False
+            dst = min(routable, key=dleaf._load_score)
+        try:
+            bundle, handle = src.frontend.migrate_out(uid)
+        except MigrationError as e:
+            self._record_cross_failure(uid, src_pod, dst_pod,
+                                       f"export: {e}")
+            return False
+        resumed = len(bundle["tokens"])
+        try:
+            dst.frontend.migrate_in(
+                bundle, handle, migrated_from=f"{src_pod}/{src.rid}")
+        except MigrationError as e:
+            try:
+                src.frontend.migrate_in(bundle, handle,
+                                        migrated_from=None)
+            except MigrationError as e2:
+                handle._resolve(
+                    "error",
+                    error=f"cross-pod migration failed both ways "
+                          f"(dst: {e}; src restore: {e2})")
+            self._record_cross_failure(uid, src_pod, dst_pod,
+                                       f"import: {e}")
+            return False
+        kv_bytes = int(bundle.get("kv_bytes", 0))
+        telemetry.count("fleet/cross_pod_migrated")
+        telemetry.count("fleet/cross_pod_migrate_bytes",
+                        float(kv_bytes))
+        telemetry.instant("fleet/migration", trace_id=handle.trace_id,
+                          from_replica=f"{src_pod}/{src.rid}",
+                          to_replica=f"{dst_pod}/{dst.rid}",
+                          resumed_tokens=resumed, kv_bytes=kv_bytes)
+        with self._lock:
+            self.n_cross_migrated += 1
+            self.cross_migrate_bytes += kv_bytes
+            self._migrations.append({
+                "trace_id": handle.trace_id, "uid": int(uid), "t": t0,
+                "dur_s": self._clock() - t0,
+                "from_pod": src_pod,
+                "from_replica": f"{src_pod}/{src.rid}",
+                "to_pod": dst_pod,
+                "to_replica": f"{dst_pod}/{dst.rid}",
+                "resumed_tokens": resumed, "kv_bytes": kv_bytes})
+        logger.info(f"fleet cross-pod migration: uid={uid} "
+                    f"{src_pod}/{src.rid} -> {dst_pod}/{dst.rid} "
+                    f"({resumed} tokens resumed)")
+        return True
+
+    def _record_cross_failure(self, uid: int, src_pod: str,
+                              dst_pod: str, why: str) -> None:
+        telemetry.count("fleet/cross_pod_migrate_failed")
+        with self._lock:
+            self.n_cross_migrate_failed += 1
+            self._migrations.append({
+                "trace_id": None, "uid": int(uid), "t": self._clock(),
+                "from_pod": src_pod, "to_pod": dst_pod, "failed": why})
+        logger.warning(f"fleet cross-pod migration uid={uid} "
+                       f"{src_pod}->{dst_pod} failed: {why}")
+
+    def rebalance(self, *, spread_ratio: float = 2.0,
+                  max_moves: int = 1) -> List[Dict[str, Any]]:
+        """One cross-pod balancing pass: while the hottest placeable
+        pod's drain estimate is at least ``spread_ratio`` times the
+        coldest's, move one movable request hot -> cold (up to
+        ``max_moves``). Per-pod spread stays the leaf's own
+        ``rebalance``; this pass only levels across pods."""
+        moves: List[Dict[str, Any]] = []
+        for _ in range(max(0, int(max_moves))):
+            cands: List[Tuple[str, LeafRouter, Dict[str, Any]]] = []
+            for pod_id in sorted(self.pods):
+                leaf = self._placeable(pod_id)
+                if leaf is None:
+                    continue
+                snap = leaf.pod_snapshot(max_age_s=0.0)
+                if snap["routable"]:
+                    cands.append((pod_id, leaf, snap))
+            if len(cands) < 2:
+                break
+            hot = max(cands, key=lambda c: c[2]["drain_s"])
+            cold = min(cands, key=lambda c: c[2]["drain_s"])
+            hot_drain = float(hot[2]["drain_s"])
+            cold_drain = float(cold[2]["drain_s"])
+            if hot_drain <= 0 \
+                    or hot_drain < spread_ratio * max(cold_drain, 1e-9):
+                break
+            uid = None
+            for rep in sorted(
+                    (r for r in hot[1].replicas if r.alive),
+                    key=hot[1]._load_score, reverse=True):
+                try:
+                    movable = rep.frontend.migration_candidates()
+                except Exception:  # noqa: BLE001 — probe is best-effort
+                    continue
+                if movable:
+                    uid = movable[0]
+                    break
+            if uid is None:
+                break
+            ok = self.migrate(uid, hot[0], cold[0])
+            moves.append({"uid": int(uid), "from_pod": hot[0],
+                          "to_pod": cold[0], "ok": ok,
+                          "hot_drain_s": hot_drain,
+                          "cold_drain_s": cold_drain})
+            if not ok:
+                break
+        return moves
+
+    # ----------------------------------------------------------- queries
+    @property
+    def n_pods(self) -> int:
+        return len([p for p in self.pods
+                    if p not in self._lost and p not in self._retiring])
+
+    @property
+    def n_replicas(self) -> int:
+        return sum(len(leaf.replicas) for leaf in self.pods.values())
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "pods": len(self.pods),
+                "pods_placeable": self.n_pods,
+                "pods_lost": sorted(self._lost),
+                "pods_retiring": sorted(self._retiring),
+                "routed": self.n_routed,
+                "shed": self.n_shed,
+                "spilled": self.n_spilled,
+                "pod_failover": self.n_pod_failover,
+                "cross_migrated": self.n_cross_migrated,
+                "cross_migrate_failed": self.n_cross_migrate_failed,
+                "cross_migrate_bytes": self.cross_migrate_bytes,
+                "pods_lost_total": self.n_pods_lost,
+                "pods_retired_total": self.n_pods_retired,
+            }
+        out["per_pod"] = {pod_id: leaf.stats()
+                          for pod_id, leaf in self.pods.items()}
+        return out
+
+    def journey_journal(self) -> Dict[str, Any]:
+        """Flat-router-shaped journal with pod-qualified replica ids
+        (``<pod>/<rid>``): root placements/failovers/migrations merge
+        with every leaf's own records, so the existing journey renderer
+        draws pod hops without a schema change."""
+        with self._lock:
+            journal: Dict[str, Any] = {
+                "placements": [dict(p) for p in self._placements],
+                "reroutes": [dict(r) for r in self._reroutes],
+                "migrations": [dict(m) for m in self._migrations],
+                "crashes": [],
+            }
+        journal["replicas"] = {}
+        for pod_id, leaf in self.pods.items():
+            sub = leaf.journey_journal()
+            for rec in sub["placements"]:
+                rec = dict(rec)
+                rec["pod"] = pod_id
+                rec["replica"] = f"{pod_id}/{rec['replica']}"
+                journal["placements"].append(rec)
+            for name in ("reroutes", "crashes", "migrations"):
+                for rec in sub[name]:
+                    rec = dict(rec)
+                    rec["pod"] = pod_id
+                    for k in ("replica", "from_replica", "to_replica"):
+                        if rec.get(k) is not None \
+                                and "/" not in str(rec[k]):
+                            rec[k] = f"{pod_id}/{rec[k]}"
+                    journal[name].append(rec)
+            for rid, trace in sub["replicas"].items():
+                journal["replicas"][f"{pod_id}/{rid}"] = trace
+        return journal
+
+    def tenants_report(self) -> Dict[str, Any]:
+        """Fleet-wide per-tenant goodput merged across every pod."""
+        merged: Dict[str, Dict[str, Any]] = {}
+        per_pod: Dict[str, Any] = {}
+        for pod_id, leaf in self.pods.items():
+            rep = leaf.tenants_report()
+            per_pod[pod_id] = rep
+            for tenant, t in rep.get("tenants", {}).items():
+                m = merged.setdefault(tenant, {
+                    "n_requests": 0, "total_tokens": 0,
+                    "goodput_tokens": 0})
+                m["n_requests"] += t.get("n_requests", 0)
+                m["total_tokens"] += t.get("total_tokens", 0)
+                m["goodput_tokens"] += t.get("goodput_tokens", 0)
+        for m in merged.values():
+            m["goodput_fraction"] = (
+                m["goodput_tokens"] / m["total_tokens"]
+                if m["total_tokens"] else 1.0)
+        return {"schema": "dstpu-hierarchy-tenants-v1",
+                "n_tenants": len(merged), "tenants": merged,
+                "per_pod": per_pod}
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        for ctrl in self.controllers.values():
+            ctrl.stop()
+        for leaf in self.pods.values():
+            leaf.close(timeout)
+
+    def __enter__(self) -> "RootRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -79,16 +79,28 @@ class RemoteReplica:
     ``engine=None`` (every engine-shaped probe goes over the wire
     instead)."""
 
+    #: bound on cached ``holds_prefix`` answers (hot prompts are few;
+    #: this only exists so a key-diverse workload can't grow the map)
+    PREFIX_CACHE_CAP = 1024
+
     def __init__(self, host: str, port: int, *,
                  label: Optional[str] = None,
                  timeout_s: float = 30.0,
                  health_ttl_s: float = 0.5,
+                 snapshot_ttl_s: float = 0.25,
                  clock=time.monotonic):
         self.host = host
         self.port = int(port)
         self.label = label or f"{host}:{port}"
         self.timeout_s = float(timeout_s)
         self.health_ttl_s = float(health_ttl_s)
+        # placement-probe cache TTL: load_snapshot()/holds_prefix() are
+        # synchronous HTTP GETs, and the router calls BOTH per replica
+        # per submit — uncached, placement latency scales with remote
+        # count. Staleness is bounded by the TTL AND by invalidation on
+        # every local state-changing event (submit, accepted/end
+        # frames, adopt, migrate, install_prefix). 0 disables caching.
+        self.snapshot_ttl_s = float(snapshot_ttl_s)
         self._clock = clock
         # router-facing lifecycle attrs (FleetReplica/retire contract)
         self.draining = False
@@ -107,6 +119,21 @@ class RemoteReplica:
         self._salvaged: set = set()
         self._health_ok: Optional[bool] = None
         self._health_t = 0.0
+        self._load_cache: Optional[Dict[str, Any]] = None
+        self._load_t = float("-inf")
+        # key -> (holds, probe time)
+        self._prefix_cache: Dict[bytes, tuple] = {}
+
+    def _snapshots_invalidate(self) -> None:
+        """Drop the cached placement probes — called on every event
+        that changes what they would report (a submit landed, a stream
+        ended, an adoption/migration moved work, a prefix installed),
+        so the cache can only be stale about REMOTE-initiated changes,
+        and those only within ``snapshot_ttl_s``."""
+        with self._lock:
+            self._load_cache = None
+            self._load_t = float("-inf")
+            self._prefix_cache.clear()
 
     @property
     def url(self) -> str:
@@ -189,6 +216,7 @@ class RemoteReplica:
         with self._lock:
             handle._remote_uid = remote_uid
             self._handles[remote_uid] = handle
+        self._snapshots_invalidate()
 
     def _spawn_reader(self, conn, resp, handle: StreamHandle) -> None:
         t = threading.Thread(
@@ -217,6 +245,8 @@ class RemoteReplica:
                 uid = getattr(handle, "_remote_uid", None)
                 if uid is not None and handle.done:
                     self._handles.pop(uid, None)
+            # a stream ended (or broke): the remote's load changed
+            self._snapshots_invalidate()
 
     def _pump_frames(self, resp, handle: StreamHandle) -> bool:
         """Apply frames to the handle; True once an ``end`` frame
@@ -383,6 +413,7 @@ class RemoteReplica:
                 "slo_ttft_s": slo_ttft_s, "deadline_s": deadline_s,
                 "max_new_tokens": int(max_new_tokens),
                 "eos_token_id": eos_token_id, "trace_id": trace_id}
+        self._snapshots_invalidate()
         t = threading.Thread(
             target=self._submit_stream, args=(body, handle),
             name=f"dstpu-remote-{self.label}", daemon=True)
@@ -404,6 +435,7 @@ class RemoteReplica:
                 self._read_stream(conn, resp, handle)
             else:
                 conn.close()
+                self._snapshots_invalidate()
         except Exception as e:  # noqa: BLE001
             self._stream_failed(handle, e)
 
@@ -442,6 +474,7 @@ class RemoteReplica:
             self._post_cancel(uid)
 
     def _post_cancel(self, uid: int) -> None:
+        self._snapshots_invalidate()
         try:
             self._post_json("/v1/cancel", {"uid": int(uid)})
         except Exception as e:  # noqa: BLE001 — stream/health paths win
@@ -499,6 +532,7 @@ class RemoteReplica:
         payload = self._post_json("/v1/migrate_out", {"uid": int(uid)})
         with self._lock:
             self._handles.pop(int(uid), None)
+        self._snapshots_invalidate()
         return decode_bundle(payload), handle
 
     def migrate_in(self, bundle: Dict[str, Any],
@@ -545,9 +579,19 @@ class RemoteReplica:
 
     # --------------------------------------------------------- queries
     def holds_prefix(self, key: bytes) -> bool:
-        return bool(self._get_json(
+        now = self._clock()
+        with self._lock:
+            hit = self._prefix_cache.get(key)
+            if hit is not None and now - hit[1] < self.snapshot_ttl_s:
+                return hit[0]
+        holds = bool(self._get_json(
             f"/v1/prefix?key={key.hex()}",
             default={"holds": False}).get("holds", False))
+        with self._lock:
+            while len(self._prefix_cache) >= self.PREFIX_CACHE_CAP:
+                self._prefix_cache.pop(next(iter(self._prefix_cache)))
+            self._prefix_cache[key] = (holds, now)
+        return holds
 
     def fetch_prefix(self, key: bytes) -> Optional[Dict[str, Any]]:
         """``GET /v1/prefix?fetch=1`` — pull the remote's demoted prefix
@@ -561,16 +605,26 @@ class RemoteReplica:
     def install_prefix(self, bundle: Dict[str, Any]) -> bool:
         """``POST /v1/prefix`` — install a fetched prefix bundle into
         the remote's DRAM tier."""
-        return bool(self._post_json(
+        ok = bool(self._post_json(
             "/v1/prefix",
             {"bundle": encode_bundle(bundle)}).get("ok", False))
+        if ok:
+            self._snapshots_invalidate()
+        return ok
 
     def load_snapshot(self) -> Dict[str, Any]:
         """``GET /v1/load`` — the same ``dstpu-load-v1`` dict the
-        in-process frontend returns. Unreachable remotes degrade to an
-        idle-shaped stub (placement already excludes them via
-        ``driver_alive``; the stub only keeps racing readers safe)."""
-        return self._get_json("/v1/load", default={
+        in-process frontend returns, cached for ``snapshot_ttl_s``
+        (invalidated by every local submit/stream/migration event).
+        Unreachable remotes degrade to an idle-shaped stub (placement
+        already excludes them via ``driver_alive``; the stub only keeps
+        racing readers safe)."""
+        now = self._clock()
+        with self._lock:
+            if self._load_cache is not None \
+                    and now - self._load_t < self.snapshot_ttl_s:
+                return self._load_cache
+        snap = self._get_json("/v1/load", default={
             "schema": LOAD_SCHEMA,
             "admission": {"pending": 0},
             "throughput": {"tokens_per_s": None},
@@ -578,6 +632,10 @@ class RemoteReplica:
             "engine_queue_depth": 0,
             "engine_running": 0,
         })
+        with self._lock:
+            self._load_cache = snap
+            self._load_t = now
+        return snap
 
     def stats(self) -> Dict[str, Any]:
         return self._get_json("/v1/stats", default={

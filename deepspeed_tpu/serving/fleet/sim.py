@@ -1,0 +1,982 @@
+"""Deterministic discrete-event fleet simulator.
+
+Validating 1000 replicas is impossible on real engines — and
+``fleet_bench``'s simulated-engine pattern still burns real driver
+threads and wall-clock sleeps, so it tops out around tens of replicas.
+This module graduates that pattern into a first-class simulator that
+drives the *real* control plane:
+
+* :class:`SimClock` — virtual time. A heap of ``(t, seq, fn)`` events,
+  no wall sleeps, no threads; ``run_until`` executes everything due and
+  then pins the clock to the horizon (so self-rescheduling heartbeat /
+  watchdog loops never prevent termination). The clock object is
+  callable, so it drops straight into every ``clock=`` seam the serving
+  stack already has (routers, TraceLog, admission, elastic
+  controllers).
+* :class:`SimReplica` — a replica satisfying the same surface
+  ``FleetRouter`` drives (``submit`` / ``load_snapshot`` /
+  ``holds_prefix`` / ``adopt`` / ``migrate_out`` / ``migrate_in`` /
+  ``drain_pending`` / ``on_crash`` …) with configurable prefill/decode
+  token rates. The real root/leaf routers, admission, elastic
+  controllers, and migration paths run UNMODIFIED over it — the sim
+  fakes the engine, never the control plane.
+* Trace-driven workload generators (:func:`diurnal_trace`,
+  :func:`tenant_skew_trace`, :func:`hot_prefix_storm`,
+  :func:`multi_turn_trace`) and a :class:`ChaosInjector` (pod loss,
+  slow and partitioned networks, zombie replicas that accept but never
+  emit, clock-skewed heartbeats).
+
+Tokens are deterministic — token ``k`` of a stream is
+``prompt[-1]`` if ``k == 0`` else ``prompt[k % len(prompt)]``
+(:func:`sim_expected`) — so a run can assert ZERO lost and ZERO
+duplicated tokens through any chaos schedule by exact comparison, and
+the same seed reproduces the same :class:`SimWorld` event log
+byte-for-byte (the log never contains process-global ids or random
+trace ids; handles get dense per-world ids).
+
+Failure detection is the part chaos exists to exercise:
+:class:`FleetWatchdog` judges liveness by heartbeat ARRIVAL time on
+its own clock — never the replica's self-reported timestamp — so
+clock-skewed replicas don't get false-killed, while partitioned
+replicas (heartbeats dropped) and zombies (heartbeats fine, zero
+token progress) both do get killed, which routes their streams through
+the router's ordinary crash-salvage/replay path.
+
+Host-side only — this module never imports JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import heapq
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...analysis import locks
+from ...telemetry import core as telemetry
+from ..engine import MIGRATE_SCHEMA, MigrationError
+from ..frontend.admission import (PRIORITY_NORMAL, REJECT_FRONTEND_CLOSED,
+                                  REJECT_FRONTEND_QUEUE_FULL)
+from ..frontend.frontend import LOAD_SCHEMA, StreamHandle
+from ..frontend.tracing import TraceLog
+from ..paged_kv import PrefixCache
+from ..scheduler import Request
+
+
+def sim_expected(prompt: Sequence[int], n: int) -> List[int]:
+    """The deterministic token oracle: what a correct end-to-end run
+    delivers for ``prompt``'s first ``n`` tokens. Depends only on the
+    ORIGINAL prompt and the emission position, so replay-after-crash
+    and migration resume produce the identical continuation."""
+    prompt = [int(t) for t in prompt]
+    return [prompt[-1] if k == 0 else prompt[k % len(prompt)]
+            for k in range(n)]
+
+
+class SimClock:
+    """Virtual time: an event heap and nothing else.
+
+    Callable (returns ``now``) so it plugs into every ``clock=`` seam.
+    ``run_until`` pops events in ``(t, seq)`` order — seq breaks ties
+    by scheduling order, so a run is deterministic — and finally sets
+    ``now`` to the horizon even when self-rescheduling loops (heart-
+    beats, watchdog polls) still have future events queued."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._heap: List[tuple] = []
+        self._seq = itertools.count()
+
+    def __call__(self) -> float:
+        return self._now
+
+    def now(self) -> float:
+        return self._now
+
+    def call_at(self, t: float, fn: Callable, *args) -> None:
+        heapq.heappush(self._heap,
+                       (max(float(t), self._now), next(self._seq),
+                        fn, args))
+
+    def call_later(self, dt: float, fn: Callable, *args) -> None:
+        self.call_at(self._now + float(dt), fn, *args)
+
+    def run_until(self, t_end: float) -> int:
+        """Execute every event due at or before ``t_end``; returns the
+        number executed. The clock ends AT ``t_end``."""
+        n = 0
+        while self._heap and self._heap[0][0] <= t_end:
+            t, _, fn, args = heapq.heappop(self._heap)
+            self._now = t
+            fn(*args)
+            n += 1
+        self._now = float(t_end)
+        return n
+
+    def run_for(self, dt: float) -> int:
+        return self.run_until(self._now + float(dt))
+
+    @property
+    def pending_events(self) -> int:
+        return len(self._heap)
+
+
+class SimWorld:
+    """One simulation run: the clock, the seeded RNG every random
+    choice must come from, and the deterministic event log.
+
+    The log is the byte-for-byte reproducibility artifact: entries are
+    ``t=<6dp> <kind> k=v ...`` with sorted keys, and handles are named
+    by DENSE per-world ids (assigned in first-sight order) — never by
+    ``Request.uid`` (a process-global counter) or ``trace_id``
+    (random), which would differ between two runs in one process."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.clock = SimClock()
+        self.rng = random.Random(self.seed)
+        self._events: List[str] = []
+        self._sids: Dict[int, int] = {}
+
+    def sid(self, handle: StreamHandle) -> int:
+        """Dense, run-stable id for one stream handle."""
+        uid = handle.uid
+        if uid not in self._sids:
+            self._sids[uid] = len(self._sids)
+        return self._sids[uid]
+
+    def log(self, kind: str, **kv) -> None:
+        parts = [f"t={self.clock.now():.6f}", kind]
+        parts += [f"{k}={kv[k]}" for k in sorted(kv)]
+        self._events.append(" ".join(parts))
+
+    def event_log(self) -> str:
+        return "\n".join(self._events) + ("\n" if self._events else "")
+
+    def digest(self) -> str:
+        return hashlib.sha256(
+            self.event_log().encode("utf-8")).hexdigest()
+
+
+@dataclasses.dataclass
+class SimReplicaConfig:
+    """One sim replica's performance envelope (token rates are the
+    knobs the chaos legs scale with ``slow_factor``)."""
+    prefill_tokens_per_s: float = 8192.0
+    decode_tokens_per_s: float = 512.0
+    chunk_s: float = 0.05            # decode chunk cadence
+    max_running: int = 8             # concurrent decode lanes
+    max_queue: int = 64              # waiting beyond the running set
+    prefix_capacity: int = 256       # LRU prefix-cache entries
+    heartbeat_every_s: float = 0.5
+
+
+@dataclasses.dataclass
+class _Lane:
+    """One running request inside a sim replica."""
+    handle: StreamHandle
+    remaining: int
+    ready_t: float                   # prefill completes at this time
+    buffered: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+
+
+class SimReplica:
+    """A fleet replica with a synthetic engine behind the REAL frontend
+    surface. Joins a router via ``add_remote`` (it walks and quacks
+    like a :class:`~.remote.RemoteReplica`), so placement, crash
+    salvage, draining, adoption/replay, and live migration all exercise
+    the production code paths.
+
+    Modes: ``ok`` (normal), ``zombie`` (accepts everything, emits
+    nothing — heartbeats keep arriving), ``partitioned`` (keeps
+    computing but its emissions and heartbeats never reach anyone;
+    ``heal()`` flushes the buffered tokens IF it still owns the stream,
+    a kill drops them — either way zero duplicates), ``dead``
+    (crashed: in-flight work was salvaged through ``on_crash``),
+    ``closed`` (gracefully retired). ``skew_s`` offsets the timestamps
+    the replica self-reports in heartbeats — arrival-time watchdogs
+    must not care."""
+
+    def __init__(self, label: str, world: SimWorld,
+                 config: Optional[SimReplicaConfig] = None):
+        self.label = str(label)
+        self.world = world
+        self.clock = world.clock
+        self.cfg = config or SimReplicaConfig()
+        self.mode = "ok"
+        self.skew_s = 0.0
+        self.slow_factor = 1.0
+        self.draining = False
+        self.on_crash = None
+        self.postmortem_path: Optional[str] = None
+        self.tracing = TraceLog(clock=self.clock)
+        self.n_submitted = 0
+        self.n_emitted = 0
+        self.last_progress_t = self.clock.now()
+        self._lock = locks.make_lock("fleet.sim_replica")
+        self._lanes: Dict[int, _Lane] = {}     # uid -> lane, FIFO order
+        self._waiting: List[StreamHandle] = []
+        self._prefixes: Dict[bytes, None] = {}  # insertion-ordered LRU
+        self._chunk_pending = False
+        self._watchdog: Optional["FleetWatchdog"] = None
+        self._hb_started = False
+
+    # ------------------------------------------------------ sim plumbing
+    def _rate(self, tokens_per_s: float) -> float:
+        return tokens_per_s / max(self.slow_factor, 1e-9)
+
+    def _owns(self, handle: StreamHandle) -> bool:
+        return handle._frontend is self and not handle.done
+
+    def _remember_prefix(self, prompt) -> None:
+        key = PrefixCache.key_for(prompt)
+        self._prefixes.pop(key, None)
+        self._prefixes[key] = None
+        while len(self._prefixes) > self.cfg.prefix_capacity:
+            self._prefixes.pop(next(iter(self._prefixes)))
+
+    def _start_lane(self, handle: StreamHandle) -> None:
+        n_emitted = len(handle.tokens)
+        prefill_tokens = int(handle._prompt.shape[0]) + n_emitted
+        ready_t = self.clock.now() + prefill_tokens / self._rate(
+            self.cfg.prefill_tokens_per_s)
+        self._lanes[handle.uid] = _Lane(
+            handle=handle,
+            remaining=handle._max_new_tokens - n_emitted,
+            ready_t=ready_t)
+        self._remember_prefix(handle._prompt)
+        self._kick()
+
+    def _kick(self) -> None:
+        if self._chunk_pending or self.mode in ("dead", "closed"):
+            return
+        if not self._lanes and not self._waiting:
+            return
+        self._chunk_pending = True
+        self.clock.call_later(self.cfg.chunk_s, self._chunk)
+
+    def _chunk(self) -> None:
+        self._chunk_pending = False
+        if self.mode in ("dead", "closed"):
+            return
+        while self._waiting and len(self._lanes) < self.cfg.max_running:
+            handle = self._waiting.pop(0)
+            if handle.done or not self._owns(handle):
+                continue
+            self._start_lane(handle)
+        if self.mode != "zombie":
+            budget = max(1, int(round(
+                self._rate(self.cfg.decode_tokens_per_s)
+                * self.cfg.chunk_s)))
+            now = self.clock.now()
+            progressed = False
+            while budget > 0:
+                ready = [ln for ln in self._lanes.values()
+                         if ln.ready_t <= now and ln.remaining > 0
+                         and not ln.finished]
+                if not ready:
+                    break
+                for lane in ready:          # round-robin, FIFO order
+                    if budget <= 0:
+                        break
+                    self._emit_one(lane)
+                    budget -= 1
+                    progressed = True
+            if progressed:
+                self.last_progress_t = now
+            for uid in [u for u, ln in self._lanes.items()
+                        if ln.finished and ln.handle.done]:
+                del self._lanes[uid]
+        self._kick()
+
+    def _emit_one(self, lane: _Lane) -> None:
+        handle = lane.handle
+        if not self._owns(handle):
+            # the router re-homed this stream (watchdog kill raced a
+            # heal): stop computing for it, and above all never push
+            self._lanes.pop(handle.uid, None)
+            return
+        pos = len(handle.tokens) + len(lane.buffered)
+        tok = sim_expected(handle._prompt, pos + 1)[pos]
+        lane.remaining -= 1
+        eos = handle._request.eos_token_id
+        if eos is not None and tok == eos:
+            lane.remaining = 0
+        if self.mode == "partitioned":
+            lane.buffered.append(tok)
+            if lane.remaining <= 0:
+                lane.finished = True
+            return
+        handle._push([tok])
+        self.tracing.chunk(handle.uid, 1)
+        self.n_emitted += 1
+        if lane.remaining <= 0:
+            lane.finished = True
+            self._finish_lane(lane)
+
+    def _finish_lane(self, lane: _Lane) -> None:
+        handle = lane.handle
+        self.tracing.finish(handle.uid, "done")
+        handle._resolve("done")
+        self._lanes.pop(handle.uid, None)
+        self.world.log("finish", replica=self.label,
+                       sid=self.world.sid(handle),
+                       n_tokens=len(handle.tokens))
+
+    # --------------------------------------------------- chaos controls
+    def fail(self, exc: Optional[BaseException] = None) -> None:
+        """Abrupt crash: every in-flight stream is salvaged through
+        ``on_crash`` (the router's reroute/replay path) exactly like a
+        dead driver thread; partition-era buffered tokens are dropped
+        un-pushed, so the survivor's replay cannot duplicate."""
+        if self.mode in ("dead", "closed"):
+            return
+        exc = exc or RuntimeError("sim replica failed")
+        salvaged = []
+        for lane in self._lanes.values():
+            if not lane.handle.done:
+                salvaged.append(lane.handle)
+        for handle in self._waiting:
+            if not handle.done:
+                salvaged.append(handle)
+        self._lanes.clear()
+        self._waiting.clear()
+        self.mode = "dead"
+        self.world.log("crash", replica=self.label,
+                       n_salvaged=len(salvaged))
+        if self.on_crash is not None:
+            self.on_crash(self, salvaged, exc)
+        else:
+            for handle in salvaged:
+                handle._resolve("error", error=str(exc))
+
+    def set_zombie(self) -> None:
+        if self.mode == "ok":
+            self.mode = "zombie"
+            self.world.log("zombie", replica=self.label)
+
+    def set_partitioned(self) -> None:
+        if self.mode == "ok":
+            self.mode = "partitioned"
+            self.world.log("partition", replica=self.label)
+
+    def heal(self) -> None:
+        """End a partition. Buffered emissions flush to their handles
+        IF this replica still owns them — a stream the watchdog
+        already re-homed keeps its new home and the stale buffer drops
+        on the floor (zero duplicates either way)."""
+        if self.mode != "partitioned":
+            return
+        self.mode = "ok"
+        self.world.log("heal", replica=self.label)
+        for uid, lane in list(self._lanes.items()):
+            handle = lane.handle
+            if not self._owns(handle):
+                self._lanes.pop(uid, None)
+                continue
+            if lane.buffered:
+                handle._push(lane.buffered)
+                self.tracing.chunk(handle.uid, len(lane.buffered))
+                self.n_emitted += len(lane.buffered)
+                lane.buffered = []
+                self.last_progress_t = self.clock.now()
+            if lane.finished:
+                self._finish_lane(lane)
+        self._kick()
+
+    def set_slow(self, factor: float) -> None:
+        self.slow_factor = max(float(factor), 1e-9)
+        self.world.log("slow", replica=self.label,
+                       factor=f"{self.slow_factor:g}")
+
+    def set_skew(self, offset_s: float) -> None:
+        self.skew_s = float(offset_s)
+        self.world.log("skew", replica=self.label,
+                       offset=f"{self.skew_s:g}")
+
+    # ----------------------------------------------------- heartbeats
+    def attach_watchdog(self, watchdog: "FleetWatchdog") -> None:
+        self._watchdog = watchdog
+        watchdog.register(self)
+        if not self._hb_started:
+            self._hb_started = True
+            self.clock.call_later(self.cfg.heartbeat_every_s,
+                                  self._heartbeat)
+
+    def _heartbeat(self) -> None:
+        if self.mode in ("dead", "closed"):
+            return
+        if self.mode != "partitioned" and self._watchdog is not None:
+            # the SELF-REPORTED timestamp carries the skew; arrival
+            # time (the watchdog's own clock) does not
+            self._watchdog.beat(self,
+                                self_t=self.clock.now() + self.skew_s)
+        self.clock.call_later(self.cfg.heartbeat_every_s,
+                              self._heartbeat)
+
+    # ------------------------------------------------ frontend surface
+    @property
+    def driver_alive(self) -> bool:
+        return self.mode not in ("dead", "closed")
+
+    @property
+    def crashed(self) -> bool:
+        return self.mode == "dead"
+
+    def has_work(self) -> bool:
+        return bool(self._lanes or self._waiting)
+
+    def submit(self, prompt, *, priority: int = PRIORITY_NORMAL,
+               tenant: str = "default",
+               slo_ttft_s: Optional[float] = None,
+               deadline_s: Optional[float] = None,
+               max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None,
+               trace_id: Optional[str] = None) -> StreamHandle:
+        now = self.clock.now()
+        req = Request(prompt=np.asarray(prompt, np.int32),
+                      max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id,
+                      deadline_s=(now + deadline_s)
+                      if deadline_s is not None else None,
+                      trace_id=trace_id, tenant=tenant)
+        handle = StreamHandle(req, self, tenant=tenant,
+                              priority=priority, slo_ttft_s=slo_ttft_s,
+                              submit_t=now, trace_id=trace_id)
+        self.n_submitted += 1
+        if not self.driver_alive:
+            self.tracing.record_rejected(req.uid, REJECT_FRONTEND_CLOSED)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_CLOSED)
+            return handle
+        if len(self._waiting) >= self.cfg.max_queue:
+            self.tracing.record_rejected(req.uid,
+                                         REJECT_FRONTEND_QUEUE_FULL)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_QUEUE_FULL)
+            return handle
+        self.tracing.start(req.uid, tenant=tenant, priority=priority,
+                           prompt_len=req.prompt_len,
+                           max_new_tokens=max_new_tokens,
+                           slo_ttft_s=slo_ttft_s, trace_id=trace_id,
+                           replica=self.label)
+        self.tracing.mark(req.uid, "submitted", t=now)
+        self.world.log("accept", replica=self.label,
+                       sid=self.world.sid(handle), tenant=tenant)
+        if len(self._lanes) < self.cfg.max_running:
+            self._start_lane(handle)
+        else:
+            self._waiting.append(handle)
+            self._kick()
+        return handle
+
+    def cancel(self, handle: StreamHandle) -> None:
+        if handle.done or not self._owns(handle):
+            return
+        self._lanes.pop(handle.uid, None)
+        self._waiting = [h for h in self._waiting
+                         if h.uid != handle.uid]
+        self.tracing.finish(handle.uid, "cancelled")
+        handle._resolve("cancelled")
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        if self.mode in ("dead", "closed"):
+            return
+        leftovers = self._waiting + [ln.handle
+                                     for ln in self._lanes.values()]
+        self._waiting = []
+        self._lanes.clear()
+        self.mode = "closed"
+        for handle in leftovers:
+            if not handle.done:
+                self.tracing.record_rejected(handle.uid,
+                                             REJECT_FRONTEND_CLOSED)
+                handle._resolve("rejected",
+                                reject_reason=REJECT_FRONTEND_CLOSED)
+
+    def load_snapshot(self) -> Dict[str, Any]:
+        backlog = sum(ln.remaining for ln in self._lanes.values())
+        backlog += sum(h._max_new_tokens + int(h._prompt.shape[0])
+                       for h in self._waiting)
+        return {
+            "schema": LOAD_SCHEMA,
+            "admission": {"pending": len(self._waiting)},
+            "throughput": {"tokens_per_s": self._rate(
+                self.cfg.decode_tokens_per_s)},
+            "engine_backlog_tokens": int(backlog),
+            "engine_queue_depth": 0,
+            "engine_running": len(self._lanes),
+        }
+
+    def holds_prefix(self, key: bytes) -> bool:
+        return key in self._prefixes
+
+    def migration_candidates(self) -> List[int]:
+        now = self.clock.now()
+        if self.mode != "ok":
+            return []
+        return [uid for uid, ln in self._lanes.items()
+                if ln.ready_t <= now and ln.remaining > 0
+                and len(ln.handle.tokens) > 0]
+
+    def migrate_out(self, uid: int, timeout: Optional[float] = 30.0):
+        if not self.driver_alive:
+            raise MigrationError("sim replica is closed or dead")
+        lane = self._lanes.get(int(uid))
+        if lane is None or lane.handle.done or lane.buffered:
+            raise MigrationError(f"uid {uid} is not migratable here")
+        handle = lane.handle
+        del self._lanes[int(uid)]
+        self.tracing.finish(uid, "migrated")
+        emitted = handle.tokens
+        bundle = {
+            "schema": MIGRATE_SCHEMA,
+            "uid": int(uid),
+            "trace_id": handle.trace_id,
+            "prompt": [int(t) for t in handle._prompt],
+            "tokens": [int(t) for t in emitted],
+            "max_new_tokens": int(handle._max_new_tokens),
+            "kv": {},
+            "kv_bytes": 8 * (int(handle._prompt.shape[0])
+                             + len(emitted)),
+            "block_size": 1,
+            "sampling": {"eos_token_id": handle._request.eos_token_id,
+                         "tenant": handle.tenant,
+                         "priority": int(handle.priority)},
+        }
+        self.world.log("migrate_out", replica=self.label,
+                       sid=self.world.sid(handle))
+        return bundle, handle
+
+    def migrate_in(self, bundle: Dict[str, Any],
+                   handle: Optional[StreamHandle] = None, *,
+                   migrated_from: Optional[str] = None,
+                   timeout: Optional[float] = 30.0) -> StreamHandle:
+        if bundle.get("schema") != MIGRATE_SCHEMA:
+            raise MigrationError(
+                f"bad bundle schema {bundle.get('schema')!r}")
+        if not self.driver_alive or self.mode != "ok":
+            raise MigrationError("sim replica cannot host the request")
+        if handle is None:
+            raise MigrationError(
+                "sim migrate_in needs the in-process handle")
+        if len(self._lanes) >= self.cfg.max_running \
+                and self.cfg.max_queue == 0:
+            raise MigrationError("sim replica is full")
+        handle._frontend = self
+        uid = handle.uid
+        # KV moved with the bundle: the lane resumes at the migrated
+        # cursor with no replay prefill
+        self._lanes[uid] = _Lane(
+            handle=handle,
+            remaining=handle._max_new_tokens - len(bundle["tokens"]),
+            ready_t=self.clock.now())
+        self._remember_prefix(handle._prompt)
+        self.tracing.start(uid, tenant=handle.tenant,
+                           priority=handle.priority,
+                           trace_id=handle.trace_id,
+                           replica=self.label,
+                           migrated_from=migrated_from,
+                           resumed_tokens=len(bundle["tokens"]))
+        self.tracing.mark(uid, "submitted", t=handle.submit_t)
+        self.world.log("migrate_in", replica=self.label,
+                       sid=self.world.sid(handle))
+        self._kick()
+        return handle
+
+    def drain_pending(self) -> List[StreamHandle]:
+        out = []
+        for handle in self._waiting:
+            if handle.done:
+                continue
+            self.tracing.finish(handle.uid, "rerouted")
+            out.append(handle)
+        self._waiting = []
+        return out
+
+    def adopt(self, handle: StreamHandle,
+              rerouted_from: Optional[str] = None) -> bool:
+        if handle.done:
+            return False
+        emitted = handle.tokens
+        n_emitted = len(emitted)
+        eos = handle._request.eos_token_id
+        if n_emitted >= handle._max_new_tokens or (
+                eos is not None and n_emitted and emitted[-1] == eos):
+            # already fully delivered — the crash only stole the status
+            self.tracing.start(handle.uid, trace_id=handle.trace_id,
+                               replica=self.label,
+                               rerouted_from=rerouted_from)
+            self.tracing.finish(handle.uid, "done")
+            handle._resolve("done")
+            return True
+        if not self.driver_alive or self.draining:
+            self.tracing.record_rejected(handle.uid,
+                                         REJECT_FRONTEND_CLOSED)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_CLOSED)
+            return False
+        if len(self._waiting) >= self.cfg.max_queue:
+            self.tracing.record_rejected(handle.uid,
+                                         REJECT_FRONTEND_QUEUE_FULL)
+            handle._resolve("rejected",
+                            reject_reason=REJECT_FRONTEND_QUEUE_FULL)
+            return False
+        handle._frontend = self
+        self.n_submitted += 1
+        self.tracing.start(handle.uid, tenant=handle.tenant,
+                           priority=handle.priority,
+                           prompt_len=int(handle._prompt.shape[0]),
+                           max_new_tokens=handle._max_new_tokens,
+                           trace_id=handle.trace_id,
+                           replica=self.label,
+                           rerouted_from=rerouted_from,
+                           replayed_tokens=n_emitted)
+        self.tracing.mark(handle.uid, "submitted", t=handle.submit_t)
+        self.world.log("adopt", replica=self.label,
+                       sid=self.world.sid(handle),
+                       replayed=n_emitted)
+        if len(self._lanes) < self.cfg.max_running:
+            self._start_lane(handle)   # replay re-prefills prompt+emitted
+        else:
+            self._waiting.append(handle)
+            self._kick()
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "submitted": self.n_submitted,
+            "emitted": self.n_emitted,
+            "pending_admission": len(self._waiting),
+            "running": len(self._lanes),
+            "mode": self.mode,
+            "terminal": dict(self.tracing.counters),
+        }
+
+
+class FleetWatchdog:
+    """Arrival-time failure detector for sim fleets.
+
+    Two independent triggers, matching the two ways a replica lies:
+
+    * **heartbeat silence** — no heartbeat ARRIVED for
+      ``heartbeat_timeout_s`` (partitioned or crashed-without-hook).
+      Arrival time is read off the watchdog's own clock; the replica's
+      self-reported timestamp is recorded but never judged, so a
+      clock-skewed replica is NOT false-killed.
+    * **zero progress** — heartbeats keep arriving but a replica with
+      queued/running work emitted nothing for ``progress_timeout_s``
+      (the zombie case: accepts everything, emits nothing).
+
+    A kill calls ``SimReplica.fail``, which salvages every in-flight
+    stream through the router's ordinary ``on_crash`` reroute path —
+    detection is the only thing the watchdog adds."""
+
+    def __init__(self, world: SimWorld, *,
+                 heartbeat_timeout_s: float = 2.0,
+                 progress_timeout_s: float = 5.0,
+                 poll_every_s: float = 0.5):
+        self.world = world
+        self.clock = world.clock
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.progress_timeout_s = float(progress_timeout_s)
+        self.poll_every_s = float(poll_every_s)
+        self.n_killed = 0
+        self._lock = locks.make_lock("fleet.sim_watchdog")
+        self._last_arrival: Dict[int, float] = {}
+        self._last_self_t: Dict[int, float] = {}
+        self._work_since: Dict[int, float] = {}
+        self._replicas: Dict[int, SimReplica] = {}
+        self._started = False
+
+    def register(self, replica: SimReplica) -> None:
+        self._replicas[id(replica)] = replica
+        self._last_arrival[id(replica)] = self.clock.now()
+
+    def beat(self, replica: SimReplica, *, self_t: float) -> None:
+        self._last_arrival[id(replica)] = self.clock.now()
+        self._last_self_t[id(replica)] = float(self_t)
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self.clock.call_later(self.poll_every_s, self._poll)
+
+    def _poll(self) -> None:
+        now = self.clock.now()
+        for key, rep in list(self._replicas.items()):
+            if rep.mode in ("dead", "closed"):
+                continue
+            silent_s = now - self._last_arrival.get(key, now)
+            if silent_s > self.heartbeat_timeout_s:
+                self._kill(rep, f"no heartbeat for {silent_s:.1f}s")
+                continue
+            # zero-progress is judged only over a span the replica has
+            # CONTINUOUSLY held work: an idle replica's progress stamp
+            # goes stale by construction, and a batch of streams
+            # adopted from a fresh kill must not read as a zombie in
+            # the very poll pass that re-homed them (cascade kill)
+            if not rep.has_work():
+                self._work_since.pop(key, None)
+                continue
+            worked_s = now - self._work_since.setdefault(key, now)
+            if worked_s > self.progress_timeout_s and \
+                    now - rep.last_progress_t > self.progress_timeout_s:
+                self._kill(rep, "accepting but not emitting")
+                self._work_since.pop(key, None)
+        self.clock.call_later(self.poll_every_s, self._poll)
+
+    def _kill(self, rep: SimReplica, why: str) -> None:
+        with self._lock:
+            self.n_killed += 1
+        telemetry.count("fleet/sim_watchdog_kill")
+        self.world.log("watchdog_kill", replica=rep.label, why=why)
+        rep.fail(RuntimeError(f"watchdog: {why}"))
+
+
+class ChaosInjector:
+    """Scripted failure schedule against a hierarchical sim fleet.
+
+    Every injection is an event on the world clock, so a chaos run is
+    as deterministic as a clean one — same seed, same schedule, same
+    event log. Counters land as ``fleet/sim_chaos_*``."""
+
+    def __init__(self, world: SimWorld, root=None):
+        self.world = world
+        self.clock = world.clock
+        self.root = root
+        self.n_injected = 0
+
+    def _fire(self, kind: str, fn: Callable, *args) -> None:
+        self.n_injected += 1
+        telemetry.count(f"fleet/sim_chaos_{kind}")
+        fn(*args)
+
+    def pod_loss(self, t: float, pod_id: str) -> None:
+        """At ``t``: the whole pod drops off the ring and every replica
+        in it crashes — streams re-home cross-pod through salvage."""
+        self.clock.call_at(t, self._fire, "pod_loss",
+                           self._pod_loss, pod_id)
+
+    def _pod_loss(self, pod_id: str) -> None:
+        self.world.log("chaos_pod_loss", pod=pod_id)
+        leaf = self.root.pods.get(str(pod_id)) \
+            if self.root is not None else None
+        if leaf is None:
+            return
+        self.root.mark_pod_lost(pod_id)
+        for rep in list(leaf.replicas):
+            fail = getattr(rep.frontend, "fail", None)
+            if fail is not None:
+                fail(RuntimeError(f"pod {pod_id} lost"))
+
+    def zombie(self, t: float, replica: SimReplica) -> None:
+        self.clock.call_at(t, self._fire, "zombie", replica.set_zombie)
+
+    def partition(self, t: float, replica: SimReplica,
+                  heal_t: Optional[float] = None) -> None:
+        self.clock.call_at(t, self._fire, "partition",
+                           replica.set_partitioned)
+        if heal_t is not None:
+            self.clock.call_at(heal_t, replica.heal)
+
+    def slow(self, t: float, replica: SimReplica, factor: float,
+             until_t: Optional[float] = None) -> None:
+        self.clock.call_at(t, self._fire, "slow",
+                           replica.set_slow, factor)
+        if until_t is not None:
+            self.clock.call_at(until_t, replica.set_slow, 1.0)
+
+    def skew(self, t: float, replica: SimReplica,
+             offset_s: float) -> None:
+        self.clock.call_at(t, self._fire, "skew",
+                           replica.set_skew, offset_s)
+
+
+# --------------------------------------------------------------------
+# workload generators — pure functions of the world RNG, returning
+# arrival records {"t", "prompt", "tenant", "max_new_tokens"} in time
+# order, so a trace is reproducible from the seed alone
+# --------------------------------------------------------------------
+
+def _rand_prompt(rng: random.Random, n: int,
+                 vocab: int = 997) -> List[int]:
+    return [rng.randrange(1, vocab) for _ in range(max(1, n))]
+
+
+def diurnal_trace(rng: random.Random, *, duration_s: float,
+                  base_rps: float, peak_rps: float,
+                  period_s: float = 60.0, prompt_len: int = 8,
+                  max_new_tokens: int = 8,
+                  tenant: str = "default") -> List[Dict[str, Any]]:
+    """Sinusoidal arrival rate between ``base_rps`` (trough) and
+    ``peak_rps`` (crest) with period ``period_s`` — the compressed
+    day/night cycle an elastic policy must track."""
+    out: List[Dict[str, Any]] = []
+    t = 0.0
+    while True:
+        phase = 0.5 * (1.0 - math.cos(2.0 * math.pi * t / period_s))
+        rate = base_rps + (peak_rps - base_rps) * phase
+        t += rng.expovariate(max(rate, 1e-9))
+        if t >= duration_s:
+            return out
+        out.append({"t": t, "prompt": _rand_prompt(rng, prompt_len),
+                    "tenant": tenant,
+                    "max_new_tokens": max_new_tokens})
+
+
+def tenant_skew_trace(rng: random.Random, *, duration_s: float,
+                      rps: float, tenants: Sequence[str],
+                      skew: float = 1.5, prompt_len: int = 8,
+                      max_new_tokens: int = 8) -> List[Dict[str, Any]]:
+    """Zipf-weighted tenant mix: tenant ``i`` arrives with weight
+    ``1/(i+1)**skew`` — one whale, a long tail."""
+    weights = [1.0 / (i + 1) ** skew for i in range(len(tenants))]
+    out: List[Dict[str, Any]] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(max(rps, 1e-9))
+        if t >= duration_s:
+            return out
+        tenant = rng.choices(list(tenants), weights=weights)[0]
+        out.append({"t": t, "prompt": _rand_prompt(rng, prompt_len),
+                    "tenant": tenant,
+                    "max_new_tokens": max_new_tokens})
+
+
+def hot_prefix_storm(rng: random.Random, *, duration_s: float,
+                     rps: float, n_hot: int = 4,
+                     hot_fraction: float = 0.8, prompt_len: int = 16,
+                     max_new_tokens: int = 8,
+                     tenant: str = "default") -> List[Dict[str, Any]]:
+    """A small hot set of identical prompts dominating arrivals —
+    the trace where prefix-affinity placement pays or doesn't. A
+    consistent-hash root sends all repeats of one hot prompt to one
+    pod, so the leaf's affinity probe finds the cache holder."""
+    hot = [_rand_prompt(rng, prompt_len) for _ in range(max(1, n_hot))]
+    out: List[Dict[str, Any]] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(max(rps, 1e-9))
+        if t >= duration_s:
+            return out
+        if rng.random() < hot_fraction:
+            prompt = list(rng.choice(hot))
+        else:
+            prompt = _rand_prompt(rng, prompt_len)
+        out.append({"t": t, "prompt": prompt, "tenant": tenant,
+                    "max_new_tokens": max_new_tokens})
+
+
+def multi_turn_trace(rng: random.Random, *, n_sessions: int,
+                     turns: int = 3, think_s: float = 3.0,
+                     start_spread_s: float = 5.0, first_len: int = 8,
+                     user_len: int = 4,
+                     max_new_tokens: int = 8) -> List[Dict[str, Any]]:
+    """Conversations: each turn's prompt is the previous prompt plus
+    the model's (deterministic) answer plus fresh user tokens, so later
+    turns are growing-prefix repeats — the multi-turn arrival pattern
+    that rewards prefix caching and stable placement."""
+    out: List[Dict[str, Any]] = []
+    for s in range(max(1, n_sessions)):
+        t = rng.uniform(0.0, start_spread_s)
+        prompt = _rand_prompt(rng, first_len)
+        tenant = f"session-{s}"
+        for _ in range(max(1, turns)):
+            out.append({"t": t, "prompt": list(prompt),
+                        "tenant": tenant,
+                        "max_new_tokens": max_new_tokens})
+            answer = sim_expected(prompt, max_new_tokens)
+            prompt = prompt + answer + _rand_prompt(rng, user_len)
+            t += think_s + rng.uniform(0.0, think_s)
+    out.sort(key=lambda ev: ev["t"])
+    return out
+
+
+# --------------------------------------------------------------------
+# drivers
+# --------------------------------------------------------------------
+
+def build_sim_fleet(world: SimWorld, root, *, n_pods: int,
+                    pod_size: int,
+                    config: Optional[SimReplicaConfig] = None,
+                    watchdog: Optional[FleetWatchdog] = None,
+                    pod_prefix: str = "pod") -> List[SimReplica]:
+    """Populate ``root`` (a :class:`~.hierarchy.RootRouter`) with
+    ``n_pods`` pods of ``pod_size`` sim replicas each; returns every
+    replica created. With a ``watchdog``, replicas heartbeat into it."""
+    replicas: List[SimReplica] = []
+    for p in range(n_pods):
+        pod_id = f"{pod_prefix}{p:03d}"
+        pod = [SimReplica(f"{pod_id}.{i}", world, config)
+               for i in range(pod_size)]
+        root.add_pod(pod_id, remotes=pod)
+        replicas.extend(pod)
+    if watchdog is not None:
+        for rep in replicas:
+            rep.attach_watchdog(watchdog)
+        watchdog.start()
+    return replicas
+
+
+def run_trace(world: SimWorld, router, trace: Sequence[Dict[str, Any]],
+              *, horizon_s: float) -> List[tuple]:
+    """Schedule every arrival on the world clock, run to ``horizon_s``,
+    and return ``(event, handle)`` pairs in arrival order."""
+    results: List[tuple] = []
+
+    def _submit(ev: Dict[str, Any]) -> None:
+        handle = router.submit(
+            ev["prompt"], tenant=ev.get("tenant", "default"),
+            max_new_tokens=ev.get("max_new_tokens", 8))
+        results.append((ev, handle))
+
+    for ev in trace:
+        world.clock.call_at(ev["t"], _submit, ev)
+    world.clock.run_until(horizon_s)
+    return results
+
+
+def verify_streams(results: Sequence[tuple]) -> Dict[str, int]:
+    """Exact end-to-end audit against the token oracle. ``lost`` is a
+    stream that terminated without its full output after partial
+    delivery (or errored / never resolved); ``duplicated`` is any
+    over-delivery or oracle mismatch; ``rejected`` only counts CLEAN
+    rejections (zero tokens delivered — the caller was told up
+    front)."""
+    out = {"n": len(results), "done": 0, "rejected": 0, "lost": 0,
+           "duplicated": 0, "pending": 0}
+    for ev, handle in results:
+        status = handle.status
+        toks = handle.tokens
+        want_n = ev.get("max_new_tokens", 8)
+        if status == "done":
+            want = sim_expected(ev["prompt"], want_n)
+            if len(toks) > len(want) or toks != want[:len(toks)]:
+                out["duplicated"] += 1
+            elif len(toks) < len(want):
+                out["lost"] += 1
+            else:
+                out["done"] += 1
+        elif status == "rejected" and not toks:
+            out["rejected"] += 1
+        elif status == "pending":
+            out["pending"] += 1
+        else:
+            out["lost"] += 1
+    return out
+
+
+def log_results(world: SimWorld, results: Sequence[tuple]) -> None:
+    """Append every stream's terminal record to the world event log
+    (arrival order — deterministic), closing the byte-reproducibility
+    artifact."""
+    for ev, handle in results:
+        world.log("result", sid=world.sid(handle),
+                  status=handle.status, n_tokens=len(handle.tokens))

@@ -376,10 +376,55 @@ KERNELS_SPECS: List[MetricSpec] = [
                     "case); null (skipped) on CPU hosts"),
 ]
 
+FLEETSIM_SPECS: List[MetricSpec] = [
+    # The simulator is deterministic (seeded virtual time), so nearly
+    # everything here is a binary gate or an exact count — only the
+    # wall-clock placement latencies are timing-shaped, and those are
+    # gated by the in-bench 2x ratio bound, not diffed here.
+    MetricSpec(("fleetsim_replicas",), SHIFT, abs_tol=0.0,
+               note="the gated fleet size (1000) is part of the "
+                    "bench's contract"),
+    MetricSpec(("placement", "scaling_ok"), SHIFT, abs_tol=0.0,
+               note="root placement p99 at 1000 replicas within 2x "
+                    "the p99 at 10, binary"),
+    MetricSpec(("prefix", "within_tol"), SHIFT, abs_tol=0.0,
+               note="hierarchical prefix hit rate within 10% of the "
+                    "flat-router oracle, binary"),
+    MetricSpec(("prefix", "root_hit_rate"), HIGHER, 0.10,
+               note="deterministic given the seed; drift means the "
+                    "ring or the leaf affinity probe changed"),
+    MetricSpec(("prefix", "lost"), SHIFT, abs_tol=0.0,
+               note="no chaos in the affinity case: zero lost"),
+    MetricSpec(("prefix", "duplicated"), SHIFT, abs_tol=0.0),
+    MetricSpec(("prefix", "rejected"), SHIFT, abs_tol=0.0,
+               note="the storm must not trip edge admission"),
+    MetricSpec(("chaos", "lost"), SHIFT, abs_tol=0.0,
+               note="zero lost streams through pod loss + zombie + "
+                    "partition chaos, exact token-oracle audit"),
+    MetricSpec(("chaos", "duplicated"), SHIFT, abs_tol=0.0,
+               note="zero duplicated/diverged streams, exact audit"),
+    MetricSpec(("chaos", "pending"), SHIFT, abs_tol=0.0,
+               note="every stream reaches a terminal state"),
+    MetricSpec(("chaos", "digest_match"), SHIFT, abs_tol=0.0,
+               note="same seed reproduces the event log byte-for-byte "
+                    "(sha256 over two full runs), binary"),
+    MetricSpec(("chaos", "seed_sensitivity"), SHIFT, abs_tol=0.0,
+               note="a different seed must diverge — the log actually "
+                    "records the run"),
+    MetricSpec(("chaos", "watchdog_kills"), SHIFT, abs_tol=0.0,
+               note="exactly the zombie and the unhealed partition; "
+                    "a skewed-but-healthy replica false-killed shows "
+                    "up here"),
+    MetricSpec(("chaos", "pod_failover"), SHIFT, abs_tol=0.0,
+               note="pod loss salvages in-flight streams cross-pod, "
+                    "deterministic count"),
+]
+
 SPEC_SETS: Dict[str, List[MetricSpec]] = {
     "serving": SERVING_SPECS,
     "frontend": FRONTEND_SPECS,
     "fleet": FLEET_SPECS,
+    "fleetsim": FLEETSIM_SPECS,
     "kernels": KERNELS_SPECS,
 }
 
@@ -391,6 +436,8 @@ def detect_kind(doc: Dict[str, Any]) -> Optional[str]:
         return "frontend"
     if "replica_scaling" in doc:
         return "fleet"
+    if "fleetsim_replicas" in doc:
+        return "fleetsim"
     if "decode_microbench" in doc:
         return "kernels"
     return None
@@ -462,7 +509,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("current", help="current BENCH_*.json")
     p.add_argument("--kind",
                    choices=["auto", "serving", "frontend", "fleet",
-                            "kernels"],
+                            "fleetsim", "kernels"],
                    default="auto")
     p.add_argument("--fail-on-missing", action="store_true",
                    help="exit 1 when a watched metric is absent from "

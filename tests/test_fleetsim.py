@@ -1,0 +1,315 @@
+"""Discrete-event fleet simulator: virtual clock, sim replicas against
+the token oracle, chaos modes (zombie / partition / skew), the
+arrival-time watchdog, workload generators, and byte-for-byte event-log
+determinism.
+
+Everything runs on :class:`SimClock` — no wall sleeps, no threads — so
+a 30-sim-second chaos scenario costs milliseconds and the whole module
+is tier-1 fast. The 1000-replica sweep at the bottom is ``slow``.
+"""
+
+import random
+
+import pytest
+
+from deepspeed_tpu.serving.fleet import (ChaosInjector, FleetWatchdog,
+                                         RootRouter, SimClock,
+                                         SimReplica, SimReplicaConfig,
+                                         SimWorld, build_sim_fleet,
+                                         diurnal_trace,
+                                         hot_prefix_storm,
+                                         multi_turn_trace, run_trace,
+                                         sim_expected,
+                                         tenant_skew_trace,
+                                         verify_streams)
+from deepspeed_tpu.serving.frontend.admission import (
+    REJECT_FRONTEND_QUEUE_FULL)
+from deepspeed_tpu.serving.paged_kv import PrefixCache
+
+pytestmark = pytest.mark.fleetsim
+
+
+# --------------------------------------------------------------------------
+# clock
+# --------------------------------------------------------------------------
+class TestSimClock:
+    def test_events_fire_in_time_then_schedule_order(self):
+        clock, fired = SimClock(), []
+        clock.call_at(2.0, fired.append, "late")
+        clock.call_at(1.0, fired.append, "early")
+        clock.call_at(1.0, fired.append, "early-tie")  # same t: seq order
+        assert clock.run_until(5.0) == 3
+        assert fired == ["early", "early-tie", "late"]
+        assert clock.now() == 5.0          # pinned to the horizon
+
+    def test_past_events_clamp_to_now(self):
+        clock, fired = SimClock(start=10.0), []
+        clock.call_at(3.0, lambda: fired.append(clock.now()))
+        clock.run_for(1.0)
+        assert fired == [10.0]             # never travels backwards
+
+    def test_self_rescheduling_loop_stops_at_horizon(self):
+        clock, ticks = SimClock(), []
+
+        def tick():
+            ticks.append(clock.now())
+            clock.call_later(1.0, tick)
+
+        clock.call_later(1.0, tick)
+        clock.run_until(4.5)
+        assert ticks == [1.0, 2.0, 3.0, 4.0]
+        assert clock.now() == 4.5 and clock.pending_events == 1
+
+
+# --------------------------------------------------------------------------
+# one replica against the oracle
+# --------------------------------------------------------------------------
+class TestSimReplica:
+    def test_stream_matches_oracle(self):
+        world = SimWorld(seed=1)
+        rep = SimReplica("r0", world)
+        h = rep.submit([4, 5, 6], max_new_tokens=10)
+        world.clock.run_for(10.0)
+        assert h.status == "done"
+        assert h.tokens == sim_expected([4, 5, 6], 10)
+        assert rep.holds_prefix(PrefixCache.key_for([4, 5, 6]))
+
+    def test_queue_full_rejects_cleanly(self):
+        world = SimWorld()
+        rep = SimReplica("r0", world,
+                         SimReplicaConfig(max_running=1, max_queue=1))
+        a = rep.submit([1], max_new_tokens=4)
+        b = rep.submit([2], max_new_tokens=4)
+        c = rep.submit([3], max_new_tokens=4)   # 1 running + 1 queued
+        assert c.status == "rejected"
+        assert c.reject_reason == REJECT_FRONTEND_QUEUE_FULL
+        assert c.tokens == []
+        world.clock.run_for(10.0)
+        assert a.status == b.status == "done"
+
+    def test_load_snapshot_shape(self):
+        world = SimWorld()
+        rep = SimReplica("r0", world)
+        rep.submit([1, 2], max_new_tokens=64)
+        snap = rep.load_snapshot()
+        assert (snap["engine_running"]
+                + snap["admission"]["pending"]) >= 1
+        assert snap["throughput"]["tokens_per_s"] > 0
+        assert snap["engine_backlog_tokens"] > 0
+
+    def test_partition_buffers_then_heal_flushes(self):
+        """Tokens emitted during a partition are invisible to the
+        caller; ``heal()`` flushes the buffer and the finished stream
+        is oracle-exact — nothing lost, nothing duplicated."""
+        world = SimWorld()
+        rep = SimReplica(
+            "r0", world, SimReplicaConfig(decode_tokens_per_s=64.0))
+        h = rep.submit([7, 8, 9], max_new_tokens=64)
+        world.clock.run_for(0.3)
+        seen_at_cut = len(h.tokens)
+        assert 0 < seen_at_cut < 64
+        rep.set_partitioned()
+        world.clock.run_for(0.5)           # decoding continues inside
+        assert len(h.tokens) == seen_at_cut
+        rep.heal()
+        world.clock.run_for(10.0)
+        assert h.status == "done"
+        assert h.tokens == sim_expected([7, 8, 9], 64)
+
+
+# --------------------------------------------------------------------------
+# watchdog + chaos through the real routers
+# --------------------------------------------------------------------------
+def _chaos_fleet(*, n_pods=1, pod_size=3, decode=64.0):
+    world = SimWorld(seed=3)
+    root = RootRouter(clock=world.clock)
+    watchdog = FleetWatchdog(world)
+    reps = build_sim_fleet(
+        world, root, n_pods=n_pods, pod_size=pod_size,
+        config=SimReplicaConfig(decode_tokens_per_s=decode),
+        watchdog=watchdog)
+    return world, root, watchdog, reps
+
+
+class TestWatchdog:
+    def test_zombie_killed_streams_rehome(self):
+        world, root, dog, reps = _chaos_fleet()
+        try:
+            handles = [root.submit([2, i + 1], max_new_tokens=32)
+                       for i in range(6)]
+            world.clock.run_for(0.1)
+            ChaosInjector(world, root).zombie(0.2, reps[0])
+            world.clock.run_for(30.0)
+            assert dog.n_killed == 1 and reps[0].crashed
+            for i, h in enumerate(handles):
+                assert h.status == "done"
+                assert h.tokens == sim_expected([2, i + 1], 32)
+        finally:
+            root.close()
+
+    def test_unhealed_partition_killed_no_duplicates(self):
+        """Heartbeats stop arriving → silence kill at ~2.5 s; the
+        partition-buffered tokens are DROPPED on fail, so the adoptee's
+        replay continues from exactly what the caller saw."""
+        world, root, dog, reps = _chaos_fleet()
+        try:
+            handles = [root.submit([6, i + 1], max_new_tokens=48)
+                       for i in range(6)]
+            ChaosInjector(world, root).partition(0.2, reps[1])
+            world.clock.run_for(30.0)
+            assert dog.n_killed == 1 and reps[1].crashed
+            audit = verify_streams(
+                [({"prompt": [6, i + 1], "max_new_tokens": 48}, h)
+                 for i, h in enumerate(handles)])
+            assert audit["done"] == 6
+            assert audit["lost"] == audit["duplicated"] == 0
+        finally:
+            root.close()
+
+    def test_clock_skewed_heartbeats_survive(self):
+        """Skew corrupts the heartbeat's self-reported timestamp; the
+        watchdog judges ARRIVAL time only, so nothing dies."""
+        world, root, dog, reps = _chaos_fleet()
+        try:
+            handles = [root.submit([9, i + 1], max_new_tokens=16)
+                       for i in range(4)]
+            ChaosInjector(world, root).skew(0.1, reps[2], 7.5)
+            world.clock.run_for(20.0)
+            assert dog.n_killed == 0
+            assert all(h.status == "done" for h in handles)
+        finally:
+            root.close()
+
+    def test_fresh_adoptees_not_cascade_killed(self):
+        """Regression: a zombie kill re-homes its streams onto replicas
+        that sat idle for >progress_timeout_s — their progress stamps
+        are stale BY CONSTRUCTION. The same watchdog pass must not read
+        them as zombies; zero-progress only counts over a span of
+        continuously held work."""
+        world, root, dog, reps = _chaos_fleet()
+        try:
+            world.clock.run_for(4.0)       # reps[1..2] idle, stamps stale
+            handles = [reps[0].submit([8, i + 1], max_new_tokens=32)
+                       for i in range(6)]
+            ChaosInjector(world, root).zombie(4.1, reps[0])
+            world.clock.run_for(30.0)
+            assert dog.n_killed == 1, "fresh adoptees were cascade-killed"
+            for i, h in enumerate(handles):
+                assert h.status == "done"
+                assert h.tokens == sim_expected([8, i + 1], 32)
+        finally:
+            root.close()
+
+
+# --------------------------------------------------------------------------
+# workload generators
+# --------------------------------------------------------------------------
+class TestGenerators:
+    GENS = [
+        lambda rng: diurnal_trace(rng, duration_s=30.0, base_rps=1.0,
+                                  peak_rps=8.0),
+        lambda rng: tenant_skew_trace(
+            rng, duration_s=30.0, rps=4.0,
+            tenants=["whale", "mid", "tail"]),
+        lambda rng: hot_prefix_storm(rng, duration_s=30.0, rps=4.0),
+        lambda rng: multi_turn_trace(rng, n_sessions=5),
+    ]
+
+    @pytest.mark.parametrize("gen", GENS)
+    def test_deterministic_and_time_sorted(self, gen):
+        a = gen(random.Random(42))
+        b = gen(random.Random(42))
+        assert a == b and a != gen(random.Random(43))
+        ts = [ev["t"] for ev in a]
+        assert ts == sorted(ts) and all(t >= 0 for t in ts)
+        assert all(ev["prompt"] and ev["max_new_tokens"] >= 1
+                   for ev in a)
+
+    def test_hot_prefix_storm_repeats_prompts(self):
+        trace = hot_prefix_storm(random.Random(7), duration_s=30.0,
+                                 rps=4.0, n_hot=2, hot_fraction=0.8)
+        prompts = [tuple(ev["prompt"]) for ev in trace]
+        hottest = max(prompts, key=prompts.count)
+        assert prompts.count(hottest) >= 0.2 * len(prompts)
+
+    def test_tenant_skew_is_skewed(self):
+        trace = tenant_skew_trace(
+            random.Random(7), duration_s=60.0, rps=8.0,
+            tenants=[f"t{i}" for i in range(4)], skew=1.5)
+        tenants = [ev["tenant"] for ev in trace]
+        assert len(set(tenants)) >= 2
+        # Zipf 1.5 over 4 tenants: the whale holds ~48% of arrivals
+        assert tenants.count("t0") > len(tenants) / 3
+
+
+# --------------------------------------------------------------------------
+# audit + determinism
+# --------------------------------------------------------------------------
+class _FakeHandle:
+    def __init__(self, status, tokens):
+        self.status, self.tokens = status, tokens
+
+
+class TestAudit:
+    def test_verify_streams_classification(self):
+        ev = {"prompt": [3, 4], "max_new_tokens": 4}
+        want = sim_expected([3, 4], 4)
+        audit = verify_streams([
+            (ev, _FakeHandle("done", list(want))),          # done
+            (ev, _FakeHandle("done", want[:2])),            # lost (short)
+            (ev, _FakeHandle("done", want + [9])),          # duplicated
+            (ev, _FakeHandle("done", [99, 98, 97, 96])),    # duplicated
+            (ev, _FakeHandle("rejected", [])),              # clean reject
+            (ev, _FakeHandle("rejected", want[:1])),        # lost (dirty)
+            (ev, _FakeHandle("pending", [])),               # pending
+        ])
+        assert audit == {"n": 7, "done": 1, "rejected": 1, "lost": 2,
+                         "duplicated": 2, "pending": 1}
+
+    @staticmethod
+    def _digest(seed):
+        world = SimWorld(seed=seed)
+        root = RootRouter(clock=world.clock)
+        build_sim_fleet(world, root, n_pods=2, pod_size=2)
+        trace = hot_prefix_storm(random.Random(seed), duration_s=10.0,
+                                 rps=6.0)
+        results = run_trace(world, root, trace, horizon_s=40.0)
+        audit = verify_streams(results)
+        root.close()
+        assert audit["lost"] == audit["duplicated"] == 0
+        return world.digest()
+
+    def test_event_log_reproducible_and_seed_sensitive(self):
+        assert self._digest(5) == self._digest(5)
+        assert self._digest(5) != self._digest(6)
+
+
+# --------------------------------------------------------------------------
+# the big one
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+def test_thousand_replica_sweep():
+    """200 pods x 5 replicas under a mixed diurnal + tenant-skew day:
+    every admitted stream finishes oracle-exact, nothing lost or
+    duplicated, and the root actually spread load across pods."""
+    world = SimWorld(seed=11)
+    root = RootRouter(clock=world.clock)
+    build_sim_fleet(world, root, n_pods=200, pod_size=5)
+    rng = random.Random(11)
+    trace = sorted(
+        diurnal_trace(rng, duration_s=60.0, base_rps=10.0,
+                      peak_rps=60.0)
+        + tenant_skew_trace(rng, duration_s=60.0, rps=20.0,
+                            tenants=[f"t{i}" for i in range(8)]),
+        key=lambda ev: ev["t"])
+    results = run_trace(world, root, trace, horizon_s=240.0)
+    audit = verify_streams(results)
+    try:
+        assert audit["lost"] == audit["duplicated"] == 0
+        assert audit["pending"] == audit["rejected"] == 0
+        assert audit["done"] == audit["n"] > 1000
+        stats = root.stats()
+        busy = [p for p, s in stats["per_pod"].items() if s["routed"]]
+        assert len(busy) > 100
+    finally:
+        root.close()

@@ -1,0 +1,371 @@
+"""Hierarchical pod routing: consistent-hash ring, root placement,
+edge shedding, pins, cross-pod failover/migration, per-pod elasticity.
+
+Ring properties are tested as the ISSUE pins them: chi-square
+uniformity over 64 pods, minimal movement on join/leave (<= 2/pods of
+the keyspace), and cross-process determinism (a subprocess with a
+different PYTHONHASHSEED must compute the identical assignment — the
+ring uses blake2b, never Python ``hash()``).
+
+Router behavior runs over the discrete-event simulator's replicas
+(:mod:`deepspeed_tpu.serving.fleet.sim`) — no JAX, no wall sleeps, so
+the whole module is tier-1 fast.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.serving.fleet import (ConsistentHashRing, ElasticConfig,
+                                         REJECT_POD_OVERLOADED, RootConfig,
+                                         RootRouter, SimReplica,
+                                         SimReplicaConfig, SimWorld,
+                                         build_sim_fleet,
+                                         elastic_config_from_elasticity,
+                                         sim_expected)
+from deepspeed_tpu.serving.paged_kv import PrefixCache
+
+pytestmark = pytest.mark.fleetsim
+
+
+# --------------------------------------------------------------------------
+# consistent-hash ring
+# --------------------------------------------------------------------------
+def _assignments(n_pods=64, n_keys=20000, vnodes=64):
+    ring = ConsistentHashRing(vnodes=vnodes)
+    for p in range(n_pods):
+        ring.add_pod(f"pod{p:03d}")
+    return ring, {i: ring.pod_for(f"key-{i}".encode())
+                  for i in range(n_keys)}
+
+
+class TestRing:
+    def test_chi_square_uniformity_64_pods(self):
+        """Keyspace shares across 64 pods. The statistic decomposes as
+        multinomial noise (~df = 63) plus vnode-geometry imbalance
+        (~N/vnodes per key): with 64 vnodes/pod the per-pod share's
+        relative sd is ~1/sqrt(64), contributing ~N/64 on top of df.
+        Bound at df + 2*N/vnodes — a hash that clumps (or a broken
+        point function) lands orders of magnitude above it."""
+        n_pods, n_keys, vnodes = 64, 20000, 64
+        _, assign = _assignments(n_pods, n_keys, vnodes)
+        counts = [0] * n_pods
+        for pod in assign.values():
+            counts[int(pod[3:])] += 1
+        exp = n_keys / n_pods
+        chi2 = sum((c - exp) ** 2 / exp for c in counts)
+        assert chi2 < (n_pods - 1) + 2 * n_keys / vnodes, (
+            f"chi2={chi2:.1f} — keyspace is not uniform across pods")
+        # no pod starves or hogs beyond vnode-variance expectations
+        assert min(counts) > 0.4 * exp
+        assert max(counts) < 2.0 * exp
+
+    def test_minimal_movement_on_join_and_leave(self):
+        """Joining pod 33 of 33 moves <= 2/33 of the keyspace, every
+        moved key moves TO the joiner, and removing it restores the
+        original assignment exactly."""
+        n_keys = 10000
+        ring, before = _assignments(32, n_keys)
+        ring.add_pod("pod032")
+        after = {i: ring.pod_for(f"key-{i}".encode())
+                 for i in range(n_keys)}
+        moved = [i for i in before if before[i] != after[i]]
+        assert 0 < len(moved) <= 2 * n_keys / 33
+        assert all(after[i] == "pod032" for i in moved)
+        ring.remove_pod("pod032")
+        assert {i: ring.pod_for(f"key-{i}".encode())
+                for i in range(n_keys)} == before
+
+    def test_cross_process_determinism(self):
+        """The assignment digest must be identical in a subprocess
+        running under a different PYTHONHASHSEED — i.e. the ring never
+        leans on Python's randomized ``hash()``."""
+        _, assign = _assignments(16, 2000)
+        local = hashlib.sha256(
+            "".join(f"{i}:{assign[i]};" for i in sorted(assign))
+            .encode()).hexdigest()
+        prog = (
+            "from deepspeed_tpu.serving.fleet import ConsistentHashRing\n"
+            "import hashlib\n"
+            "ring = ConsistentHashRing(vnodes=64)\n"
+            "for p in range(16): ring.add_pod(f'pod{p:03d}')\n"
+            "a = {i: ring.pod_for(f'key-{i}'.encode())"
+            " for i in range(2000)}\n"
+            "print(hashlib.sha256(''.join(f'{i}:{a[i]};'"
+            " for i in sorted(a)).encode()).hexdigest())\n")
+        env = dict(os.environ, PYTHONHASHSEED="12345",
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", prog], env=env, text=True,
+            capture_output=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip().splitlines()[-1] == local
+
+    def test_pods_for_distinct_and_ordered(self):
+        ring = ConsistentHashRing(vnodes=8)
+        for p in "abcd":
+            ring.add_pod(p)
+        got = ring.pods_for(b"some-key", 3)
+        assert len(got) == len(set(got)) == 3
+        assert got[0] == ring.pod_for(b"some-key")
+        # asking for more pods than exist returns them all, once each
+        assert sorted(ring.pods_for(b"some-key", 99)) == list("abcd")
+        assert ring.pods_for(b"k", 0) == []
+        assert ConsistentHashRing().pods_for(b"k", 2) == []
+        assert ConsistentHashRing().pod_for(b"k") is None
+
+    def test_vnodes_validation(self):
+        with pytest.raises(ValueError):
+            ConsistentHashRing(vnodes=0)
+
+
+# --------------------------------------------------------------------------
+# root placement over sim pods
+# --------------------------------------------------------------------------
+def _fleet(n_pods=4, pod_size=2, *, seed=0, config=None, root_cfg=None,
+           elastic=None):
+    world = SimWorld(seed=seed)
+    root = RootRouter(config=root_cfg or RootConfig(),
+                      elastic=elastic, clock=world.clock)
+    reps = build_sim_fleet(world, root, n_pods=n_pods,
+                           pod_size=pod_size, config=config)
+    return world, root, reps
+
+
+class TestRootPlacement:
+    def test_same_prompt_same_pod(self):
+        world, root, _ = _fleet()
+        prompt = [5, 6, 7, 8]
+        expect = root._ring.pod_for(PrefixCache.key_for(prompt))
+        try:
+            for _ in range(4):
+                root.submit(prompt, max_new_tokens=2)
+            world.clock.run_for(10.0)
+            stats = root.stats()
+            assert stats["per_pod"][expect]["routed"] == 4
+            assert stats["routed"] == 4 and stats["shed"] == 0
+        finally:
+            root.close()
+
+    def test_streams_match_oracle(self):
+        world, root, _ = _fleet()
+        try:
+            handles = [root.submit([i + 1, i + 2, i + 3],
+                                   max_new_tokens=6)
+                       for i in range(8)]
+            world.clock.run_for(30.0)
+            for i, h in enumerate(handles):
+                assert h.status == "done"
+                assert h.tokens == sim_expected(
+                    [i + 1, i + 2, i + 3], 6)
+        finally:
+            root.close()
+
+    def test_tenant_and_adapter_pins(self):
+        world, root, _ = _fleet()
+        prompt = [9, 9, 9]
+        ring_pod = root._ring.pod_for(PrefixCache.key_for(prompt))
+        other = next(p for p in root.pods if p != ring_pod)
+        third = next(p for p in root.pods
+                     if p not in (ring_pod, other))
+        try:
+            root.pin_tenant("vip", other)
+            h = root.submit(prompt, tenant="vip", max_new_tokens=2)
+            assert root._placements[-1]["pod"] == other
+            # adapter pin outranks the tenant pin
+            root.pin_adapter("lora-x", third)
+            root.submit(prompt, tenant="vip", adapter="lora-x",
+                        max_new_tokens=2)
+            assert root._placements[-1]["pod"] == third
+            # unpin restores ring placement
+            root.pin_tenant("vip", None)
+            root.pin_adapter("lora-x", None)
+            root.submit(prompt, tenant="vip", adapter="lora-x",
+                        max_new_tokens=2)
+            assert root._placements[-1]["pod"] == ring_pod
+            world.clock.run_for(10.0)
+            assert h.status == "done"
+        finally:
+            root.close()
+
+    def test_edge_shed_when_all_pods_overloaded(self):
+        """shed_pending=0 makes any nonzero admission backlog an
+        overload; with every replica's lanes full the next submit is
+        rejected AT THE EDGE with ``pod_overloaded`` — zero tokens,
+        clean reject, counters moved."""
+        world, root, _ = _fleet(
+            n_pods=2, pod_size=1,
+            config=SimReplicaConfig(max_running=1, max_queue=2,
+                                    decode_tokens_per_s=1.0),
+            root_cfg=RootConfig(shed_pending=1))
+        try:
+            # Advance past agg_ttl_s between submits so the root sees
+            # each pod's fresh pending count (the aggregate snapshot is
+            # TTL-cached); at 1 token/s nothing drains meanwhile.
+            keep = []
+            for i in range(8):
+                keep.append(root.submit([7, i], max_new_tokens=64))
+                world.clock.run_for(0.1)
+            shed = [h for h in keep if h.status == "rejected"]
+            assert shed, "overloaded pods never shed at the edge"
+            assert all(h.reject_reason == REJECT_POD_OVERLOADED
+                       and not h.tokens for h in shed)
+            assert root.stats()["shed"] == len(shed)
+        finally:
+            root.close()
+
+    def test_no_pods_sheds(self):
+        world = SimWorld()
+        root = RootRouter(clock=world.clock)
+        h = root.submit([1, 2, 3], max_new_tokens=4)
+        assert h.status == "rejected"
+        assert h.reject_reason == REJECT_POD_OVERLOADED
+        root.close()
+
+
+# --------------------------------------------------------------------------
+# failover, migration, retirement, elasticity
+# --------------------------------------------------------------------------
+class TestPodLifecycle:
+    def test_pod_loss_failover_zero_loss(self):
+        """Kill a whole pod mid-stream: every in-flight stream re-homes
+        onto a survivor pod (replaying its emitted prefix) and finishes
+        bit-identical to the oracle."""
+        world, root, reps = _fleet(
+            n_pods=3, pod_size=2,
+            config=SimReplicaConfig(decode_tokens_per_s=8.0))
+        try:
+            handles = [root.submit([3, i + 1], max_new_tokens=16)
+                       for i in range(12)]
+            world.clock.run_for(0.5)         # mid-stream everywhere
+            victim = root._placements[-1]["pod"]
+            root.mark_pod_lost(victim)
+            for rep in list(root.pods[victim].replicas):
+                rep.frontend.fail(RuntimeError("rack power"))
+            world.clock.run_for(60.0)
+            for i, h in enumerate(handles):
+                assert h.status == "done", (i, h.status, h.reject_reason)
+                assert h.tokens == sim_expected([3, i + 1], 16)
+            assert root.stats()["pod_failover"] >= 1
+        finally:
+            root.close()
+
+    def test_cross_pod_migrate(self):
+        world, root, reps = _fleet(
+            n_pods=2, pod_size=1,
+            config=SimReplicaConfig(decode_tokens_per_s=4.0))
+        try:
+            h = root.submit([11, 12, 13], max_new_tokens=12)
+            src = root._placements[-1]["pod"]
+            dst = next(p for p in root.pods if p != src)
+            # the per-chunk budget floors at 1 token / 0.05 s chunk, so
+            # 0.3 s of sim time emits a handful of the 12 tokens
+            world.clock.run_for(0.3)
+            assert 0 < len(h.tokens) < 12
+            assert root.migrate(h.uid, src, dst)
+            world.clock.run_for(60.0)
+            assert h.status == "done"
+            assert h.tokens == sim_expected([11, 12, 13], 12)
+            assert root.stats()["cross_migrated"] == 1
+        finally:
+            root.close()
+
+    def test_retire_pod_redistributes_and_finalizes(self):
+        world, root, _ = _fleet(n_pods=3, pod_size=2)
+        victim = "pod001"
+        try:
+            assert root.retire_pod(victim)
+            assert victim not in root._ring
+            # fresh placements avoid the retiring pod entirely
+            for i in range(8):
+                root.submit([i + 2, i + 5], max_new_tokens=2)
+            assert all(p["pod"] != victim
+                       for p in list(root._placements)[-8:])
+            world.clock.run_for(10.0)
+            root.step()
+            assert victim not in root.pods
+            assert root.stats()["pods_retired_total"] == 1
+        finally:
+            root.close()
+
+    def test_step_auto_detects_dead_pod(self):
+        world, root, reps = _fleet(n_pods=2, pod_size=1)
+        try:
+            reps[0].fail(RuntimeError("gone"))
+            rec = root.step()
+            assert rec["lost"] == ["pod000"]
+            assert "pod000" not in root._ring
+            assert root.n_pods == 1
+        finally:
+            root.close()
+
+    def test_per_pod_elastic_controllers(self):
+        world, root, _ = _fleet(
+            n_pods=2, pod_size=1,
+            elastic=ElasticConfig(min_replicas=1, max_replicas=3,
+                                  cooldown_s=0.0))
+        try:
+            assert set(root.controllers) == {"pod000", "pod001"}
+            # each controller steps against ITS pod's router only
+            rec = root.step()
+            assert set(rec["elastic"]) == {"pod000", "pod001"}
+            assert all("action" in r and r["routable"] >= 1
+                       for r in rec["elastic"].values())
+            # controllers are independent instances with their own cfg
+            c0, c1 = (root.controllers[p] for p in ("pod000", "pod001"))
+            assert c0 is not c1 and c0.config is not c1.config
+        finally:
+            root.close()
+
+
+# --------------------------------------------------------------------------
+# elasticity heritage bridge (satellite: elasticity/ wiring)
+# --------------------------------------------------------------------------
+class TestElasticityBridge:
+    DS_CONFIG = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 1536,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 2, "max_gpus": 12,
+            "min_time": 20,
+            "version": 0.1,
+        },
+        "train_micro_batch_size_per_gpu": 2,
+    }
+
+    def test_round_trip_single_pod(self):
+        cfg = elastic_config_from_elasticity(self.DS_CONFIG)
+        assert (cfg.min_replicas, cfg.max_replicas) == (2, 12)
+        assert cfg.target_replicas == 2
+        assert isinstance(cfg, ElasticConfig)
+
+    def test_round_trip_split_across_pods(self):
+        cfg = elastic_config_from_elasticity(self.DS_CONFIG, n_pods=4)
+        assert (cfg.min_replicas, cfg.max_replicas) == (1, 3)
+
+    def test_overrides_pass_through(self):
+        cfg = elastic_config_from_elasticity(
+            self.DS_CONFIG, cooldown_s=1.5, rebalance=True)
+        assert cfg.cooldown_s == 1.5 and cfg.rebalance is True
+
+    def test_rejects_bad_pod_count(self):
+        with pytest.raises(ValueError):
+            elastic_config_from_elasticity(self.DS_CONFIG, n_pods=0)
+
+    def test_bridge_feeds_per_pod_controllers(self):
+        """The training-side valid-world schedule, split across 4
+        pods, becomes each pod controller's replica band."""
+        cfg = elastic_config_from_elasticity(self.DS_CONFIG, n_pods=4)
+        world, root, _ = _fleet(n_pods=4, pod_size=1, elastic=cfg)
+        try:
+            for ctrl in root.controllers.values():
+                assert ctrl.config.min_replicas == 1
+                assert ctrl.config.max_replicas == 3
+        finally:
+            root.close()

@@ -279,3 +279,82 @@ class TestExpositionRebind:
             assert ms2.port == port
         finally:
             ms2.stop()
+
+
+class TestSnapshotCache:
+    """The placement-probe cache on ``RemoteReplica``: the router calls
+    ``load_snapshot()``/``holds_prefix()`` per replica per submit, so
+    both are TTL-cached (``snapshot_ttl_s``) and invalidated by every
+    local state-changing event. Staleness is therefore bounded by the
+    TTL from above and by invalidation from below — these tests pin
+    both bounds on a fake clock with a counting network seam, no
+    server needed."""
+
+    def _replica(self, ttl=0.25):
+        clock = {"t": 0.0}
+        rr = RemoteReplica("127.0.0.1", 1, snapshot_ttl_s=ttl,
+                           clock=lambda: clock["t"])
+        calls = {"n": 0}
+
+        def fake_get(path, default=None):
+            calls["n"] += 1
+            if path.startswith("/v1/prefix"):
+                return {"holds": True}
+            return {"schema": LOAD_SCHEMA,
+                    "admission": {"pending": calls["n"]},
+                    "throughput": {"tokens_per_s": 100.0},
+                    "engine_backlog_tokens": 0,
+                    "engine_queue_depth": 0, "engine_running": 0}
+
+        rr._get_json = fake_get
+        return rr, clock, calls
+
+    def test_load_snapshot_staleness_bounded_by_ttl(self):
+        rr, clock, calls = self._replica(ttl=0.25)
+        first = rr.load_snapshot()
+        assert calls["n"] == 1
+        # inside the TTL: served from cache, byte-identical
+        clock["t"] = 0.24
+        assert rr.load_snapshot() is first and calls["n"] == 1
+        # one tick past the TTL: must re-probe — a reading can never
+        # be more than snapshot_ttl_s old
+        clock["t"] = 0.26
+        assert rr.load_snapshot()["admission"]["pending"] == 2
+        assert calls["n"] == 2
+
+    def test_holds_prefix_cached_per_key(self):
+        rr, clock, calls = self._replica()
+        key = b"\x01" * 16
+        assert rr.holds_prefix(key) and calls["n"] == 1
+        assert rr.holds_prefix(key) and calls["n"] == 1   # cache hit
+        assert rr.holds_prefix(b"\x02" * 16) and calls["n"] == 2
+        clock["t"] = 0.3                                  # past TTL
+        assert rr.holds_prefix(key) and calls["n"] == 3
+
+    def test_invalidation_beats_ttl(self):
+        """A state-changing event drops the cache immediately — the
+        next probe inside the TTL still hits the network."""
+        rr, clock, calls = self._replica()
+        rr.load_snapshot()
+        rr.holds_prefix(b"\x03" * 16)
+        assert calls["n"] == 2
+        rr._snapshots_invalidate()
+        clock["t"] = 0.01                 # well inside the TTL
+        rr.load_snapshot()
+        rr.holds_prefix(b"\x03" * 16)
+        assert calls["n"] == 4
+
+    def test_install_prefix_invalidates(self):
+        rr, clock, calls = self._replica()
+        rr.load_snapshot()
+        assert calls["n"] == 1
+        rr._post_json = lambda path, body: {"ok": True}
+        assert rr.install_prefix({"schema": MIGRATE_SCHEMA})
+        rr.load_snapshot()                # same instant, yet re-probed
+        assert calls["n"] == 2
+
+    def test_zero_ttl_disables_caching(self):
+        rr, clock, calls = self._replica(ttl=0.0)
+        rr.load_snapshot()
+        rr.load_snapshot()
+        assert calls["n"] == 2
