@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Fleet smoke: the fleet serving benchmark on CPU. Seven asserted cases:
+# Fleet smoke: the fleet serving benchmark on CPU. Eight asserted cases:
 # 2-replica FleetRouter >= 1.6x a 1-replica router over
 # simulated-compute replicas (real scheduler/admission/stream stack,
 # sleep-for-device — one XLA CPU engine already saturates every host
@@ -15,7 +15,14 @@
 # running request live-migrates its KV blocks + cursor mid-decode and
 # finishes bit-identical, and a skewed 3-replica simulated fleet's
 # rebalance passes keep the post-rebalance occupancy spread under the
-# unbalanced control's with zero lost/duplicated tokens; an injected mid-stream
+# unbalanced control's with zero lost/duplicated tokens; the fleet
+# observability plane (--fleetobs) — a 3-pod mixed local+remote
+# hierarchy behind RootRouter.serve_metrics live-serves a merged
+# /fleet/metrics (every replica up with pod=/replica= labels, one
+# TYPE header per family, all pod rollup families), a killed remote
+# replica flips to up 0 within one TTL, and a forced cross-pod
+# failover's journey export validates with the pod hop connected on
+# the pod lane; an injected mid-stream
 # replica crash loses NOTHING (the wedged request replays its prompt +
 # emitted prefix on the survivor, bit-identical) while producing a
 # fully-connected journey trace (one trace id per request incl.

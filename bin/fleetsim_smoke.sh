@@ -11,15 +11,28 @@
 # skewed replica must survive), at least one cross-pod failover, and a
 # byte-for-byte reproducible event log under the same seed (sha256
 # compared across two full runs; a third run on a different seed must
-# diverge). Writes BENCH_fleetsim.json at the repo root and exits
-# nonzero on any bound/determinism failure. Host-side only — the
-# simulator never imports JAX — and runs in seconds, fast enough for
-# tier-1.
+# diverge). The chaos leg also exports its sim-time Chrome trace
+# (--trace-out): one lane per sim replica on the virtual clock, chaos
+# instants, watchdog-kill and migration flow arrows — re-validated
+# here with `bin/tputrace validate`, so the observability contract on
+# the simulated fleet is gated alongside the behavioural one. Writes
+# BENCH_fleetsim.json at the repo root and exits nonzero on any
+# bound/determinism/trace failure. Host-side only — the simulator
+# never imports JAX — and runs in seconds, fast enough for tier-1.
 #
 # Usage: bin/fleetsim_smoke.sh        (from the repo root, or anywhere)
 
 cd "$(dirname "$0")/.." || exit 1
 
-exec timeout -k 10 300 env JAX_PLATFORMS=cpu \
+SIM_TRACE=$(mktemp /tmp/fleetsim_trace.XXXXXX.json) || exit 1
+trap 'rm -f "$SIM_TRACE"' EXIT
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
     python -m deepspeed_tpu.benchmarks.fleetsim_bench \
-    --json-out BENCH_fleetsim.json
+    --json-out BENCH_fleetsim.json \
+    --trace-out "$SIM_TRACE" || exit $?
+
+# independent re-validation of the exported sim-time timeline (the
+# bench already gates it internally; this proves the on-disk artifact
+# passes the same tool a human would run)
+python bin/tputrace validate "$SIM_TRACE" || exit $?

@@ -19,7 +19,15 @@
 #      connected journey under one trace id, rerouted requests carry
 #      the reroute link), the crash postmortem's in-flight set must
 #      exactly match the handles reported error/rerouted, and the SLO
-#      burn-rate gauges must move during the crash window and recover.
+#      burn-rate gauges must move during the crash window and recover;
+#   5. fleet observability plane — the same fleet_bench run stands up a
+#      3-pod mixed local+remote hierarchy behind
+#      RootRouter.serve_metrics and live-GETs /fleet/metrics +
+#      /fleet/pods: every replica up with pod=/replica= labels, one
+#      TYPE header per family, every dstpu_fleet_pod_* rollup family,
+#      the killed remote replica flipped to up 0 within one TTL, and
+#      the forced cross-pod failover journey validating with its pod
+#      hop connected on the pod lane (pid 5).
 #
 # Usage: bin/obs_smoke.sh    (from the repo root, or anywhere)
 
@@ -151,6 +159,29 @@ print("obs_smoke: fleet crash observability ok "
       f"({j['n_traces']} journeys, {c['rerouted']} rerouted, "
       f"burn {s['burn_pre']} -> {s['burn_crash']} -> "
       f"{s['burn_recovered']})")
+EOF
+    [ $? -ne 0 ] && fail=1
+    # ---- 5. fleet observability plane (same run's fleetobs block) ------
+    python - <<'EOF'
+import json
+d = json.load(open("/tmp/obs_smoke_fleet.json"))
+fo = d["fleetobs"]
+assert fo["n_replicas"] == 6 and fo["n_up_initial"] == 6, fo
+# killing the remote replica flipped exactly its up series to 0
+# within one TTL — the dark replica renders, it never vanishes
+assert fo["n_up_after_kill"] == 5, fo
+assert fo["dark_replica_up_zero"] == 1.0, fo
+assert fo["type_headers_unique"] == 1.0, fo
+assert fo["pod_families_present"] == 1.0, fo
+assert fo["parity"] == 1.0, fo
+# forced cross-pod failover: connected journeys incl. the pod hop
+assert fo["journey_validate_ok"] == 1.0, fo
+assert fo["pod_failover"] >= 1 and fo["pod_lane_events"] >= 1, fo
+print("obs_smoke: fleet observability plane ok "
+      f"({fo['n_up_initial']} -> {fo['n_up_after_kill']} up after "
+      f"kill, scrape {fo['scrape_s']}s, "
+      f"{fo['pod_failover']} pod failovers, "
+      f"{fo['pod_lane_events']} pod-lane events)")
 EOF
     [ $? -ne 0 ] && fail=1
 else

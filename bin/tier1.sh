@@ -29,6 +29,16 @@ for bench in BENCH_serving.json BENCH_frontend.json BENCH_fleet.json \
         --fail-on-missing --quiet || exit $?; }
 done
 
+# benchtrend append (stdlib-only, non-fatal): record this round's
+# baselines into the append-only history keyed by git sha, so
+# `bin/benchtrend report` can flag slow drift that stays inside
+# benchdiff's per-pair bands. Identical doc + sha dedupes to a no-op.
+for bench in BENCH_serving.json BENCH_frontend.json BENCH_fleet.json \
+             BENCH_kernels.json BENCH_fleetsim.json; do
+    [ -f "$bench" ] && python bin/benchtrend append "$bench" \
+        > /dev/null 2>&1
+done
+
 set -o pipefail
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log

@@ -1,8 +1,8 @@
 """Fleet serving benchmark: replica routing, tp=2, disaggregation,
-cross-host transport + live migration, crash observability, and
-elastic recovery.
+cross-host transport + live migration, the fleet observability plane,
+crash observability, and elastic recovery.
 
-Seven cases over one tiny model (CPU-runnable, smoke-sized):
+Eight cases over one tiny model (CPU-runnable, smoke-sized):
 
   * router scaling — a 2-replica :class:`FleetRouter` against a
     1-replica router on SIMULATED-compute replicas: engines that honor
@@ -48,6 +48,14 @@ Seven cases over one tiny model (CPU-runnable, smoke-sized):
     spread must stay below the unbalanced control run's, again with
     zero lost/duplicated tokens, and the merged journey export must
     validate with its migration hops connected.
+
+  * fleet observability plane — a 3-pod mixed local+remote hierarchy
+    behind ``RootRouter.serve_metrics``: the merged ``/fleet/metrics``
+    exposition shows every replica up with ``pod=``/``replica=``
+    labels and one TYPE header per family, killing a remote replica
+    flips exactly its ``up`` series to 0 within one TTL, and a forced
+    cross-pod failover's merged journey export validates with the pod
+    hop connected on the pod lane (pid 5).
 
   * crash observability — an injected mid-decode-chunk replica crash
     over a 2-replica fleet: ZERO requests resolve error (the wedged
@@ -293,6 +301,7 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
               sim_requests: int = 16,
               sim_chunk_time_s: float = 0.005,
               slo: bool = True, transport: bool = True,
+              fleetobs: bool = True,
               trace_out: Optional[str] = None) -> dict:
     import jax.numpy as jnp
     import deepspeed_tpu as ds
@@ -481,6 +490,10 @@ def run_bench(n_requests: int = 8, max_new_tokens: int = 32,
     if transport:
         result.update(_transport_case(
             inf, eng_kw, prompts, paged_out, max_new_tokens))
+
+    # ---- fleet observability plane (--fleetobs) ------------------------
+    if fleetobs:
+        result.update(_fleetobs_case())
 
     # ---- crash journeys + SLO burn + flight recorder -------------------
     # LAST on purpose: these cases inject mid-stream replica crashes,
@@ -1168,6 +1181,191 @@ def _transport_case(inf, eng_kw, prompts, paged_out,
     }}
 
 
+def _fleetobs_case(*, n_requests: int = 12, prompt_len: int = 8,
+                   max_new: int = 16, ttl_s: float = 0.75,
+                   seed: int = 3) -> dict:
+    """Fleet observability plane, two legs:
+
+    * LIVE — a 3-pod mixed local+remote hierarchy (two pods of
+      in-process simulated replicas, one pod of loopback-HTTP
+      :class:`RemoteReplica` clients) behind
+      ``RootRouter.serve_metrics``: after a routed batch, one GET of
+      ``/fleet/metrics`` must show every replica ``up 1`` with
+      ``pod=``/``replica=`` labels, exactly one ``# TYPE`` header per
+      family, and every ``dstpu_fleet_pod_*`` rollup family; killing
+      the remote pod's second replica (its :class:`ReplicaServer`
+      closes under it) must flip EXACTLY that series to ``up 0``
+      within one TTL — the dark replica renders, it never vanishes;
+    * JOURNEY — a deterministic sim-world fleet loses a whole pod
+      mid-stream (the test_hierarchy failover scenario): zero lost
+      streams, and the merged hierarchy Perfetto export must pass
+      ``validate_journeys`` with the cross-pod hop CONNECTED on the
+      pod lane (pid 5) — the regression gate for the trace-context
+      drop this PR fixed in the failover/re-submit paths.
+    """
+    import urllib.request
+
+    from ..serving.fleet import (RemoteReplica, ReplicaServer,
+                                 RootConfig, RootRouter,
+                                 SimReplicaConfig, SimWorld,
+                                 build_sim_fleet, sim_expected)
+    from ..serving.frontend.frontend import ServingFrontend
+    from ..telemetry.fleetobs import POD_FAMILIES
+    from ..telemetry.journey import validate_journeys
+
+    def _get(url: str) -> str:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    def _up_lines(text: str) -> dict:
+        out = {}
+        for ln in text.splitlines():
+            if ln.startswith("dstpu_fleet_replica_up{"):
+                out[ln.rsplit(" ", 1)[0]] = float(ln.rsplit(" ", 1)[1])
+        return out
+
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 512, (prompt_len,)).astype(np.int32)
+               for _ in range(n_requests)]
+
+    # ---- leg 1: live mixed local+remote plane --------------------------
+    root = RootRouter(config=RootConfig())
+    rem_engines = [SimulatedEngine(max_batch=4, decode_chunk=4,
+                                   chunk_time_s=0.002) for _ in range(2)]
+    fronts = [ServingFrontend(eng, telemetry_label=f"obs{i}")
+              for i, eng in enumerate(rem_engines)]
+    servers = [ReplicaServer(fe) for fe in fronts]
+    try:
+        for pod in ("p0", "p1"):
+            root.add_pod(pod, engines=[
+                SimulatedEngine(max_batch=4, decode_chunk=4,
+                                chunk_time_s=0.002) for _ in range(2)])
+        root.add_pod("p2", remotes=[
+            RemoteReplica("127.0.0.1", srv.port, label=f"obs{i}")
+            for i, srv in enumerate(servers)])
+        handles = [root.submit(p, max_new_tokens=max_new)
+                   for p in prompts]
+        statuses = [h.result(timeout=120) for h in handles]
+        if any(s != "done" for s in statuses):
+            raise RuntimeError(
+                f"fleetobs routed batch failed: {statuses}")
+        parity = all([int(t) for t in h.tokens]
+                     == _sim_expected(p, max_new)
+                     for h, p in zip(handles, prompts))
+        if not parity:
+            raise RuntimeError(
+                "fleetobs routed streams diverged from the simulated "
+                "oracle")
+
+        srv = root.serve_metrics(ttl_s=ttl_s)
+        t0 = time.perf_counter()
+        text = _get(srv.url + "/fleet/metrics")
+        scrape_s = time.perf_counter() - t0
+        pods_doc = json.loads(_get(srv.url + "/fleet/pods"))
+        ups = _up_lines(text)
+        n_up_initial = sum(1 for v in ups.values() if v == 1.0)
+        if len(ups) != 6 or n_up_initial != 6:
+            raise RuntimeError(
+                f"expected 6/6 replicas up at steady state, saw "
+                f"{n_up_initial}/{len(ups)}")
+        type_names = [ln.split()[2] for ln in text.splitlines()
+                      if ln.startswith("# TYPE ")]
+        types_unique = len(type_names) == len(set(type_names))
+        if not types_unique:
+            dupes = sorted({n for n in type_names
+                            if type_names.count(n) > 1})
+            raise RuntimeError(
+                f"duplicate TYPE headers in the merged exposition: "
+                f"{dupes}")
+        fams_present = all(f"dstpu_{fam}" in text
+                           for fam in POD_FAMILIES)
+        if not fams_present:
+            missing = [f for f in POD_FAMILIES
+                       if f"dstpu_{f}" not in text]
+            raise RuntimeError(
+                f"pod rollup families missing from the exposition: "
+                f"{missing}")
+        if pods_doc["n_pods"] != 3 or pods_doc["n_replicas"] != 6:
+            raise RuntimeError(
+                f"/fleet/pods topology off: {pods_doc['n_pods']} pods, "
+                f"{pods_doc['n_replicas']} replicas")
+
+        # kill the remote pod's second replica: its server closes under
+        # it, the next refresh past the TTL must flip up -> 0
+        servers[1].close()
+        time.sleep(ttl_s + 0.5)
+        text2 = _get(srv.url + "/fleet/metrics")
+        ups2 = _up_lines(text2)
+        n_up_after = sum(1 for v in ups2.values() if v == 1.0)
+        dark = [k for k, v in ups2.items() if v == 0.0]
+        dark_ok = (len(ups2) == 6 and len(dark) == 1
+                   and 'pod="p2"' in dark[0])
+        if n_up_after != 5 or not dark_ok:
+            raise RuntimeError(
+                f"killed replica did not flip to up 0 within one TTL: "
+                f"up={n_up_after}/6 dark={dark}")
+    finally:
+        root.close(timeout=30)
+        for s in servers:
+            s.close()
+        for fe in fronts:
+            fe.close(timeout=10)
+
+    # ---- leg 2: cross-pod failover journey validates -------------------
+    world = SimWorld(seed=seed)
+    sim_root = RootRouter(config=RootConfig(), clock=world.clock)
+    build_sim_fleet(world, sim_root, n_pods=3, pod_size=2,
+                    config=SimReplicaConfig(decode_tokens_per_s=8.0))
+    try:
+        sim_handles = [sim_root.submit([3, i + 1], max_new_tokens=16)
+                       for i in range(12)]
+        world.clock.run_for(0.5)             # mid-stream everywhere
+        victim = sim_root._placements[-1]["pod"]
+        sim_root.mark_pod_lost(victim)
+        for rep in list(sim_root.pods[victim].replicas):
+            rep.frontend.fail(RuntimeError("rack power"))
+        world.clock.run_for(60.0)
+        for i, h in enumerate(sim_handles):
+            if h.status != "done" \
+                    or h.tokens != sim_expected([3, i + 1], 16):
+                raise RuntimeError(
+                    f"failover lost or corrupted stream {i}: "
+                    f"{h.status}")
+        n_failover = sim_root.stats()["pod_failover"]
+        if n_failover < 1:
+            raise RuntimeError("pod loss triggered no cross-pod "
+                               "failover")
+        trace_obj = sim_root.export_chrome(None)
+        problems = validate_journeys(trace_obj)
+        if problems:
+            raise RuntimeError(
+                "failover journey validation failed: "
+                + "; ".join(problems[:5]))
+        n_pod_events = sum(
+            1 for e in trace_obj["traceEvents"] if e.get("pid") == 5
+            and e.get("ph") in ("X", "i", "s", "f"))
+        if n_pod_events < 1:
+            raise RuntimeError("hierarchy trace has no pod-lane events")
+    finally:
+        sim_root.close()
+
+    return {"fleetobs": {
+        "n_pods": 3,
+        "n_replicas": 6,
+        "n_up_initial": n_up_initial,
+        "n_up_after_kill": n_up_after,
+        "dark_replica_up_zero": float(dark_ok),
+        "type_headers_unique": float(types_unique),
+        "pod_families_present": float(fams_present),
+        "parity": float(parity),
+        "scrape_s": scrape_s,
+        "ttl_s": ttl_s,
+        "journey_validate_ok": 1.0,
+        "pod_failover": n_failover,
+        "pod_lane_events": n_pod_events,
+    }}
+
+
 def _ensure_virtual_devices(n: int = 8) -> None:
     """The tp=2 case needs a multi-device mesh; on CPU that is the XLA
     host-platform device-count flag, which must be set before jax
@@ -1203,6 +1401,11 @@ def main(argv=None):
                     default=True,
                     help="run the cross-host transport + live-migration "
                          "case (--no-transport skips it)")
+    ap.add_argument("--fleetobs", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run the fleet observability plane case: live "
+                         "mixed local+remote /fleet/metrics + failover "
+                         "journey validation (--no-fleetobs skips it)")
     ap.add_argument("--trace-out", type=str, default=None,
                     help="write the merged fleet journey Perfetto trace "
                          "(validated either way)")
@@ -1218,6 +1421,7 @@ def main(argv=None):
                        sim_requests=args.sim_requests,
                        sim_chunk_time_s=args.sim_chunk_time_ms / 1e3,
                        slo=args.slo, transport=args.transport,
+                       fleetobs=args.fleetobs,
                        trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:

@@ -55,9 +55,11 @@ from ..serving.fleet.hierarchy import RootConfig, RootRouter
 from ..serving.fleet.router import FleetRouter
 from ..serving.fleet.sim import (ChaosInjector, FleetWatchdog,
                                  SimReplica, SimReplicaConfig, SimWorld,
-                                 build_sim_fleet, hot_prefix_storm,
-                                 log_results, multi_turn_trace,
-                                 run_trace, verify_streams)
+                                 build_sim_fleet, export_sim_trace,
+                                 hot_prefix_storm, log_results,
+                                 multi_turn_trace, run_trace,
+                                 verify_streams)
+from ..telemetry.cli import validate_trace
 
 #: placement-latency gate: p99 at 1000 replicas over p99 at 10.
 PLACEMENT_P99_RATIO_BOUND = 2.0
@@ -221,7 +223,8 @@ def _prefix_case(*, n_pods: int = 200, pod_size: int = 5,
 # case 3: chaos determinism (zero loss, byte-identical replay)
 # --------------------------------------------------------------------------
 def _chaos_leg(seed: int, *, n_pods: int = 4, pod_size: int = 4,
-               duration_s: float = 30.0, rps: float = 12.0) -> dict:
+               duration_s: float = 30.0, rps: float = 12.0,
+               trace_out: str = None) -> dict:
     """One full chaos run: hot-prefix storm + multi-turn sessions over
     a watched fleet, losing a pod mid-stream, a zombie, one partition
     that heals (buffered tokens flush) and one that does not (the
@@ -255,7 +258,7 @@ def _chaos_leg(seed: int, *, n_pods: int = 4, pod_size: int = 4,
         stats = root.stats()
     finally:
         root.close()
-    return {
+    leg = {
         "audit": audit,
         "digest": world.digest(),
         "n_log_lines": len(world.event_log()),
@@ -264,10 +267,34 @@ def _chaos_leg(seed: int, *, n_pods: int = 4, pod_size: int = 4,
         "pod_failover": stats["pod_failover"],
         "n_replicas": n_pods * pod_size,
     }
+    if trace_out is not None:
+        # sim-time timeline: the chaos run on virtual clocks, one lane
+        # per sim replica, gated Perfetto-loadable right here
+        trace = export_sim_trace(world, trace_out)
+        problems = validate_trace(trace)
+        if problems:
+            raise RuntimeError(
+                f"sim trace failed shape validation: {problems[:5]}")
+        evs = trace["traceEvents"]
+        leg["trace"] = {
+            "n_events": len(evs),
+            "n_lanes": len({e.get("tid") for e in evs
+                            if e.get("ph") == "M"
+                            and e.get("name") == "thread_name"}),
+            "n_kill_arrows": sum(1 for e in evs
+                                 if e.get("cat") == "watchdog"
+                                 and e.get("ph") == "s"),
+            "n_chaos_instants": sum(1 for e in evs
+                                    if e.get("ph") == "i"
+                                    and e.get("s") == "g"),
+            "valid": 1.0,
+        }
+    return leg
 
 
-def _chaos_case(*, seed: int = 0) -> Dict[str, dict]:
-    a = _chaos_leg(seed)
+def _chaos_case(*, seed: int = 0,
+                trace_out: str = None) -> Dict[str, dict]:
+    a = _chaos_leg(seed, trace_out=trace_out)
     b = _chaos_leg(seed)          # same seed: byte-for-byte identical
     c = _chaos_leg(seed + 1)      # different seed: must diverge
     audit = a["audit"]
@@ -305,12 +332,15 @@ def _chaos_case(*, seed: int = 0) -> Dict[str, dict]:
         raise RuntimeError(
             "different seeds produced identical event logs — the log "
             "is not actually recording the run")
+    if "trace" in a:
+        out["trace"] = a["trace"]
     return {"chaos": out}
 
 
 # --------------------------------------------------------------------------
 def run_bench(*, seed: int = 0, n_pods: int = 200, pod_size: int = 5,
-              n_timed: int = 400, repeats: int = 3) -> dict:
+              n_timed: int = 400, repeats: int = 3,
+              trace_out: str = None) -> dict:
     result: dict = {
         "bench": "fleetsim",
         "fleetsim_replicas": n_pods * pod_size,
@@ -321,7 +351,7 @@ def run_bench(*, seed: int = 0, n_pods: int = 200, pod_size: int = 5,
         repeats=repeats, seed=seed))
     result.update(_prefix_case(n_pods=n_pods, pod_size=pod_size,
                                seed=seed))
-    result.update(_chaos_case(seed=seed))
+    result.update(_chaos_case(seed=seed, trace_out=trace_out))
     return _round_tree(result)
 
 
@@ -337,11 +367,14 @@ def main(argv=None):
                     help="latency repeats (best p99 kept per size)")
     ap.add_argument("--json-out", type=str, default=None,
                     help="also write the result dict to this JSON file")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="write the chaos leg's sim-time Chrome trace "
+                         "(virtual clocks; tputrace-validated) here")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
     result = run_bench(seed=args.seed, n_pods=args.n_pods,
                        pod_size=args.pod_size, n_timed=args.n_timed,
-                       repeats=args.repeats)
+                       repeats=args.repeats, trace_out=args.trace_out)
     print(json.dumps(result, indent=2))
     if args.json_out:
         with open(args.json_out, "w") as f:

@@ -31,9 +31,10 @@ from .transport import (FLEET_SCHEMA, ReplicaServer,  # noqa: F401
 from .remote import RemoteReplica  # noqa: F401
 from .sim import (ChaosInjector, FleetWatchdog, SimClock,  # noqa: F401
                   SimReplica, SimReplicaConfig, SimWorld,
-                  build_sim_fleet, diurnal_trace, hot_prefix_storm,
-                  multi_turn_trace, run_trace, sim_expected,
-                  tenant_skew_trace, verify_streams)
+                  build_sim_fleet, diurnal_trace, export_sim_trace,
+                  hot_prefix_storm, multi_turn_trace, run_trace,
+                  sim_expected, sim_trace_events, tenant_skew_trace,
+                  verify_streams)
 
 __all__ = ["FleetRouter", "FleetReplica",
            "ElasticController", "ElasticConfig",
@@ -45,5 +46,6 @@ __all__ = ["FleetRouter", "FleetReplica",
            "SimClock", "SimWorld", "SimReplica", "SimReplicaConfig",
            "FleetWatchdog", "ChaosInjector", "build_sim_fleet",
            "run_trace", "verify_streams", "sim_expected",
+           "sim_trace_events", "export_sim_trace",
            "diurnal_trace", "tenant_skew_trace", "hot_prefix_storm",
            "multi_turn_trace"]

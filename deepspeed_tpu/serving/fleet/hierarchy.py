@@ -55,6 +55,7 @@ import numpy as np
 
 from ...analysis import locks
 from ...telemetry import core as telemetry
+from ...telemetry.journey import new_trace_id
 from ...utils.logging import logger
 from ..engine import MigrationError
 from ..frontend.admission import PRIORITY_NORMAL
@@ -314,6 +315,10 @@ class RootRouter:
         self._placements: deque = deque(maxlen=4096)
         self._reroutes: deque = deque(maxlen=1024)
         self._migrations: deque = deque(maxlen=1024)
+        # fleet observability plane (serve_metrics()): the root owns
+        # the aggregator + its MetricsServer so close() tears them down
+        self._fleet_agg = None
+        self._metrics_server = None
 
     # ------------------------------------------------------ pod lifecycle
     def add_pod(self, pod_id: str, *, engines: Sequence[Any] = (),
@@ -467,21 +472,29 @@ class RootRouter:
         return False
 
     def _pod_order(self, prompt, tenant: str,
-                   adapter: Optional[str]) -> List[str]:
+                   adapter: Optional[str]
+                   ) -> Tuple[List[str], str, str]:
         """Candidate pods in preference order: adapter pin, tenant pin,
         then ring order from the prompt's prefix key (primary + spill
-        successors)."""
+        successors). Returns ``(order, ring_key_hex, pin_source)`` —
+        the placement provenance the journey journal records
+        (``pin_source`` is ``"adapter"``/``"tenant"``/``"ring"``)."""
         order: List[str] = []
+        pin_source = "ring"
         pin = self._adapter_pins.get(adapter) if adapter else None
-        if pin is None:
+        if pin is not None:
+            pin_source = "adapter"
+        else:
             pin = self._tenant_pins.get(tenant)
+            if pin is not None:
+                pin_source = "tenant"
         if pin is not None:
             order.append(pin)
         key = PrefixCache.key_for(prompt)
         for pod_id in self._ring.pods_for(key, 1 + self.config.spill):
             if pod_id not in order:
                 order.append(pod_id)
-        return order
+        return order, key.hex()[:16], pin_source
 
     def submit(self, prompt: Union[Sequence[int], np.ndarray], *,
                priority: int = PRIORITY_NORMAL,
@@ -495,22 +508,25 @@ class RootRouter:
         every candidate pod overloaded (or no pod at all) the handle
         resolves ``rejected`` (``pod_overloaded``) at the edge."""
         t0 = self._clock()
-        order = self._pod_order(prompt, tenant, adapter)
+        order, ring_key, pin_source = self._pod_order(prompt, tenant,
+                                                      adapter)
         chosen: Optional[LeafRouter] = None
         spilled = False
+        spill_index = 0
         for i, pod_id in enumerate(order):
             leaf = self._placeable(pod_id)
             if leaf is None:
                 continue
             if self._overloaded(leaf):
                 continue
-            chosen, spilled = leaf, i > 0
+            chosen, spilled, spill_index = leaf, i > 0, i
             break
         if chosen is None:
             return self._shed(prompt, tenant=tenant, priority=priority,
                               slo_ttft_s=slo_ttft_s,
                               max_new_tokens=max_new_tokens, t0=t0,
-                              tried=order)
+                              tried=order, ring_key=ring_key,
+                              pin_source=pin_source)
         handle = chosen.submit(
             prompt, priority=priority, tenant=tenant,
             slo_ttft_s=slo_ttft_s, deadline_s=deadline_s,
@@ -526,28 +542,36 @@ class RootRouter:
             self._placements.append({
                 "trace_id": handle.trace_id, "uid": handle.uid,
                 "t": t0, "dur_s": t1 - t0, "pod": chosen.pod_id,
-                "spilled": spilled})
+                "spilled": spilled, "ring_key": ring_key,
+                "pin": pin_source, "tried": order[:spill_index]})
         return handle
 
     def _shed(self, prompt, *, tenant: str, priority: int,
               slo_ttft_s: Optional[float], max_new_tokens: int,
-              t0: float, tried: List[str]) -> StreamHandle:
+              t0: float, tried: List[str], ring_key: str = "",
+              pin_source: str = "ring") -> StreamHandle:
+        # shed placements mint a real trace id (the caller's handle and
+        # the journey journal must agree on one — a None id would drop
+        # the edge rejection out of the journey path entirely)
+        trace_id = new_trace_id()
         req = Request(prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=None, deadline_s=None,
-                      trace_id=None, tenant=tenant)
+                      trace_id=trace_id, tenant=tenant)
         handle = StreamHandle(req, self, tenant=tenant,
                               priority=priority, slo_ttft_s=slo_ttft_s,
-                              submit_t=t0, trace_id=None)
+                              submit_t=t0, trace_id=trace_id)
         handle._resolve("rejected",
                         reject_reason=REJECT_POD_OVERLOADED)
         telemetry.count("fleet/pod_shed")
         with self._lock:
             self.n_shed += 1
             self._placements.append({
-                "trace_id": None, "uid": handle.uid, "t": t0,
+                "trace_id": trace_id, "uid": handle.uid, "t": t0,
                 "dur_s": self._clock() - t0, "pod": None,
-                "shed": True, "tried": list(tried)})
+                "shed": True, "shed_reason": REJECT_POD_OVERLOADED,
+                "tried": list(tried), "ring_key": ring_key,
+                "pin": pin_source})
         return handle
 
     def cancel(self, handle: StreamHandle) -> None:
@@ -662,7 +686,8 @@ class RootRouter:
                     error=f"cross-pod migration failed both ways "
                           f"(dst: {e}; src restore: {e2})")
             self._record_cross_failure(uid, src_pod, dst_pod,
-                                       f"import: {e}")
+                                       f"import: {e}",
+                                       trace_id=handle.trace_id)
             return False
         kv_bytes = int(bundle.get("kv_bytes", 0))
         telemetry.count("fleet/cross_pod_migrated")
@@ -689,12 +714,18 @@ class RootRouter:
         return True
 
     def _record_cross_failure(self, uid: int, src_pod: str,
-                              dst_pod: str, why: str) -> None:
+                              dst_pod: str, why: str, *,
+                              trace_id: Optional[str] = None) -> None:
+        """``trace_id`` propagates from the in-flight handle whenever
+        the failure happens after export (the handle exists and carries
+        the request's id); pre-export failures have no handle, so the
+        record keeps a None id rather than minting a fake one."""
         telemetry.count("fleet/cross_pod_migrate_failed")
         with self._lock:
             self.n_cross_migrate_failed += 1
             self._migrations.append({
-                "trace_id": None, "uid": int(uid), "t": self._clock(),
+                "trace_id": trace_id, "uid": int(uid),
+                "t": self._clock(),
                 "from_pod": src_pod, "to_pod": dst_pod, "failed": why})
         logger.warning(f"fleet cross-pod migration uid={uid} "
                        f"{src_pod}->{dst_pod} failed: {why}")
@@ -778,6 +809,63 @@ class RootRouter:
                           for pod_id, leaf in self.pods.items()}
         return out
 
+    # ---------------------------------------------------- observability
+    def serve_metrics(self, *, host: str = "127.0.0.1", port: int = 0,
+                      ttl_s: float = 2.0, namespace: str = "dstpu",
+                      slo: bool = True,
+                      slo_windows_s: Sequence[float] = (5.0, 60.0)):
+        """Stand up the fleet observability plane: a
+        :class:`~deepspeed_tpu.telemetry.fleetobs
+        .FleetMetricsAggregator` over every pod (local frontends render
+        directly, remotes scrape over ``GET /v1/metrics``) behind a
+        :class:`~deepspeed_tpu.telemetry.exposition.MetricsServer`
+        serving ``/fleet/metrics`` + ``/fleet/pods`` (and the root
+        process's own ``/metrics`` / ``/readyz``). With ``slo``, each
+        pod gets one :class:`~deepspeed_tpu.telemetry.slo.SLOEngine`
+        attached to its local replicas' TraceLogs; per-pod burn feeds
+        ``fleet/pod_burn_rate|pod=<p>`` gauges and the pod-level
+        anomaly detector, whose tripped state degrades the root's
+        ``/readyz``. Returns the server; the root owns it (``close()``
+        stops it). Idempotent — a second call returns the first
+        server."""
+        if self._metrics_server is not None:
+            return self._metrics_server
+        from ...telemetry import core as _tcore
+        from ...telemetry.exposition import MetricsServer
+        from ...telemetry.fleetobs import FleetMetricsAggregator
+        from ..frontend.health import HealthMonitor
+        agg = FleetMetricsAggregator(self, ttl_s=ttl_s,
+                                     namespace=namespace,
+                                     clock=self._clock)
+        if slo:
+            from ...telemetry.slo import SLOEngine
+            for pod_id, leaf in self.pods.items():
+                engine = SLOEngine(windows_s=slo_windows_s,
+                                   clock=self._clock)
+                attached = 0
+                for rep in leaf.replicas:
+                    tracing = getattr(rep.frontend, "tracing", None)
+                    if tracing is not None \
+                            and hasattr(tracing, "add_listener"):
+                        engine.attach(tracing)
+                        attached += 1
+                if attached:
+                    agg.attach_slo(pod_id, engine)
+        health = HealthMonitor(
+            anomaly=agg.anomaly,
+            checks={"pods_placeable": lambda: self.n_pods > 0})
+        self._fleet_agg = agg
+        self._metrics_server = MetricsServer(
+            runtime=_tcore.get_runtime(), health=health, fleet=agg,
+            host=host, port=port, namespace=namespace)
+        logger.info("fleet observability plane serving on "
+                    f"{self._metrics_server.url}/fleet/metrics")
+        return self._metrics_server
+
+    @property
+    def fleet_aggregator(self):
+        return self._fleet_agg
+
     def journey_journal(self) -> Dict[str, Any]:
         """Flat-router-shaped journal with pod-qualified replica ids
         (``<pod>/<rid>``): root placements/failovers/migrations merge
@@ -808,6 +896,22 @@ class RootRouter:
                             rec[k] = f"{pod_id}/{rec[k]}"
                     journal[name].append(rec)
             for rid, trace in sub["replicas"].items():
+                # the records INSIDE a leaf's TraceLog reference other
+                # replicas by flat rid (within-pod crash salvage sets
+                # rerouted_from="0") — qualify those too, or the
+                # journey validator cannot follow the reroute chain
+                # across the pod boundary
+                trace = dict(trace)
+                for key in ("requests", "live"):
+                    recs = []
+                    for rec in trace.get(key, ()):
+                        rec = dict(rec)
+                        for k in ("rerouted_from", "migrated_from"):
+                            v = rec.get(k)
+                            if v is not None and "/" not in str(v):
+                                rec[k] = f"{pod_id}/{v}"
+                        recs.append(rec)
+                    trace[key] = recs
                 journal["replicas"][f"{pod_id}/{rid}"] = trace
         return journal
 
@@ -833,7 +937,37 @@ class RootRouter:
                 "n_tenants": len(merged), "tenants": merged,
                 "per_pod": per_pod}
 
+    def export_chrome(self, path: Optional[str] = None,
+                      runtime=None) -> Dict[str, Any]:
+        """One Perfetto file for the whole hierarchy: the shared
+        runtime (pid 1), every replica's per-request lanes (pid 2),
+        journey lanes (pid 3), and the pod lane (pid 5) — root
+        placement decisions (ring key, pin source, spill/shed) as pod
+        spans with cross-pod failover/migration flow arrows. Writes to
+        ``path`` when given; always returns the trace object."""
+        from ...telemetry import (chrome_trace, request_trace_events,
+                                  write_chrome_trace)
+        from ...telemetry import core as _tcore
+        from ...telemetry.journey import (journey_trace_events,
+                                          pod_lane_events)
+        rt = runtime if runtime is not None else _tcore.get_runtime()
+        journal = self.journey_journal()
+        extra: List[dict] = []
+        for rid in sorted(journal["replicas"]):
+            extra.extend(request_trace_events(journal["replicas"][rid]))
+        extra.extend(journey_trace_events(journal))
+        extra.extend(pod_lane_events(journal))
+        if path is None:
+            return chrome_trace(rt, extra_events=extra)
+        return write_chrome_trace(path, rt, extra_events=extra)
+
     def close(self, timeout: Optional[float] = None) -> None:
+        if self._metrics_server is not None:
+            try:
+                self._metrics_server.stop()
+            finally:
+                self._metrics_server = None
+                self._fleet_agg = None
         for ctrl in self.controllers.values():
             ctrl.stop()
         for leaf in self.pods.values():

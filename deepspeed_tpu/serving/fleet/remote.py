@@ -168,6 +168,27 @@ class RemoteReplica:
                          f"failed ({e}); using default")
             return default
 
+    def _get_text(self, path: str) -> str:
+        """Plain-text GET (the Prometheus proxy verb is text format
+        0.0.4, not JSON). Always raises on failure — the fleet
+        aggregator treats a failed scrape as a dark replica."""
+        conn = self._conn()
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise ConnectionError(f"GET {path} -> {resp.status}")
+            return data.decode("utf-8")
+        finally:
+            conn.close()
+
+    def fetch_metrics(self) -> str:
+        """The fleet aggregator's remote scrape: the server-side
+        process's full Prometheus exposition (runtime + TraceLog) via
+        ``GET /v1/metrics``."""
+        return self._get_text("/v1/metrics")
+
     def _post_json(self, path: str, body: Dict[str, Any]) -> Any:
         conn = self._conn()
         try:

@@ -141,6 +141,7 @@ class SimWorld:
         self.clock = SimClock()
         self.rng = random.Random(self.seed)
         self._events: List[str] = []
+        self._records: List[tuple] = []    # (t, kind, kv) — same feed
         self._sids: Dict[int, int] = {}
 
     def sid(self, handle: StreamHandle) -> int:
@@ -154,6 +155,15 @@ class SimWorld:
         parts = [f"t={self.clock.now():.6f}", kind]
         parts += [f"{k}={kv[k]}" for k in sorted(kv)]
         self._events.append(" ".join(parts))
+        # the same single funnel also feeds the structured record list
+        # behind sim_trace_events — the string log (and its digest)
+        # stays byte-identical
+        self._records.append((self.clock.now(), kind, dict(kv)))
+
+    def records(self) -> List[tuple]:
+        """The structured ``(t, kind, kv)`` mirror of the event log —
+        what :func:`sim_trace_events` renders on virtual clocks."""
+        return list(self._records)
 
     def event_log(self) -> str:
         return "\n".join(self._events) + ("\n" if self._events else "")
@@ -980,3 +990,113 @@ def log_results(world: SimWorld, results: Sequence[tuple]) -> None:
     for ev, handle in results:
         world.log("result", sid=world.sid(handle),
                   status=handle.status, n_tokens=len(handle.tokens))
+
+
+# --------------------------------------------------------------------
+# sim-time timeline export
+# --------------------------------------------------------------------
+
+#: pid of the sim timeline process in a Chrome trace — virtual clocks,
+#: one lane per sim replica (pid 3 = journeys, pid 5 = fleet pods)
+PID_SIM = 4
+
+#: record kinds that render as instants on the emitting replica's lane
+_SIM_INSTANTS = ("accept", "finish", "crash", "zombie", "partition",
+                 "heal", "slow", "skew", "adopt")
+
+
+def sim_trace_events(world: SimWorld, *,
+                     pid: int = PID_SIM) -> List[dict]:
+    """Render the world's structured event records as Chrome trace
+    events on VIRTUAL time (``ts`` = sim seconds * 1e6): one lane per
+    sim replica plus a world lane (tid 0) for chaos/watchdog/result
+    records. Chaos pod losses are global-scope instants; a watchdog
+    kill is a flow arrow from the world lane to the killed replica's
+    lane; a migration draws an arrow from ``migrate_out`` to the
+    matching ``migrate_in`` (paired by sid, in order). Deterministic —
+    a function of the event log only, so two same-seed runs export the
+    identical trace."""
+    records = world.records()
+    labels = sorted({str(kv["replica"]) for _, _, kv in records
+                     if "replica" in kv})
+    lane = {lbl: i for i, lbl in enumerate(labels, start=1)}
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": f"fleet sim (seed {world.seed})"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "world"}},
+    ]
+    for lbl in labels:
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": lane[lbl], "args": {"name": lbl}})
+
+    def us(t: float) -> float:
+        return float(t) * 1e6
+
+    out_pending: Dict[str, List[tuple]] = {}   # sid -> [(i, t, label)]
+    n_hops = 0
+    for i, (t, kind, kv) in enumerate(records):
+        lbl = str(kv.get("replica", ""))
+        tid = lane.get(lbl, 0)
+        args = {k: v for k, v in kv.items()}
+        if kind in _SIM_INSTANTS:
+            events.append({"name": kind, "ph": "i", "s": "t",
+                           "ts": us(t), "pid": pid, "tid": tid,
+                           "args": args})
+        elif kind == "chaos_pod_loss":
+            events.append({"name": f"pod loss {kv.get('pod')}",
+                           "ph": "i", "s": "g", "ts": us(t),
+                           "pid": pid, "tid": 0, "args": args})
+        elif kind == "watchdog_kill":
+            common = {"name": "watchdog_kill", "cat": "watchdog",
+                      "id": f"simkill:{i}", "pid": pid, "args": args}
+            events.append({**common, "ph": "s", "tid": 0, "ts": us(t)})
+            events.append({**common, "ph": "f", "bp": "e", "tid": tid,
+                           "ts": us(t) + 1.0})
+        elif kind == "migrate_out":
+            out_pending.setdefault(str(kv.get("sid")), []).append(
+                (i, t, lbl))
+            events.append({"name": kind, "ph": "i", "s": "t",
+                           "ts": us(t), "pid": pid, "tid": tid,
+                           "args": args})
+        elif kind == "migrate_in":
+            events.append({"name": kind, "ph": "i", "s": "t",
+                           "ts": us(t), "pid": pid, "tid": tid,
+                           "args": args})
+            pending = out_pending.get(str(kv.get("sid")))
+            if pending:
+                j, t0, src = pending.pop(0)
+                n_hops += 1
+                common = {"name": "sim_migrate", "cat": "sim_migrate",
+                          "id": f"simmigrate:{j}", "pid": pid,
+                          "args": {"sid": kv.get("sid"),
+                                   "from": src, "to": lbl}}
+                events.append({**common, "ph": "s",
+                               "tid": lane.get(src, 0), "ts": us(t0)})
+                events.append({**common, "ph": "f", "bp": "e",
+                               "tid": tid,
+                               "ts": max(us(t), us(t0) + 1.0)})
+        elif kind == "result":
+            events.append({"name": f"result:{kv.get('status')}",
+                           "ph": "i", "s": "t", "ts": us(t),
+                           "pid": pid, "tid": 0, "args": args})
+        else:
+            events.append({"name": kind, "ph": "i", "s": "t",
+                           "ts": us(t), "pid": pid, "tid": tid,
+                           "args": args})
+    return events
+
+
+def export_sim_trace(world: SimWorld,
+                     path: Optional[str] = None) -> Dict[str, Any]:
+    """One Perfetto file of the whole simulated fleet on virtual
+    clocks. Writes to ``path`` when given; always returns the trace
+    object (``bin/tputrace validate`` passes on it)."""
+    from ...telemetry.export import chrome_trace, write_chrome_trace
+    meta = {"source": "fleetsim", "seed": world.seed,
+            "digest": world.digest()}
+    evs = sim_trace_events(world)
+    if path is None:
+        return chrome_trace(None, extra_events=evs, metadata=meta)
+    return write_chrome_trace(path, None, extra_events=evs,
+                              metadata=meta)

@@ -31,6 +31,8 @@ line, flushed per frame, the connection close IS the end-of-stream):
 * ``GET  /v1/prefix?key=<hex>``  prefix-cache membership peek
 * ``GET  /v1/migratable``   movable uids (rebalancer input)
 * ``GET  /v1/stats`` · ``/v1/trace`` · ``/v1/tenants`` · ``/healthz``
+* ``GET  /v1/metrics``      Prometheus text proxy (runtime + TraceLog)
+                            — the fleet aggregator's remote scrape
 
 Stream frames (each a JSON line):
 
@@ -149,6 +151,15 @@ class _FleetHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _send_text(self, code: int, body: str,
+                   content_type: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _open_stream(self) -> None:
         self.send_response(200)
         self.send_header("Content-Type", NDJSON_TYPE)
@@ -253,6 +264,18 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 self._send_json(200, fe.tracing.to_json())
             elif url.path == "/v1/tenants":
                 self._send_json(200, fe.tracing.tenants_report())
+            elif url.path == "/v1/metrics":
+                # Prometheus proxy verb: the fleet plane's aggregator
+                # scrapes remote replicas through the SAME wire the
+                # router already speaks, so a replica needs no second
+                # listener. Renders this process's runtime + the
+                # frontend's TraceLog in text format 0.0.4.
+                from ...telemetry import core as _tcore
+                from ...telemetry.exposition import (CONTENT_TYPE,
+                                                     render_prometheus)
+                self._send_text(200, render_prometheus(
+                    runtime=_tcore.get_runtime(), tracelog=fe.tracing),
+                    CONTENT_TYPE)
             else:
                 self._send_json(404, {"error": "not found"})
         except (BrokenPipeError, ConnectionResetError):

@@ -24,6 +24,11 @@ This package is the one runtime they all feed:
   utilization reports (powers ``profiling/flops_profiler.py``).
 * :mod:`.cli` — ``bin/tputrace``: summarize/validate a captured trace
   (stdlib-only; never imports JAX).
+* :mod:`.fleetobs` — the fleet observability plane: one
+  :class:`FleetMetricsAggregator` scraping every pod's replicas (local
+  render, remote ``GET /v1/metrics``) into a single ``/fleet/metrics``
+  exposition with ``pod=``/``replica=`` labels, pod rollups, and
+  pod-level anomaly wiring (stdlib-only).
 
 Module-level helpers (``span`` / ``instant`` / ``count`` / ``gauge``)
 write to one process-wide default runtime so instrumentation sites never
@@ -48,9 +53,12 @@ from .exposition import (MetricsServer, parse_prometheus_text,  # noqa: F401
                          render_prometheus)
 from .regression import (MetricSpec, detect_kind,  # noqa: F401
                          diff_benchmarks)
-from .journey import (PID_JOURNEYS, assemble_journeys,  # noqa: F401
-                      journey_trace_events, new_trace_id,
+from .journey import (PID_JOURNEYS, PID_PODS,  # noqa: F401
+                      assemble_journeys, journey_trace_events,
+                      new_trace_id, pod_lane_events,
                       summarize_journeys, validate_journeys)
+from .fleetobs import (FleetMetricsAggregator,  # noqa: F401
+                       ScrapeTarget)
 from .slo import SLOEngine, SLOSpec, default_slos  # noqa: F401
 from .flight_recorder import (FlightRecorder, dump_all,  # noqa: F401
                               install_sigterm_handler)
@@ -69,8 +77,10 @@ __all__ = [
     "compiled_memory_analysis", "live_array_census", "format_bytes",
     "render_prometheus", "parse_prometheus_text", "MetricsServer",
     "MetricSpec", "diff_benchmarks", "detect_kind",
-    "PID_JOURNEYS", "new_trace_id", "assemble_journeys",
-    "journey_trace_events", "validate_journeys", "summarize_journeys",
+    "PID_JOURNEYS", "PID_PODS", "new_trace_id", "assemble_journeys",
+    "journey_trace_events", "pod_lane_events", "validate_journeys",
+    "summarize_journeys",
+    "FleetMetricsAggregator", "ScrapeTarget",
     "SLOSpec", "SLOEngine", "default_slos",
     "FlightRecorder", "install_sigterm_handler", "dump_all",
     "PID_DEVICE", "ChunkProfiler", "validate_report",

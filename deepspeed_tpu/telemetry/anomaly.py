@@ -134,13 +134,15 @@ class AnomalyDetector:
         Unknown metrics and ``None`` values are ignored."""
         if value is None:
             return self.tripped
-        spec = self.specs.get(metric)
-        if spec is None:
-            return self.tripped
         value = float(value)
         flipped = False
         trip_payload: Dict[str, Any] = {}
         with self._lock:
+            # specs can grow concurrently via ensure_spec — resolve
+            # under the same lock (self._tripped: lock already held)
+            spec = self.specs.get(metric)
+            if spec is None:
+                return self._tripped
             st = self._states[metric]
             self.n_observed += 1
             scored = st.n >= spec.min_samples
@@ -210,6 +212,19 @@ class AnomalyDetector:
             except Exception:
                 pass
         return tripped
+
+    def ensure_spec(self, spec: AnomalySpec) -> bool:
+        """Register one more watched metric after construction (no-op
+        when the metric is already watched — existing baselines are
+        never reset). The fleet plane uses this to grow per-pod specs
+        as pods join the hierarchy. Returns True when the spec was
+        newly added."""
+        with self._lock:
+            if spec.metric in self.specs:
+                return False
+            self.specs[spec.metric] = spec
+            self._states[spec.metric] = _MetricState()
+            return True
 
     def observe_trace(self, trace: Any) -> None:
         """TraceLog finish-listener: fold TPOT from each finished

@@ -375,7 +375,8 @@ def main(argv=None) -> int:
     p.add_argument("trace_id", nargs="?", default=None,
                    help="show one journey (prefix match) in full")
     p.add_argument("--validate", action="store_true",
-                   help="gate journey connectedness (exit 1 on problems)")
+                   help="gate journey connectedness, incl. pod-hop "
+                        "links on hierarchy traces (exit 1 on problems)")
     p.add_argument("--pid", type=int, default=PID_JOURNEYS)
     p.set_defaults(fn=cmd_journey)
     p = sub.add_parser("profile",

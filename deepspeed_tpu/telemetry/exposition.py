@@ -296,6 +296,21 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(report),
                                "application/json")
+            elif path == "/fleet/metrics":
+                body = ms.fleet_render()
+                if body is None:
+                    self._send(404, "no fleet aggregator wired\n",
+                               "text/plain")
+                else:
+                    self._send(200, body, CONTENT_TYPE)
+            elif path == "/fleet/pods":
+                report = ms.fleet_pods()
+                if report is None:
+                    self._send(404, "no fleet aggregator wired\n",
+                               "text/plain")
+                else:
+                    self._send(200, json.dumps(report),
+                               "application/json")
             else:
                 self._send(404, "not found\n", "text/plain")
         except BrokenPipeError:
@@ -324,13 +339,15 @@ class MetricsServer:
 
     def __init__(self, *, runtime=None, tracelog=None,
                  gauges_fn: Optional[Callable[[], Mapping[str, float]]] = None,
-                 health=None, slo=None, host: str = "127.0.0.1",
+                 health=None, slo=None, fleet=None,
+                 host: str = "127.0.0.1",
                  port: int = 0, namespace: str = "dstpu"):
         self.runtime = runtime
         self.tracelog = tracelog
         self.gauges_fn = gauges_fn
         self.health = health
         self.slo = slo
+        self.fleet = fleet
         self.namespace = namespace
         self._httpd = ReusableThreadingHTTPServer((host, port), _Handler)
         self._httpd.metrics_server = self        # type: ignore[attr-defined]
@@ -372,6 +389,22 @@ class MetricsServer:
                 or not hasattr(self.tracelog, "tenants_report"):
             return None
         return self.tracelog.tenants_report()
+
+    def fleet_render(self):
+        """The ``/fleet/metrics`` payload: the wired
+        :class:`~deepspeed_tpu.telemetry.fleetobs
+        .FleetMetricsAggregator`'s merged pod-labelled exposition; None
+        when no aggregator is wired."""
+        if self.fleet is None:
+            return None
+        return self.fleet.render()
+
+    def fleet_pods(self):
+        """The ``/fleet/pods`` payload (pod rollups + per-replica
+        up/age); None when no aggregator is wired."""
+        if self.fleet is None:
+            return None
+        return self.fleet.pods_report()
 
     def stop(self) -> None:
         self._httpd.shutdown()

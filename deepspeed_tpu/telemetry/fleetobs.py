@@ -1,0 +1,444 @@
+"""Fleet observability plane: one merged view over N pods of replicas.
+
+PR 19 made the fleet hierarchical (RootRouter -> LeafRouter pods ->
+replicas) but observability still stopped at the single-replica
+boundary: every ReplicaServer answers its own ``/metrics`` and the
+root has no aggregate. This module is the missing plane:
+
+* :class:`FleetMetricsAggregator` scrapes every known replica — local
+  in-process frontends render directly
+  (:func:`~.exposition.render_prometheus` over their ``TraceLog``),
+  remote replicas over the wire (``GET /v1/metrics`` on their
+  :class:`~deepspeed_tpu.serving.fleet.transport.ReplicaServer`) — on
+  a TTL, and merges everything into ONE Prometheus text exposition
+  with ``pod=``/``replica=`` labels. Merge discipline matches the
+  single-process renderer: one ``# TYPE`` header per family, all of a
+  family's samples contiguous, label values escaped.
+* A replica whose last successful scrape is older than the TTL (or
+  that is marked dead) does NOT vanish from the exposition — it
+  renders as ``dstpu_fleet_replica_up{pod=...,replica=...} 0`` so
+  dashboards and alerts see the hole, not a gap.
+* Pod-level rollups are computed from the hierarchy's own aggregate
+  snapshots (``LeafRouter.pod_snapshot``) + the scraped samples:
+  routable count, estimated drain seconds, a saturating occupancy
+  transform ``drain_s / (drain_s + 1s)``, prefix-affinity hit rate,
+  tiered-KV bytes, and per-pod SLO burn. They render both as
+  ``dstpu_fleet_pod_*{pod=...}`` gauges on ``/fleet/metrics`` and as
+  the ``/fleet/pods`` JSON document.
+* Per-pod SLO burn feeds ``fleet/pod_burn_rate|pod=<p>`` gauges
+  through the shared telemetry runtime and a pod-level
+  :class:`~.anomaly.AnomalyDetector` (one ``pod_burn_rate/<pod>`` +
+  ``pod_drain_s/<pod>`` spec per pod, registered lazily via
+  :meth:`~.anomaly.AnomalyDetector.ensure_spec`) whose tripped state a
+  :class:`~deepspeed_tpu.serving.frontend.health.HealthMonitor` folds
+  into the root's ``/readyz``.
+
+The aggregator never holds its own lock across a scrape (network I/O)
+— stale targets are listed under the lock, scraped outside it, and the
+results written back under it.
+
+Stdlib-only; never imports JAX — the fleet plane must answer even when
+every accelerator in the fleet is wedged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis import locks
+from .anomaly import AnomalyDetector, AnomalySpec
+from .core import gauge as _telemetry_gauge
+from .exposition import (escape_label_value, parse_prometheus_text,
+                         render_prometheus, sanitize_metric_name)
+
+SCHEMA = "dstpu-fleetobs-v1"
+
+#: metric families the aggregator itself emits (under the namespace)
+UP_FAMILY = "fleet_replica_up"
+AGE_FAMILY = "fleet_replica_scrape_age_seconds"
+POD_FAMILIES = (
+    "fleet_pod_routable", "fleet_pod_replicas", "fleet_pod_up_fraction",
+    "fleet_pod_drain_seconds", "fleet_pod_occupancy",
+    "fleet_pod_backlog_tokens", "fleet_pod_prefix_hit_rate",
+    "fleet_pod_tier_bytes", "fleet_pod_burn_rate",
+)
+
+
+@dataclasses.dataclass
+class ScrapeTarget:
+    """One scrapeable replica: ``scrape()`` returns Prometheus text
+    (raising on failure), ``alive()`` gates whether a scrape is even
+    attempted (a dead replica renders ``up 0`` without a connect
+    timeout on every refresh)."""
+    pod: str
+    replica: str
+    scrape: Callable[[], str]
+    alive: Callable[[], bool] = lambda: True
+
+
+class _CacheEntry:
+    __slots__ = ("t", "samples", "types", "error", "n_scrapes",
+                 "n_failures")
+
+    def __init__(self):
+        self.t: Optional[float] = None      # last SUCCESSFUL scrape
+        self.samples: Dict[str, list] = {}
+        self.types: Dict[str, str] = {}
+        self.error: Optional[str] = None
+        self.n_scrapes = 0
+        self.n_failures = 0
+
+
+def _local_scraper(frontend: Any, namespace: str) -> Callable[[], str]:
+    """A local in-process replica renders its own ``TraceLog`` — the
+    process-wide runtime is shared across local replicas, so the
+    aggregator must not re-render it once per replica."""
+    def scrape() -> str:
+        return render_prometheus(tracelog=frontend.tracing,
+                                 namespace=namespace)
+    return scrape
+
+
+class FleetMetricsAggregator:
+    """Merge every replica's Prometheus exposition into one fleet view.
+
+    ``root`` is a :class:`~deepspeed_tpu.serving.fleet.hierarchy
+    .RootRouter` (or None for manual registration via
+    :meth:`add_target` — the test path). Targets are re-discovered
+    from the root on every scrape, so pods added or retired after
+    construction appear and disappear with the hierarchy.
+
+    ``ttl_s`` bounds both staleness and scrape amplification: a fresh
+    cache entry is served as-is, and a replica whose last good scrape
+    is older than ``ttl_s`` flips to ``up 0``."""
+
+    def __init__(self, root: Any = None, *, ttl_s: float = 2.0,
+                 namespace: str = "dstpu",
+                 clock: Callable[[], float] = time.monotonic,
+                 anomaly: Optional[AnomalyDetector] = None,
+                 gauge_fn: Optional[Callable[[str, float], None]] = None):
+        self.root = root
+        self.ttl_s = float(ttl_s)
+        self.namespace = sanitize_metric_name(namespace)
+        self.clock = clock
+        self._gauge = gauge_fn if gauge_fn is not None \
+            else _telemetry_gauge
+        self._lock = locks.make_lock("telemetry.fleetobs")
+        self._manual: Dict[Tuple[str, str], ScrapeTarget] = {}
+        self._cache: Dict[Tuple[str, str], _CacheEntry] = {}
+        self._slo: Dict[str, Any] = {}       # pod -> SLOEngine
+        # pod-level drift detection: specs register lazily as pods
+        # appear (ensure_spec), so the detector survives pod churn
+        # without losing learned baselines for surviving pods
+        self.anomaly = anomaly if anomaly is not None \
+            else AnomalyDetector(
+                [AnomalySpec("fleet_placeholder")], export_gauges=False)
+        self.n_scrapes = 0
+        self.n_scrape_failures = 0
+
+    # ------------------------------------------------------------ targets
+    def add_target(self, pod: str, replica: str,
+                   scrape: Callable[[], str], *,
+                   alive: Optional[Callable[[], bool]] = None) -> None:
+        """Register one scrape target by hand (tests; processes outside
+        the hierarchy)."""
+        t = ScrapeTarget(str(pod), str(replica), scrape,
+                         alive if alive is not None else (lambda: True))
+        with self._lock:
+            self._manual[(t.pod, t.replica)] = t
+
+    def remove_target(self, pod: str, replica: str) -> None:
+        with self._lock:
+            self._manual.pop((str(pod), str(replica)), None)
+
+    def attach_slo(self, pod: str, engine: Any) -> None:
+        """Wire one pod's :class:`~.slo.SLOEngine`; its fastest-window
+        burn rate becomes the pod's ``fleet_pod_burn_rate`` rollup."""
+        with self._lock:
+            self._slo[str(pod)] = engine
+
+    def _discover(self) -> List[ScrapeTarget]:
+        """Current scrape set: manual targets + every replica of every
+        pod the root knows. Remote replicas (``fetch_metrics`` over the
+        wire) and local frontends (direct render) get the same shape."""
+        with self._lock:
+            targets = list(self._manual.values())
+        root = self.root
+        if root is None:
+            return targets
+        for pod_id, leaf in sorted(root.pods.items()):
+            for rep in leaf.replicas:
+                fe = rep.frontend
+                fetch = getattr(fe, "fetch_metrics", None)
+                scrape = fetch if fetch is not None \
+                    else _local_scraper(fe, self.namespace)
+                targets.append(ScrapeTarget(
+                    str(pod_id), str(rep.rid), scrape,
+                    alive=(lambda r=rep: r.alive)))
+        return targets
+
+    # ------------------------------------------------------------- scrape
+    def scrape(self, now: Optional[float] = None,
+               force: bool = False) -> Dict[str, Any]:
+        """Refresh every stale target (older than ``ttl_s``, or all
+        with ``force``); returns a small report. Scrapes run OUTSIDE
+        the aggregator lock — a slow remote never blocks a concurrent
+        ``render``."""
+        now = self.clock() if now is None else float(now)
+        targets = self._discover()
+        with self._lock:
+            known = {(t.pod, t.replica) for t in targets}
+            for key in [k for k in self._cache if k not in known]:
+                del self._cache[key]
+            stale = [t for t in targets
+                     if force or self._stale_locked(t, now)]
+        n_ok = n_fail = 0
+        results: List[Tuple[ScrapeTarget, Optional[dict], str]] = []
+        for t in stale:
+            if not _safe_alive(t):
+                results.append((t, None, "replica not alive"))
+                n_fail += 1
+                continue
+            try:
+                parsed = parse_prometheus_text(t.scrape())
+                results.append((t, parsed, ""))
+                n_ok += 1
+            except Exception as e:  # noqa: BLE001 — a dark replica is data
+                results.append((t, None, f"{type(e).__name__}: {e}"))
+                n_fail += 1
+        with self._lock:
+            for t, parsed, err in results:
+                e = self._cache.setdefault((t.pod, t.replica),
+                                           _CacheEntry())
+                e.n_scrapes += 1
+                if parsed is not None:
+                    e.t = now
+                    e.samples = parsed["samples"]
+                    e.types = parsed["types"]
+                    e.error = None
+                else:
+                    e.n_failures += 1
+                    e.error = err
+            self.n_scrapes += n_ok
+            self.n_scrape_failures += n_fail
+        return {"targets": len(targets), "scraped": len(stale),
+                "ok": n_ok, "failed": n_fail}
+
+    def _stale_locked(self, t: ScrapeTarget, now: float) -> bool:
+        e = self._cache.get((t.pod, t.replica))
+        return e is None or e.t is None or (now - e.t) > self.ttl_s
+
+    def _up(self, e: Optional[_CacheEntry],
+            now: float) -> Tuple[bool, float]:
+        """(up, age_s) for one cache entry: up iff the last successful
+        scrape is within one TTL. Takes the caller's snapshotted entry
+        (never re-reads ``self._cache``) so render/report decisions
+        are consistent with the samples they were snapshotted with."""
+        if e is None or e.t is None:
+            return False, float("inf")
+        age = now - e.t
+        return age <= self.ttl_s, age
+
+    # ------------------------------------------------------------ rollups
+    def pods_report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``/fleet/pods`` JSON document: per-pod rollups + per-
+        replica up/age. Formulas (documented in docs/observability.md):
+        ``occupancy = drain_s / (drain_s + 1)`` — a saturating [0, 1)
+        transform of the pod's estimated drain time; ``prefix_hit_rate
+        = affinity_hits / routed`` at the pod's leaf router;
+        ``tier_bytes`` sums the pod replicas' scraped
+        ``*_serve_tier_{dram,nvme}_bytes`` gauges; ``burn_rate`` is the
+        attached pod SLOEngine's fastest-window burn."""
+        now = self.clock() if now is None else float(now)
+        self.scrape(now)
+        pods: Dict[str, Dict[str, Any]] = {}
+        replicas: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            cache = dict(self._cache)
+            slo = dict(self._slo)
+        for (pod, rid), e in sorted(cache.items()):
+            up, age = self._up(e, now)
+            replicas[f"{pod}/{rid}"] = {
+                "pod": pod, "replica": rid, "up": bool(up),
+                "age_s": (None if age == float("inf") else age),
+                "error": e.error,
+            }
+            p = pods.setdefault(pod, {
+                "pod": pod, "replicas": 0, "up": 0, "tier_bytes": 0.0})
+            p["replicas"] += 1
+            p["up"] += 1 if up else 0
+            p["tier_bytes"] += _tier_bytes(e.samples)
+        root = self.root
+        if root is not None:
+            for pod_id, leaf in sorted(root.pods.items()):
+                p = pods.setdefault(str(pod_id), {
+                    "pod": str(pod_id), "replicas": 0, "up": 0,
+                    "tier_bytes": 0.0})
+                try:
+                    snap = leaf.pod_snapshot(max_age_s=self.ttl_s)
+                except TypeError:
+                    snap = leaf.pod_snapshot()
+                drain = float(snap.get("drain_s", 0.0))
+                p["routable"] = int(snap.get("routable", 0))
+                p["pending"] = int(snap.get("pending", 0))
+                p["backlog_tokens"] = float(
+                    snap.get("backlog_tokens", 0.0))
+                p["drain_s"] = drain
+                p["occupancy"] = drain / (drain + 1.0)
+                routed = int(getattr(leaf, "n_routed", 0))
+                hits = int(getattr(leaf, "n_affinity_hits", 0))
+                p["prefix_hit_rate"] = (hits / routed) if routed else 0.0
+                p["lost"] = str(pod_id) in getattr(root, "_lost", ())
+        for pod, p in pods.items():
+            p["up_fraction"] = (p["up"] / p["replicas"]) \
+                if p["replicas"] else 0.0
+            engine = slo.get(pod)
+            burn = None
+            if engine is not None:
+                try:
+                    burn = float(engine.fast_burn_rate())
+                except Exception:  # noqa: BLE001 — a probe never raises
+                    burn = None
+            p["burn_rate"] = burn
+            self._observe_pod(pod, p, now)
+        return {"schema": SCHEMA, "t": now, "ttl_s": self.ttl_s,
+                "n_pods": len(pods),
+                "n_replicas": len(replicas),
+                "n_up": sum(1 for r in replicas.values() if r["up"]),
+                "pods": pods, "replicas": replicas}
+
+    def _observe_pod(self, pod: str, p: Dict[str, Any],
+                     now: float) -> None:
+        """Export the pod's gauges through the shared runtime (the
+        ISSUE-specified ``fleet/pod_burn_rate|pod=<p>`` scheme) and
+        feed the pod-level drift detector."""
+        burn = p.get("burn_rate")
+        if burn is not None:
+            self._gauge(f"fleet/pod_burn_rate|pod={pod}", float(burn))
+            self.anomaly.ensure_spec(AnomalySpec(
+                f"pod_burn_rate/{pod}", direction="higher_is_bad"))
+            self.anomaly.observe(f"pod_burn_rate/{pod}", float(burn),
+                                 t=now)
+        drain = p.get("drain_s")
+        if drain is not None:
+            self._gauge(f"fleet/pod_drain_rollup_s|pod={pod}",
+                        float(drain))
+            self.anomaly.ensure_spec(AnomalySpec(
+                f"pod_drain_s/{pod}", direction="higher_is_bad"))
+            self.anomaly.observe(f"pod_drain_s/{pod}", float(drain),
+                                 t=now)
+        self._gauge(f"fleet/pod_up_fraction|pod={pod}",
+                    float(p.get("up_fraction", 0.0)))
+
+    # ------------------------------------------------------------- render
+    def render(self, now: Optional[float] = None) -> str:
+        """The merged ``/fleet/metrics`` exposition: every replica's
+        families re-labelled with ``pod=``/``replica=`` (one TYPE
+        header per family, contiguous samples), then the fleet's own
+        ``up``/age series and the pod rollup gauges."""
+        now = self.clock() if now is None else float(now)
+        report = self.pods_report(now)
+        ns = self.namespace
+        reserved = f"{ns}_fleet_"
+        with self._lock:
+            cache = sorted(self._cache.items())
+        families: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+        types: Dict[str, str] = {}
+        ups: List[Tuple[Dict[str, str], float]] = []
+        ages: List[Tuple[Dict[str, str], float]] = []
+        for (pod, rid), e in cache:
+            up, age = self._up(e, now)
+            fleet_labels = {"pod": pod, "replica": rid}
+            ups.append((dict(fleet_labels), 1.0 if up else 0.0))
+            if age != float("inf"):
+                ages.append((dict(fleet_labels), age))
+            if not up:
+                continue        # dark replica: up 0 only, no stale lies
+            for name, entries in e.samples.items():
+                # the aggregator owns the <ns>_fleet_* namespace: a
+                # replica sharing a process with the root renders the
+                # router's own fleet/* gauges in its local scrape —
+                # re-labelling those per-replica would duplicate TYPE
+                # headers and shadow the authoritative rollups below
+                if name.startswith(reserved):
+                    continue
+                fam = families.setdefault(name, [])
+                for labels, value in entries:
+                    merged = dict(labels)
+                    merged["pod"] = pod
+                    merged["replica"] = rid
+                    fam.append((merged, value))
+            for name, kind in e.types.items():
+                types.setdefault(name, kind)
+        lines: List[str] = []
+
+        def _emit(name: str, kind: Optional[str],
+                  entries: List[Tuple[Dict[str, str], float]]) -> None:
+            if kind:
+                lines.append(f"# TYPE {name} {kind}")
+            for labels, value in sorted(
+                    entries, key=lambda e: tuple(sorted(e[0].items()))):
+                inner = ",".join(
+                    f'{k}="{escape_label_value(v)}"'
+                    for k, v in labels.items())
+                head = f"{name}{{{inner}}}" if inner else name
+                lines.append(f"{head} {float(value)}")
+
+        for name in sorted(families):
+            _emit(name, types.get(name), families[name])
+        _emit(f"{ns}_{UP_FAMILY}", "gauge", ups)
+        if ages:
+            _emit(f"{ns}_{AGE_FAMILY}", "gauge", ages)
+        pod_entries: Dict[str, List] = {f: [] for f in POD_FAMILIES}
+        for pod, p in sorted(report["pods"].items()):
+            lbl = {"pod": pod}
+            pod_entries["fleet_pod_replicas"].append(
+                (dict(lbl), float(p.get("replicas", 0))))
+            pod_entries["fleet_pod_up_fraction"].append(
+                (dict(lbl), float(p.get("up_fraction", 0.0))))
+            pod_entries["fleet_pod_tier_bytes"].append(
+                (dict(lbl), float(p.get("tier_bytes", 0.0))))
+            for key, fam in (("routable", "fleet_pod_routable"),
+                             ("drain_s", "fleet_pod_drain_seconds"),
+                             ("occupancy", "fleet_pod_occupancy"),
+                             ("backlog_tokens",
+                              "fleet_pod_backlog_tokens"),
+                             ("prefix_hit_rate",
+                              "fleet_pod_prefix_hit_rate"),
+                             ("burn_rate", "fleet_pod_burn_rate")):
+                v = p.get(key)
+                if v is not None:
+                    pod_entries[fam].append((dict(lbl), float(v)))
+        for fam in POD_FAMILIES:
+            if pod_entries[fam]:
+                _emit(f"{ns}_{fam}", "gauge", pod_entries[fam])
+        _emit(f"{ns}_fleet_pods", "gauge",
+              [({}, float(report["n_pods"]))])
+        _emit(f"{ns}_fleet_replicas_known", "gauge",
+              [({}, float(report["n_replicas"]))])
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------- health
+    def tripped(self) -> bool:
+        """Pod-level drift state for readiness wiring."""
+        return bool(self.anomaly.tripped)
+
+
+def _safe_alive(t: ScrapeTarget) -> bool:
+    try:
+        return bool(t.alive())
+    except Exception:  # noqa: BLE001 — liveness probes never raise
+        return False
+
+
+def _tier_bytes(samples: Dict[str, list]) -> float:
+    """Sum a replica's tiered-KV capacity gauges
+    (``*_serve_tier_dram_bytes`` / ``*_serve_tier_nvme_bytes``) out of
+    its scraped sample map."""
+    total = 0.0
+    for name, entries in samples.items():
+        if "_serve_tier_" in name and name.endswith("_bytes"):
+            total += sum(v for _, v in entries)
+    return total

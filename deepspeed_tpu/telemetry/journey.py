@@ -21,7 +21,15 @@ back together under one **trace id**:
 * :func:`validate_journeys` is the CI gate behind
   ``bin/tputrace journey --validate``: every journey must have a router
   span, stay on a single lane, carry chunk events when it finished
-  ``done``, and carry the reroute link when any segment was rerouted.
+  ``done``, and carry the reroute link when any segment was rerouted;
+* :func:`pod_lane_events` renders the *hierarchy's* half of the story
+  on its own process (pid :data:`PID_PODS`): root placement decisions
+  (ring key, pin source, spill depth) as per-pod ``place`` spans, edge
+  sheds as instants, and cross-pod failovers/migrations as ``podhop``
+  flow arrows from the source pod's lane to the destination's. The
+  validator grows matching connectivity rules — gated only when the
+  trace carries a pod lane and the segments are pod-qualified
+  (``<pod>/<rid>``), so flat-router traces validate unchanged.
 
 Journal shape (``FleetRouter.journey_journal()``)::
 
@@ -57,6 +65,11 @@ _US = 1e6
 #: request lanes — see export.py)
 PID_JOURNEYS = 3
 
+#: pid lane of the hierarchy's pod process: one lane per pod plus an
+#: edge lane (tid 0) for shed decisions (pid 4 is the sim timeline —
+#: see serving/fleet/sim.py)
+PID_PODS = 5
+
 
 def new_trace_id() -> str:
     """Mint a fleet-unique trace id (16 hex chars)."""
@@ -70,6 +83,43 @@ def _segment_time(rec: Dict[str, Any]) -> float:
     if t is None:
         t = min(ev.values()) if ev else 0.0
     return float(t)
+
+
+def _causal_sort(segs: List[Any], *, rep_of, src_of, t_of) -> List[Any]:
+    """Order a journey's segments causally, not just by timestamp: a
+    segment that resumed from replica R (``rerouted_from`` /
+    ``migrated_from``) sorts AFTER R's segment even when their
+    timestamps tie — a replayed record inherits the original submit
+    time, so a salvaged request's hops can all stamp the same instant.
+    Chain depth is the primary key, time the tiebreaker."""
+    by_rep: Dict[str, Any] = {}
+    for s in segs:
+        by_rep.setdefault(str(rep_of(s)), s)
+    depths: Dict[int, int] = {}
+
+    def depth(s: Any, seen: frozenset) -> int:
+        k = id(s)
+        if k in depths:
+            return depths[k]
+        src = src_of(s)
+        d = 0
+        if src is not None:
+            src_s = by_rep.get(str(src))
+            if src_s is not None and id(src_s) not in seen:
+                d = depth(src_s, seen | {id(src_s)}) + 1
+            else:       # unknown source replica: still a later hop
+                d = 1
+        depths[k] = d
+        return d
+
+    for s in segs:
+        depth(s, frozenset((id(s),)))
+    return sorted(segs, key=lambda s: (depths[id(s)], t_of(s)))
+
+
+def _record_src(rec: Dict[str, Any]) -> Optional[str]:
+    src = rec.get("rerouted_from")
+    return src if src is not None else rec.get("migrated_from")
 
 
 def assemble_journeys(journal: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
@@ -109,7 +159,11 @@ def assemble_journeys(journal: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
         if m.get("trace_id") and not m.get("failed"):
             entry(m["trace_id"])["migrations"].append(dict(m))
     for j in journeys.values():
-        j["segments"].sort(key=lambda s: _segment_time(s["record"]))
+        j["segments"] = _causal_sort(
+            j["segments"],
+            rep_of=lambda s: s["replica"],
+            src_of=lambda s: _record_src(s["record"]),
+            t_of=lambda s: _segment_time(s["record"]))
         if j["segments"]:
             j["status"] = j["segments"][-1]["record"].get("status")
     return journeys
@@ -138,15 +192,20 @@ def journey_trace_events(journal: Dict[str, Any], *,
             "args": {"name": f"journey {tid_str[:8]} (uid {uid})"}})
         p = j["placement"]
         if p is not None:
+            rargs = {"trace_id": tid_str,
+                     "replica": p.get("replica"),
+                     "affinity_hit": bool(p.get("affinity_hit")),
+                     "scores": str(p.get("scores")),
+                     "candidates": str(p.get("candidates"))}
+            if p.get("pod") is not None:
+                rargs["pod"] = p.get("pod")
+            if p.get("shed"):
+                rargs["shed"] = True
+                rargs["shed_reason"] = p.get("shed_reason")
             events.append({
                 "name": "route", "ph": "X", "ts": us(p["t"]),
                 "dur": max(float(p.get("dur_s") or 0.0) * _US, 1.0),
-                "pid": pid, "tid": lane,
-                "args": {"trace_id": tid_str,
-                         "replica": p.get("replica"),
-                         "affinity_hit": bool(p.get("affinity_hit")),
-                         "scores": str(p.get("scores")),
-                         "candidates": str(p.get("candidates"))}})
+                "pid": pid, "tid": lane, "args": rargs})
         for seg in j["segments"]:
             rec, rid = seg["record"], seg["replica"]
             ev = rec.get("events") or {}
@@ -216,6 +275,80 @@ def journey_trace_events(journal: Dict[str, Any], *,
     return events
 
 
+def pod_lane_events(journal: Dict[str, Any], *,
+                    pid: int = PID_PODS,
+                    clock_offset_s: float = 0.0) -> List[dict]:
+    """Render the root router's pod-level decisions as their own
+    Perfetto process: one lane per pod plus an edge lane (tid 0) for
+    sheds. Root placement records — the ones carrying ``pod`` but no
+    ``replica`` — become ``place`` spans with the ring key, pin source,
+    and spill path; edge sheds become instants; cross-pod failovers
+    and migrations become ``podhop`` flow-arrow pairs from the source
+    pod's lane to the destination's. A flat-router journal has no
+    pod-level records, so this returns ``[]`` and flat traces gain no
+    empty process."""
+    def us(t: float) -> float:
+        return (float(t) + clock_offset_s) * _US
+
+    placements = [p for p in journal.get("placements", ())
+                  if "replica" not in p
+                  and ("pod" in p or p.get("shed"))]
+    hops: List[tuple] = []
+    for kind, key in (("reroute", "reroutes"),
+                      ("migrate", "migrations")):
+        for r in journal.get(key, ()):
+            fp, tp = r.get("from_pod"), r.get("to_pod")
+            if fp and tp and fp != tp and not r.get("failed"):
+                hops.append((kind, r))
+    if not placements and not hops:
+        return []
+    pods = sorted({str(p["pod"]) for p in placements if p.get("pod")}
+                  | {str(r["from_pod"]) for _, r in hops}
+                  | {str(r["to_pod"]) for _, r in hops})
+    lane = {p: i for i, p in enumerate(pods, start=1)}
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "fleet pods"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "edge (shed)"}},
+    ]
+    for p in pods:
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": lane[p], "args": {"name": f"pod {p}"}})
+    for p in placements:
+        args: Dict[str, Any] = {
+            "trace_id": p.get("trace_id"),
+            "ring_key": p.get("ring_key"), "pin": p.get("pin"),
+            "tried": list(p.get("tried") or ())}
+        if p.get("shed") or p.get("pod") is None:
+            args["shed_reason"] = p.get("shed_reason")
+            events.append({
+                "name": "shed", "ph": "i", "s": "t", "ts": us(p["t"]),
+                "pid": pid, "tid": 0, "args": args})
+            continue
+        args["pod"] = str(p["pod"])
+        args["spilled"] = bool(p.get("spilled"))
+        events.append({
+            "name": "place", "ph": "X", "ts": us(p["t"]),
+            "dur": max(float(p.get("dur_s") or 0.0) * _US, 1.0),
+            "pid": pid, "tid": lane[str(p["pod"])], "args": args})
+    for i, (kind, r) in enumerate(hops):
+        fp, tp = str(r["from_pod"]), str(r["to_pod"])
+        fid = f"podhop:{r.get('trace_id')}:{i}"
+        common = {"name": "podhop", "cat": "podhop", "id": fid,
+                  "pid": pid,
+                  "args": {"trace_id": r.get("trace_id"),
+                           "kind": kind, "from_pod": fp,
+                           "to_pod": tp}}
+        events.append({**common, "ph": "s", "tid": lane[fp],
+                       "ts": us(r["t"])})
+        events.append({**common, "ph": "f", "bp": "e",
+                       "tid": lane[tp],
+                       "ts": us(r["t"])
+                       + max(float(r.get("dur_s") or 0.0) * _US, 1.0)})
+    return events
+
+
 # ------------------------------------------------------------- validation
 def _journey_events(trace_obj: Dict[str, Any],
                     pid: int = PID_JOURNEYS) -> Dict[str, List[dict]]:
@@ -232,6 +365,7 @@ def _journey_events(trace_obj: Dict[str, Any],
 
 def validate_journeys(trace_obj: Dict[str, Any], *,
                       pid: int = PID_JOURNEYS,
+                      pods_pid: Optional[int] = PID_PODS,
                       require_chunks: bool = True) -> List[str]:
     """The ``tputrace journey --validate`` contract over a merged trace:
 
@@ -245,11 +379,20 @@ def validate_journeys(trace_obj: Dict[str, Any], *,
     * migration hops are gated: the journey stays on its single lane,
       each ``migrated_from`` segment has EXACTLY one ``migrate`` flow
       arrow, and there is no token gap at the hop — the segment's
-      ``resumed_tokens`` equals everything emitted before it.
+      ``resumed_tokens`` equals everything emitted before it;
+    * hierarchy traces add pod connectivity (active only when the
+      trace carries a pod lane — ``pods_pid`` — and the journey's
+      segments are pod-qualified ``<pod>/<rid>``): an edge-shed
+      journey may legitimately have zero segments, every placed
+      journey needs a ``place`` span on the pod that ran its first
+      segment, and every cross-pod transition needs a ``podhop`` flow
+      pair.
 
     Returns a list of problems (empty = valid)."""
     problems: List[str] = []
     by_tid = _journey_events(trace_obj, pid)
+    pod_lane = _journey_events(trace_obj, pods_pid) \
+        if pods_pid is not None else {}
     if not by_tid:
         problems.append("no journey events found (pid %d)" % pid)
         return problems
@@ -262,12 +405,20 @@ def validate_journeys(trace_obj: Dict[str, Any], *,
         if len(routes) != 1:
             problems.append(
                 f"journey {tid}: expected 1 route span, got {len(routes)}")
+        shed = any((e.get("args") or {}).get("shed") for e in routes)
         segments = [e for e in evs if e.get("ph") == "X"
                     and str(e.get("name", "")).startswith("replica")]
         if not segments:
-            problems.append(f"journey {tid}: no replica segment span")
+            if not shed:
+                problems.append(
+                    f"journey {tid}: no replica segment span")
             continue
-        final = max(segments, key=lambda e: e.get("ts", 0.0))
+        ordered = _causal_sort(
+            segments,
+            rep_of=lambda e: (e.get("args") or {}).get("replica") or "",
+            src_of=lambda e: _record_src(e.get("args") or {}),
+            t_of=lambda e: e.get("ts", 0.0))
+        final = ordered[-1]
         status = (final.get("args") or {}).get("status")
         chunks = [e for e in evs if e.get("ph") == "i"
                   and str(e.get("name", "")).startswith("chunk")]
@@ -284,7 +435,6 @@ def validate_journeys(trace_obj: Dict[str, Any], *,
                 problems.append(
                     f"journey {tid}: rerouted segment without a "
                     f"reroute flow link (have phases {sorted(flows)})")
-        ordered = sorted(segments, key=lambda e: e.get("ts", 0.0))
         migrated = [e for e in ordered
                     if (e.get("args") or {}).get("migrated_from")
                     is not None]
@@ -314,6 +464,45 @@ def validate_journeys(trace_obj: Dict[str, Any], *,
                     f"journey {tid}: token gap at migration hop "
                     f"(resumed_tokens={resumed}, emitted before "
                     f"hop={before})")
+        # pod connectivity (hierarchy traces): active only when the
+        # trace carries a pod lane AND every segment is pod-qualified,
+        # so flat-router traces keep validating unchanged
+        pod_seq: List[str] = []
+        for e in ordered:
+            rep = str((e.get("args") or {}).get("replica") or "")
+            if "/" not in rep:
+                pod_seq = []
+                break
+            pod_seq.append(rep.split("/", 1)[0])
+        if pod_seq and pod_lane:
+            pevs = pod_lane.get(tid, [])
+            places = sorted(
+                (e for e in pevs if e.get("ph") == "X"
+                 and e.get("name") == "place"),
+                key=lambda e: e.get("ts", 0.0))
+            if not places:
+                problems.append(
+                    f"journey {tid}: pod-qualified segments but no "
+                    f"place span on the pod lane (pid {pods_pid})")
+            else:
+                placed = str((places[0].get("args") or {}).get("pod"))
+                if placed != pod_seq[0]:
+                    problems.append(
+                        f"journey {tid}: placed on pod {placed} but "
+                        f"first segment ran on pod {pod_seq[0]}")
+            hops = {"s": set(), "f": set()}
+            for e in pevs:
+                if e.get("cat") == "podhop" and e.get("ph") in hops:
+                    a = e.get("args") or {}
+                    hops[e["ph"]].add((str(a.get("from_pod")),
+                                       str(a.get("to_pod"))))
+            for a, b in zip(pod_seq, pod_seq[1:]):
+                if a == b:
+                    continue
+                if (a, b) not in hops["s"] or (a, b) not in hops["f"]:
+                    problems.append(
+                        f"journey {tid}: pod hop {a} -> {b} without a "
+                        f"podhop flow pair on the pod lane")
     return problems
 
 

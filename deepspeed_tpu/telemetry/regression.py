@@ -346,6 +346,28 @@ FLEET_SPECS: List[MetricSpec] = [
                abs_tol=1.0,
                note="max-min per-replica running count after rebalance; "
                     "the hard bound is asserted inside the bench"),
+    # ---- fleet observability plane (--fleetobs, telemetry/fleetobs.py) ----
+    MetricSpec(("fleetobs", "n_replicas"), SHIFT, abs_tol=0.0,
+               note="3-pod mixed local+remote topology is pinned"),
+    MetricSpec(("fleetobs", "n_up_initial"), SHIFT, abs_tol=0.0,
+               note="every replica scrapes up=1 at steady state"),
+    MetricSpec(("fleetobs", "n_up_after_kill"), SHIFT, abs_tol=0.0,
+               note="killing the remote replica flips exactly its "
+                    "up series to 0 within one TTL"),
+    MetricSpec(("fleetobs", "dark_replica_up_zero"), SHIFT, abs_tol=0.0,
+               note="the dead replica renders up 0, never vanishes"),
+    MetricSpec(("fleetobs", "type_headers_unique"), SHIFT, abs_tol=0.0,
+               note="one TYPE header per family in the merged "
+                    "exposition, binary"),
+    MetricSpec(("fleetobs", "pod_families_present"), SHIFT, abs_tol=0.0,
+               note="all dstpu_fleet_pod_* rollup families render"),
+    MetricSpec(("fleetobs", "journey_validate_ok"), SHIFT, abs_tol=0.0,
+               note="forced cross-pod failover journey passes "
+                    "tputrace-style validation incl. pod-hop links, "
+                    "binary"),
+    MetricSpec(("fleetobs", "scrape_s"), LOWER, 1.00, abs_tol=1.0,
+               note="full-fleet scrape wall time (loopback HTTP; CPU "
+                    "timing is noisy)"),
 ]
 
 KERNELS_SPECS: List[MetricSpec] = [
@@ -418,6 +440,19 @@ FLEETSIM_SPECS: List[MetricSpec] = [
     MetricSpec(("chaos", "pod_failover"), SHIFT, abs_tol=0.0,
                note="pod loss salvages in-flight streams cross-pod, "
                     "deterministic count"),
+    # ---- sim-time timeline export (sim_trace_events, --trace-out) ----
+    MetricSpec(("chaos", "trace", "valid"), SHIFT, abs_tol=0.0,
+               note="exported sim-time Chrome trace passes "
+                    "validate_trace, binary"),
+    MetricSpec(("chaos", "trace", "n_lanes"), SHIFT, abs_tol=0.0,
+               note="one lane per sim replica plus the world lane — "
+                    "deterministic topology"),
+    MetricSpec(("chaos", "trace", "n_kill_arrows"), SHIFT, abs_tol=0.0,
+               note="one flow arrow per watchdog kill, exact"),
+    MetricSpec(("chaos", "trace", "n_chaos_instants"), SHIFT,
+               abs_tol=0.0,
+               note="pod-loss chaos renders as global-scope instants, "
+                    "exact count"),
 ]
 
 SPEC_SETS: Dict[str, List[MetricSpec]] = {

@@ -1,0 +1,249 @@
+"""Bench history: append-only JSONL of ``BENCH_*.json`` rounds and a
+drift report over the tail.
+
+``bin/benchdiff`` compares exactly two rounds; nothing remembers the
+rounds themselves, so a slow drift that stays inside the per-pair
+tolerance band every time — 5% a week for a quarter — never trips
+anything. ``bin/benchtrend`` closes that window:
+
+* ``append`` — record one bench document into the history file
+  (default ``.bench_history.jsonl`` at the repo root), keyed by git
+  sha + wall timestamp + a content digest. Re-appending an identical
+  document under the same sha is a no-op, so a CI job can append on
+  every run without bloating the file.
+* ``report`` — walk the last N entries per bench kind and re-evaluate
+  every :mod:`.regression` MetricSpec oldest-vs-newest: a metric that
+  moved beyond its band across the WINDOW is drift, even if every
+  adjacent pair stayed inside it. ``--fail-on-drift`` turns the report
+  into a gate.
+
+History lines are self-contained JSON objects::
+
+    {"t": <epoch>, "iso": "...", "sha": "<git sha or 'unknown'>",
+     "dirty": bool, "file": "BENCH_fleet.json", "kind": "fleet",
+     "digest": "<sha256 of the canonical doc>", "bench": {...}}
+
+Stdlib-only — never imports JAX (same contract as ``regression.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .regression import SPEC_SETS, _check_one, detect_kind, lookup
+
+SCHEMA = "dstpu-benchtrend-v1"
+
+#: default history file, repo-root relative
+HISTORY_FILE = ".bench_history.jsonl"
+
+
+def _git_sha(cwd: Optional[str] = None) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=cwd, timeout=10,
+            capture_output=True, text=True)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:  # noqa: BLE001 — history works outside git too
+        pass
+    return "unknown"
+
+
+def _git_dirty(cwd: Optional[str] = None) -> bool:
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=cwd, timeout=10,
+            capture_output=True, text=True)
+        return out.returncode == 0 and bool(out.stdout.strip())
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _digest(doc: Dict[str, Any]) -> str:
+    return hashlib.sha256(
+        json.dumps(doc, sort_keys=True).encode("utf-8")).hexdigest()
+
+
+def append_entry(bench_path: str, history_path: str = HISTORY_FILE, *,
+                 sha: Optional[str] = None,
+                 now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+    """Append one bench document to the history. Returns the entry
+    written, or None when the latest entry for this file already holds
+    the identical document under the same sha (append-only dedupe)."""
+    with open(bench_path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{bench_path}: bench document must be an "
+                         f"object, got {type(doc).__name__}")
+    sha = sha if sha is not None else _git_sha()
+    now = time.time() if now is None else float(now)
+    entry = {
+        "schema": SCHEMA,
+        "t": now,
+        "iso": datetime.datetime.fromtimestamp(
+            now, datetime.timezone.utc).isoformat(),
+        "sha": sha,
+        "dirty": _git_dirty(),
+        "file": os.path.basename(bench_path),
+        "kind": detect_kind(doc),
+        "digest": _digest(doc),
+        "bench": doc,
+    }
+    last = None
+    for e in load_history(history_path):
+        if e.get("file") == entry["file"]:
+            last = e
+    if last is not None and last.get("digest") == entry["digest"] \
+            and last.get("sha") == entry["sha"]:
+        return None
+    with open(history_path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return entry
+
+
+def load_history(history_path: str = HISTORY_FILE) -> List[Dict[str, Any]]:
+    """Every parseable entry, file order (oldest first). Corrupt lines
+    are skipped — an interrupted append must not poison the report."""
+    if not os.path.exists(history_path):
+        return []
+    out: List[Dict[str, Any]] = []
+    with open(history_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(e, dict) and isinstance(e.get("bench"), dict):
+                out.append(e)
+    return out
+
+
+def drift_report(history_path: str = HISTORY_FILE, *,
+                 last: int = 10,
+                 kind: Optional[str] = None) -> Dict[str, Any]:
+    """Oldest-vs-newest spec evaluation over the last ``last`` entries
+    of each bench kind. A metric whose window-wide move exceeds its
+    band is ``drift`` — the slow creep per-pair diffs never see."""
+    entries = load_history(history_path)
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for e in entries:
+        k = e.get("kind")
+        if k in SPEC_SETS and (kind is None or k == kind):
+            by_kind.setdefault(k, []).append(e)
+    kinds: Dict[str, Any] = {}
+    n_drift = 0
+    for k, es in sorted(by_kind.items()):
+        window = es[-max(2, int(last)):] if len(es) >= 2 else es
+        rep: Dict[str, Any] = {
+            "n_entries": len(es), "n_window": len(window),
+            "oldest": {"sha": window[0].get("sha"),
+                       "iso": window[0].get("iso")},
+            "newest": {"sha": window[-1].get("sha"),
+                       "iso": window[-1].get("iso")},
+            "metrics": [],
+        }
+        if len(window) >= 2:
+            base, cur = window[0]["bench"], window[-1]["bench"]
+            for spec in SPEC_SETS[k]:
+                rec = _check_one(spec, lookup(base, spec.path),
+                                 lookup(cur, spec.path))
+                series = [lookup(e["bench"], spec.path) for e in window]
+                rec["series"] = [
+                    (float(v) if isinstance(v, (int, float)) else None)
+                    for v in series]
+                rec["drift"] = rec["status"] == "regression"
+                n_drift += 1 if rec["drift"] else 0
+                rep["metrics"].append(rec)
+        kinds[k] = rep
+    return {"schema": SCHEMA, "history": history_path,
+            "window": int(last), "kinds": kinds,
+            "n_drift": n_drift, "ok": n_drift == 0}
+
+
+def _print_report(rep: Dict[str, Any]) -> None:
+    for k, kr in sorted(rep["kinds"].items()):
+        print(f"{k}: {kr['n_entries']} entries, window "
+              f"{kr['n_window']} ({kr['oldest'].get('sha', '?')[:9]} "
+              f"-> {kr['newest'].get('sha', '?')[:9]})")
+        if not kr["metrics"]:
+            print("  (need >= 2 entries for a drift window)")
+            continue
+        flagged = [m for m in kr["metrics"] if m["drift"]]
+        moved = [m for m in kr["metrics"]
+                 if not m["drift"] and m["status"] == "ok"
+                 and m.get("delta")]
+        for m in flagged:
+            print(f"  DRIFT {m['metric']}: "
+                  f"{m.get('baseline')} -> {m.get('current')} "
+                  f"(dir {m['direction']}, rel_tol {m['rel_tol']})")
+        for m in moved[:8]:
+            print(f"  moved {m['metric']}: "
+                  f"{m.get('baseline')} -> {m.get('current')}")
+        if not flagged:
+            print(f"  no drift across {len(kr['metrics'])} watched "
+                  f"metrics")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="benchtrend",
+        description="Append BENCH_*.json rounds to an append-only "
+                    "JSONL history and report drift over the tail.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pa = sub.add_parser("append", help="record one bench round")
+    pa.add_argument("bench", nargs="+", help="BENCH_*.json file(s)")
+    pa.add_argument("--history", default=HISTORY_FILE)
+    pr = sub.add_parser("report", help="drift over the last N entries")
+    pr.add_argument("--history", default=HISTORY_FILE)
+    pr.add_argument("--last", type=int, default=10,
+                    help="window size per bench kind")
+    pr.add_argument("--kind", default=None, choices=sorted(SPEC_SETS))
+    pr.add_argument("--json-out", default=None)
+    pr.add_argument("--fail-on-drift", action="store_true",
+                    help="exit 1 when any watched metric drifted "
+                         "across the window")
+    args = p.parse_args(argv)
+    if args.cmd == "append":
+        rc = 0
+        for path in args.bench:
+            try:
+                e = append_entry(path, args.history)
+            except (OSError, ValueError) as exc:
+                print(f"benchtrend: cannot append {path}: {exc}",
+                      file=sys.stderr)
+                rc = 2
+                continue
+            if e is None:
+                print(f"benchtrend: {path}: unchanged since last "
+                      f"entry, skipped")
+            else:
+                print(f"benchtrend: appended {path} "
+                      f"(kind={e['kind']}, sha={e['sha'][:9]}) to "
+                      f"{args.history}")
+        return rc
+    rep = drift_report(args.history, last=args.last, kind=args.kind)
+    _print_report(rep)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(rep, f, indent=2)
+    if args.fail_on_drift and not rep["ok"]:
+        print(f"benchtrend: {rep['n_drift']} metric(s) drifted",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

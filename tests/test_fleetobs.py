@@ -1,0 +1,383 @@
+"""Fleet observability plane: merged metrics aggregation, dark-replica
+semantics, TTL staleness, loopback HTTP scrape, and hierarchy-complete
+journey validation.
+
+The aggregator tests run over MANUAL scrape targets (plain callables —
+no fleet needed) on a fake clock, so merge discipline, label escaping,
+and TTL arithmetic are tested deterministically. The loopback test
+stands up one real :class:`ReplicaServer` and scrapes it through
+:meth:`RemoteReplica.fetch_metrics` — the same wire the router speaks.
+The journey tests force a whole-pod loss mid-stream on the
+deterministic simulator and gate the merged Perfetto export with
+``validate_journeys`` — including the negative direction: a trace with
+its pod-hop flow arrows stripped must FAIL validation, proving the
+pod-connectivity rules actually fire.
+"""
+
+import time
+
+import pytest
+
+from deepspeed_tpu.telemetry.exposition import parse_prometheus_text
+from deepspeed_tpu.telemetry.fleetobs import (FleetMetricsAggregator,
+                                              POD_FAMILIES)
+
+pytestmark = pytest.mark.fleetsim
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _agg(ttl_s=1.0, clock=None):
+    return FleetMetricsAggregator(
+        None, ttl_s=ttl_s, clock=clock or FakeClock(),
+        gauge_fn=lambda name, value: None)
+
+
+# --------------------------------------------------------------------------
+# merge semantics
+# --------------------------------------------------------------------------
+class TestMergeSemantics:
+    def test_one_type_header_per_family_and_contiguous(self):
+        agg = _agg()
+        text_a = ('# TYPE dstpu_serve_tokens_total counter\n'
+                  'dstpu_serve_tokens_total 10\n'
+                  '# TYPE dstpu_serve_queue_depth gauge\n'
+                  'dstpu_serve_queue_depth 2\n')
+        text_b = ('# TYPE dstpu_serve_tokens_total counter\n'
+                  'dstpu_serve_tokens_total 32\n')
+        agg.add_target("pa", "r0", lambda: text_a)
+        agg.add_target("pb", "r0", lambda: text_b)
+        out = agg.render()
+        lines = out.splitlines()
+        type_lines = [ln for ln in lines if ln.startswith("# TYPE ")]
+        names = [ln.split()[2] for ln in type_lines]
+        assert len(names) == len(set(names)), names
+        assert names.count("dstpu_serve_tokens_total") == 1
+        # both replicas' samples, re-labelled, contiguous under the
+        # single header
+        fam = [i for i, ln in enumerate(lines)
+               if ln.startswith("dstpu_serve_tokens_total{")]
+        assert len(fam) == 2
+        assert fam[1] == fam[0] + 1, "family samples not contiguous"
+        parsed = parse_prometheus_text(out)
+        entries = parsed["samples"]["dstpu_serve_tokens_total"]
+        got = {(e[0]["pod"], e[0]["replica"]): e[1] for e in entries}
+        assert got == {("pa", "r0"): 10.0, ("pb", "r0"): 32.0}
+
+    def test_label_escaping_with_embedded_pod(self):
+        """A replica-side label VALUE containing ``",pod="`` must not
+        inject a fake pod label into the merged exposition — the
+        aggregator's own ``pod=`` wins and the hostile value survives
+        escaped, byte-for-byte."""
+        hostile = 'x",pod="evil'
+        src = ('# TYPE dstpu_serve_tokens_total counter\n'
+               'dstpu_serve_tokens_total{tenant="x\\",pod=\\"evil"} 7\n')
+        agg = _agg()
+        agg.add_target("pa", "r0", lambda: src)
+        out = agg.render()
+        parsed = parse_prometheus_text(out)
+        entries = parsed["samples"]["dstpu_serve_tokens_total"]
+        assert len(entries) == 1
+        labels, value = entries[0]
+        assert value == 7.0
+        assert labels["pod"] == "pa"
+        assert labels["replica"] == "r0"
+        assert labels["tenant"] == hostile
+
+    def test_up_series_always_renders(self):
+        agg = _agg()
+        agg.add_target("pa", "r0",
+                       lambda: "# TYPE x gauge\nx 1\n")
+        out = agg.render()
+        assert ('dstpu_fleet_replica_up{pod="pa",replica="r0"} 1.0'
+                in out)
+
+    def test_scraped_fleet_namespace_is_dropped(self):
+        """A replica sharing a process with the root router renders the
+        router's own ``fleet/*`` gauges in its local scrape — the
+        aggregator owns the ``<ns>_fleet_*`` namespace, so those
+        scraped copies must be dropped, never re-labelled (which would
+        duplicate TYPE headers and shadow the authoritative rollups)."""
+        src = ('# TYPE dstpu_fleet_pods gauge\n'
+               'dstpu_fleet_pods 3\n'
+               '# TYPE dstpu_fleet_pod_backlog_tokens gauge\n'
+               'dstpu_fleet_pod_backlog_tokens{pod="stale"} 99\n'
+               '# TYPE dstpu_serve_tokens_total counter\n'
+               'dstpu_serve_tokens_total 5\n')
+        agg = _agg()
+        agg.add_target("pa", "r0", lambda: src)
+        out = agg.render()
+        type_lines = [ln for ln in out.splitlines()
+                      if ln.startswith("# TYPE ")]
+        names = [ln.split()[2] for ln in type_lines]
+        assert len(names) == len(set(names)), names
+        assert 'pod="stale"' not in out
+        parsed = parse_prometheus_text(out)
+        # the non-reserved family survives, re-labelled
+        entries = parsed["samples"]["dstpu_serve_tokens_total"]
+        assert [(e[0]["pod"], e[1]) for e in entries] == [("pa", 5.0)]
+        # the aggregator's own summary gauge is the only fleet_pods
+        # series left, counting the one known pod — not the scraped 3
+        assert parsed["samples"]["dstpu_fleet_pods"] == [({}, 1.0)]
+
+
+# --------------------------------------------------------------------------
+# dark replicas + TTL
+# --------------------------------------------------------------------------
+class TestDarkReplicaAndTTL:
+    def test_failed_scrape_renders_up_zero_not_absence(self):
+        agg = _agg()
+
+        def boom():
+            raise ConnectionError("replica is dark")
+
+        agg.add_target("pa", "r0", boom)
+        agg.add_target("pa", "r1", lambda: "# TYPE x gauge\nx 3\n")
+        out = agg.render()
+        assert ('dstpu_fleet_replica_up{pod="pa",replica="r0"} 0.0'
+                in out)
+        assert ('dstpu_fleet_replica_up{pod="pa",replica="r1"} 1.0'
+                in out)
+        # the dark replica contributes NO stale samples
+        parsed = parse_prometheus_text(out)
+        assert all(e[0].get("replica") != "r0"
+                   for e in parsed["samples"].get("x", []))
+
+    def test_dead_alive_gate_skips_the_scrape(self):
+        calls = []
+        agg = _agg()
+        agg.add_target("pa", "r0", lambda: calls.append(1) or "x 1\n",
+                       alive=lambda: False)
+        out = agg.render()
+        assert calls == [], "scraped a replica whose alive() is False"
+        assert ('dstpu_fleet_replica_up{pod="pa",replica="r0"} 0.0'
+                in out)
+
+    def test_ttl_staleness_flips_up_and_bounds_scrapes(self):
+        clock = FakeClock()
+        agg = _agg(ttl_s=1.0, clock=clock)
+        state = {"ok": True, "n": 0}
+
+        def scrape():
+            state["n"] += 1
+            if not state["ok"]:
+                raise ConnectionError("down")
+            return "# TYPE x gauge\nx 1\n"
+
+        agg.add_target("pa", "r0", scrape)
+        assert 'replica="r0"} 1.0' in agg.render()
+        n_after_first = state["n"]
+        # fresh within the TTL: served from cache, no new scrape
+        clock.advance(0.5)
+        assert 'replica="r0"} 1.0' in agg.render()
+        assert state["n"] == n_after_first
+        # past the TTL and now failing: one refresh attempt, up -> 0
+        state["ok"] = False
+        clock.advance(1.0)
+        out = agg.render()
+        assert state["n"] == n_after_first + 1
+        assert ('dstpu_fleet_replica_up{pod="pa",replica="r0"} 0.0'
+                in out)
+        # recovery: the next refresh succeeds and up returns
+        state["ok"] = True
+        clock.advance(1.5)
+        assert 'replica="r0"} 1.0' in agg.render()
+
+    def test_removed_target_vanishes(self):
+        agg = _agg()
+        agg.add_target("pa", "r0", lambda: "x 1\n")
+        agg.render()
+        agg.remove_target("pa", "r0")
+        assert 'replica="r0"' not in agg.render()
+
+
+# --------------------------------------------------------------------------
+# loopback HTTP scrape (real ReplicaServer, real wire)
+# --------------------------------------------------------------------------
+class TestLoopbackScrape:
+    def test_remote_replica_scrape_and_dark_flip(self):
+        from deepspeed_tpu.benchmarks.fleet_bench import SimulatedEngine
+        from deepspeed_tpu.serving.fleet import (RemoteReplica,
+                                                 ReplicaServer)
+        from deepspeed_tpu.serving.frontend.frontend import \
+            ServingFrontend
+
+        fe = ServingFrontend(SimulatedEngine(chunk_time_s=0.001),
+                             telemetry_label="obs-test")
+        srv = ReplicaServer(fe)
+        rem = RemoteReplica("127.0.0.1", srv.port, label="obs-test")
+        agg = FleetMetricsAggregator(
+            None, ttl_s=0.2, gauge_fn=lambda n, v: None)
+        try:
+            agg.add_target("pr", "r0", rem.fetch_metrics)
+            out = agg.render()
+            assert ('dstpu_fleet_replica_up{pod="pr",replica="r0"} 1.0'
+                    in out)
+            # the remote's own families arrive pod/replica-labelled
+            parsed = parse_prometheus_text(out)
+            remote_fams = [
+                name for name, entries in parsed["samples"].items()
+                if name.startswith("dstpu_")
+                and any(e[0].get("pod") == "pr" for e in entries)]
+            assert remote_fams, "no remote families in the merge"
+            srv.close()
+            time.sleep(0.3)          # past the TTL
+            out2 = agg.render()
+            assert ('dstpu_fleet_replica_up{pod="pr",replica="r0"} 0.0'
+                    in out2)
+        finally:
+            srv.close()
+            fe.close(timeout=10)
+
+
+# --------------------------------------------------------------------------
+# hierarchy-complete journeys under forced pod loss
+# --------------------------------------------------------------------------
+def _failover_trace(seed=11):
+    from deepspeed_tpu.serving.fleet import (RootConfig, RootRouter,
+                                             SimReplicaConfig, SimWorld,
+                                             build_sim_fleet,
+                                             sim_expected)
+    world = SimWorld(seed=seed)
+    root = RootRouter(config=RootConfig(), clock=world.clock)
+    build_sim_fleet(world, root, n_pods=3, pod_size=2,
+                    config=SimReplicaConfig(decode_tokens_per_s=8.0))
+    try:
+        handles = [root.submit([3, i + 1], max_new_tokens=16)
+                   for i in range(12)]
+        world.clock.run_for(0.5)               # mid-stream everywhere
+        victim = root._placements[-1]["pod"]
+        root.mark_pod_lost(victim)
+        for rep in list(root.pods[victim].replicas):
+            rep.frontend.fail(RuntimeError("rack power"))
+        world.clock.run_for(60.0)
+        for i, h in enumerate(handles):
+            assert h.status == "done", (i, h.status, h.reject_reason)
+            assert h.tokens == sim_expected([3, i + 1], 16)
+        assert root.stats()["pod_failover"] >= 1
+        return root.export_chrome(None)
+    finally:
+        root.close()
+
+
+class TestFailoverJourneys:
+    def test_pod_loss_failover_journeys_validate(self):
+        """Regression for the dropped trace context in the hierarchy's
+        failover/re-submit paths: a forced whole-pod loss must still
+        produce CONNECTED journeys — every re-homed stream one journey
+        under one trace id, the cross-pod hop drawn and linked on the
+        pod lane (pid 5)."""
+        from deepspeed_tpu.telemetry.journey import validate_journeys
+        trace = _failover_trace()
+        assert validate_journeys(trace) == []
+        pod_lane = [e for e in trace["traceEvents"]
+                    if e.get("pid") == 5]
+        assert any(e.get("ph") == "X" and e.get("name") == "place"
+                   for e in pod_lane)
+        assert any(e.get("cat") == "podhop" and e.get("ph") == "s"
+                   for e in pod_lane)
+
+    def test_queued_double_hop_journeys_validate(self):
+        """A request still QUEUED on the lost pod hops twice: within
+        the dead pod first (leaf crash salvage to a sibling that is
+        also about to die), then cross-pod. The replayed records all
+        inherit the original submit time AND the within-pod hop marks
+        ``rerouted_from`` with a flat rid — the journal must qualify
+        it and the validator must order the chain causally, not by
+        the tied timestamps (regression: this exact shape reported
+        'placed on pod X but first segment ran on pod Y')."""
+        from deepspeed_tpu.serving.fleet import (RootConfig, RootRouter,
+                                                 SimReplicaConfig,
+                                                 SimWorld,
+                                                 build_sim_fleet,
+                                                 sim_expected)
+        from deepspeed_tpu.telemetry.journey import validate_journeys
+        world = SimWorld(seed=7)
+        root = RootRouter(config=RootConfig(), clock=world.clock)
+        build_sim_fleet(world, root, n_pods=3, pod_size=2,
+                        config=SimReplicaConfig(decode_tokens_per_s=8.0))
+        try:
+            handles = [root.submit([3, i + 1], max_new_tokens=12)
+                       for i in range(9)]       # oversubscribed: queues
+            world.clock.run_for(0.5)
+            victim = root._placements[-1]["pod"]
+            dead = list(root.pods[victim].replicas)
+            root.mark_pod_lost(victim)
+            for rep in dead:
+                rep.frontend.fail(RuntimeError("rack power"))
+            world.clock.run_for(60.0)
+            for i, h in enumerate(handles):
+                assert h.tokens == sim_expected([3, i + 1], 12)
+            trace = root.export_chrome(None)
+            assert validate_journeys(trace) == []
+            # the within-pod salvage hop is pod-qualified in the merge
+            srcs = [(e.get("args") or {}).get("rerouted_from")
+                    for e in trace["traceEvents"]
+                    if (e.get("args") or {}).get("rerouted_from")]
+            assert srcs and all("/" in str(s) for s in srcs), srcs
+        finally:
+            root.close()
+
+    def test_podhop_gate_actually_fires(self):
+        """Strip the pod-hop flow arrows out of a failover trace: the
+        validator must flag the now-unlinked cross-pod transition —
+        otherwise the connectivity rule is decorative."""
+        from deepspeed_tpu.telemetry.journey import validate_journeys
+        trace = _failover_trace()
+        trace["traceEvents"] = [e for e in trace["traceEvents"]
+                                if e.get("cat") != "podhop"]
+        problems = validate_journeys(trace)
+        assert problems
+        assert any("podhop" in p or "pod hop" in p for p in problems)
+
+
+# --------------------------------------------------------------------------
+# pod rollups + anomaly wiring over a sim hierarchy
+# --------------------------------------------------------------------------
+class TestPodRollups:
+    def test_rollups_and_pod_families(self):
+        from deepspeed_tpu.serving.fleet import (RootConfig, RootRouter,
+                                                 SimReplicaConfig,
+                                                 SimWorld,
+                                                 build_sim_fleet)
+        world = SimWorld(seed=4)
+        root = RootRouter(config=RootConfig(), clock=world.clock)
+        build_sim_fleet(world, root, n_pods=2, pod_size=2,
+                        config=SimReplicaConfig(
+                            decode_tokens_per_s=8.0))
+        try:
+            for i in range(6):
+                root.submit([5, i + 1], max_new_tokens=8)
+            world.clock.run_for(30.0)
+            agg = FleetMetricsAggregator(
+                root, ttl_s=5.0, clock=world.clock,
+                gauge_fn=lambda n, v: None)
+            rep = agg.pods_report()
+            assert rep["n_pods"] == 2
+            assert rep["n_replicas"] == 4
+            assert rep["n_up"] == 4
+            for p in rep["pods"].values():
+                assert p["replicas"] == 2
+                assert p["up_fraction"] == 1.0
+                assert 0.0 <= p["occupancy"] < 1.0
+                assert 0.0 <= p["prefix_hit_rate"] <= 1.0
+                assert p["lost"] is False
+            out = agg.render()
+            for fam in POD_FAMILIES:
+                if fam == "fleet_pod_burn_rate":
+                    continue        # no SLO engines attached here
+                assert f"dstpu_{fam}" in out, fam
+            # the pod-level anomaly specs registered lazily
+            specs = {s for s in agg.anomaly.specs}
+            assert any(s.startswith("pod_drain_s/") for s in specs)
+        finally:
+            root.close()
